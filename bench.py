@@ -1,0 +1,104 @@
+"""Benchmark: HBM bin-pack utilization + filter/bind latency.
+
+Replays BASELINE.json config #4 (the north star: 8 JAX inference pods per
+v5p-8 node, 4 chips x 95 GiB) across a simulated 16-node fleet through
+the REAL extender stack — HTTP server, JSON wire protocol, controller,
+ledger — measuring per-pod scheduling latency end to end, then reports:
+
+* headline: cluster HBM bin-pack utilization % (target >= 90, the value
+  the reference never published — BASELINE.md);
+* p50/p99 filter+bind latency in ms (the Prometheus-tracked metric).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+import urllib.error
+import urllib.request
+
+NODES = 16
+PODS_PER_NODE = 8
+POD_HBM = 44          # 2 x 44 GiB per 95-GiB chip -> 92.6% packed
+CHIPS, CHIP_HBM = 4, 95
+TARGET_UTIL = 90.0    # BASELINE.json north star
+
+
+def post(base, path, doc):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def main() -> None:
+    from tpushare.cmd.main import build_stack
+    from tpushare.k8s.builders import make_node, make_pod
+    from tpushare.k8s.fake import FakeApiServer
+    from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+
+    api = FakeApiServer()
+    for i in range(NODES):
+        api.create_node(make_node(f"v5p-{i:02d}", chips=CHIPS,
+                                  hbm_per_chip=CHIP_HBM,
+                                  topology="2x2x1", tpu_type="v5p"))
+
+    controller, pred, binder, inspect = build_stack(api)
+    controller.start(workers=4)
+    server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect)
+    serve_forever(server)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    node_names = [f"v5p-{i:02d}" for i in range(NODES)]
+
+    latencies = []
+    bound = 0
+    for i in range(NODES * PODS_PER_NODE):
+        doc = make_pod(f"infer-{i:03d}", hbm=POD_HBM)
+        pod = api.create_pod(doc)
+        t0 = time.perf_counter()
+        status, result = post(base, "/tpushare-scheduler/filter",
+                              {"Pod": pod.raw, "NodeNames": node_names})
+        assert status == 200, result
+        candidates = result["NodeNames"]
+        assert candidates, f"pod {i} found no node: {result['FailedNodes']}"
+        status, bind_result = post(base, "/tpushare-scheduler/bind", {
+            "PodName": pod.name, "PodNamespace": pod.namespace,
+            "PodUID": pod.uid, "Node": candidates[0]})
+        latencies.append((time.perf_counter() - t0) * 1000.0)
+        assert status == 200, bind_result
+        bound += 1
+
+    # Utilization from the inspect API (the operator's view).
+    with urllib.request.urlopen(f"{base}/tpushare-scheduler/inspect") as r:
+        doc = json.loads(r.read())
+    used = sum(n["usedHBM"] for n in doc["nodes"])
+    total = sum(n["totalHBM"] for n in doc["nodes"])
+    util = 100.0 * used / total
+
+    server.shutdown()
+    controller.stop()
+
+    latencies.sort()
+    p50 = statistics.median(latencies)
+    p99 = latencies[int(len(latencies) * 0.99) - 1]
+    print(json.dumps({
+        "metric": "hbm_binpack_utilization",
+        "value": round(util, 2),
+        "unit": "%",
+        "vs_baseline": round(util / TARGET_UTIL, 4),
+        "p50_filter_bind_ms": round(p50, 3),
+        "p99_filter_bind_ms": round(p99, 3),
+        "pods_bound": bound,
+        "nodes": NODES,
+    }))
+
+
+if __name__ == "__main__":
+    main()

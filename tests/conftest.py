@@ -1,0 +1,38 @@
+"""Test fixtures.
+
+JAX-facing tests run on a virtual 8-device CPU mesh (multi-chip hardware
+is not available in CI), so the env must be set before jax is imported
+anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+# The runtime image may pre-register an accelerator platform (e.g. a
+# tunneled TPU) via sitecustomize and force it into jax_platforms; pin
+# the config itself so tests always run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+from tpushare.k8s.builders import make_node, make_pod  # re-export for tests
+from tpushare.k8s.fake import FakeApiServer
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+@pytest.fixture
+def v5e_node(api):
+    """One v5e host: 4 chips x 16 GiB, 2x2 mesh."""
+    return api.create_node(make_node("v5e-node-0"))

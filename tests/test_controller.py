@@ -1,0 +1,119 @@
+"""Sync-controller tests: informer events reconcile the ledger
+(SURVEY.md §3.4 watch-loop behavior, against the fake apiserver)."""
+
+import time
+
+from tests.conftest import make_node, make_pod
+from tpushare.api.objects import Pod
+from tpushare.controller.controller import Controller
+from tpushare.k8s.workqueue import RateLimitedQueue
+from tpushare.utils import pod as podutils
+
+
+class TestWorkqueue:
+    def test_fifo_and_dedup(self):
+        q = RateLimitedQueue()
+        q.add("a"); q.add("b"); q.add("a")
+        assert q.get() == "a"
+        assert q.get() == "b"
+        q.done("a"); q.done("b")
+        assert q.get(timeout=0.05) is None
+
+    def test_requeue_while_processing(self):
+        """A key re-added mid-processing runs again after done() — the
+        guarantee that makes concurrent workers safe."""
+        q = RateLimitedQueue()
+        q.add("a")
+        key = q.get()
+        q.add("a")  # event while in flight
+        assert q.get(timeout=0.05) is None  # not handed out twice
+        q.done(key)
+        assert q.get(timeout=0.5) == "a"
+
+    def test_rate_limited_backoff(self):
+        q = RateLimitedQueue(base_delay=0.01)
+        q.add_rate_limited("a")
+        start = time.monotonic()
+        assert q.get(timeout=1.0) == "a"
+        assert time.monotonic() - start >= 0.005
+
+    def test_shutdown_unblocks(self):
+        q = RateLimitedQueue()
+        q.shut_down()
+        assert q.get() is None
+
+
+def start_controller(api):
+    c = Controller(api)
+    c.start(workers=2)
+    return c
+
+
+class TestControllerSync:
+    def test_completion_frees_hbm(self, api, v5e_node):
+        c = start_controller(api)
+        try:
+            pod = api.create_pod(make_pod("p", hbm=8, phase="Running"))
+            info = c.cache.get_node_info("v5e-node-0")
+            placed = info.allocate(api, pod)
+            c.cache.add_or_update_pod(placed)
+            assert info.get_available_hbm()[0] == 8
+
+            api.update_pod_status("default", "p", "Succeeded")
+            assert c.wait_idle()
+            time.sleep(0.05)
+            assert not c.cache.known_pod(placed.uid)
+            assert c.cache.get_node_info("v5e-node-0") \
+                    .get_available_hbm()[0] == 16
+        finally:
+            c.stop()
+
+    def test_delete_frees_hbm_via_stash(self, api, v5e_node):
+        """Deleted pods are reconciled from the stashed copy (reference
+        removePodCache, controller.go:59,185-189)."""
+        c = start_controller(api)
+        try:
+            pod = api.create_pod(make_pod("p", hbm=8, phase="Running"))
+            info = c.cache.get_node_info("v5e-node-0")
+            placed = info.allocate(api, pod)
+            c.cache.add_or_update_pod(placed)
+
+            api.delete_pod("default", "p")
+            assert c.wait_idle()
+            time.sleep(0.05)
+            assert not c.cache.known_pod(placed.uid)
+            assert c.cache.get_node_info("v5e-node-0") \
+                    .get_available_hbm()[0] == 16
+        finally:
+            c.stop()
+
+    def test_externally_annotated_pod_adopted(self, api, v5e_node):
+        """A pod that appears already annotated+scheduled (e.g. another
+        extender replica bound it) is adopted into the ledger."""
+        c = start_controller(api)
+        try:
+            pod = Pod(make_pod("adopted", hbm=8, phase="Running"))
+            pod = podutils.updated_pod_annotation_spec(pod, [1], 8, 16)
+            pod.raw["spec"]["nodeName"] = "v5e-node-0"
+            api.create_pod(pod.raw)
+            assert c.wait_idle()
+            time.sleep(0.05)
+            info = c.cache.get_node_info("v5e-node-0")
+            assert info.get_available_hbm()[1] == 8
+        finally:
+            c.stop()
+
+    def test_build_on_start(self, api, v5e_node):
+        """Controller.start() rebuilds the ledger from annotations before
+        serving (crash-restart path, reference cmd/main.go:108)."""
+        pod = Pod(make_pod("pre", hbm=12, phase="Running"))
+        pod = podutils.updated_pod_annotation_spec(pod, [2], 12, 16)
+        pod.raw["spec"]["nodeName"] = "v5e-node-0"
+        api.create_pod(pod.raw)
+
+        c = start_controller(api)
+        try:
+            info = c.cache.get_node_info("v5e-node-0")
+            assert info.get_available_hbm()[2] == 4
+        finally:
+            c.stop()

@@ -1,0 +1,166 @@
+"""Gang planner lifecycle tests: partial commit failure, idempotent
+retry of committed members, adoption, and relist resync of the informer
+(regression coverage for the reserve/commit/expire protocol)."""
+
+import time
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tpushare.api.objects import Pod
+from tpushare.cache.cache import SchedulerCache
+from tpushare.gang.planner import GangPending, GangPlanner
+from tpushare.k8s.errors import ApiError
+from tpushare.k8s.informer import InformerHub
+from tpushare.utils import const
+from tpushare.utils import pod as podutils
+
+ANN = {const.ANN_POD_GROUP: "train", const.ANN_POD_GROUP_MIN: "2"}
+
+
+def make_cluster(api, hosts=2):
+    for i in range(hosts):
+        api.create_node(make_node(f"host-{i}", chips=4, hbm_per_chip=95,
+                                  topology="2x2x1", tpu_type="v5p"))
+    cache = SchedulerCache(api.get_node, api.list_pods)
+    return cache
+
+
+class FlakyBindClient:
+    """Wraps the fake apiserver, failing bind_pod for chosen pods once."""
+
+    def __init__(self, api, fail_names):
+        self._api = api
+        self.fail_names = set(fail_names)
+
+    def __getattr__(self, name):
+        return getattr(self._api, name)
+
+    def bind_pod(self, binding):
+        name = binding["metadata"]["name"]
+        if name in self.fail_names:
+            self.fail_names.discard(name)
+            raise ApiError(503, reason="transient apiserver hiccup")
+        return self._api.bind_pod(binding)
+
+
+class TestCommitFailures:
+    def test_partial_commit_failure_is_surfaced_and_retried(self, api):
+        """A member whose binding POST fails at commit stays tracked; the
+        housekeeping retry binds it — no silent HBM leak."""
+        cache = make_cluster(api)
+        client = FlakyBindClient(api, fail_names={"w0"})
+        planner = GangPlanner(cache, client, ttl=60)
+
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+
+        p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
+        # quorum: commit runs; w0's bind fails transiently -> surfaced
+        with pytest.raises(ApiError):
+            planner.bind_member(p1, "host-1")
+        assert api.get_pod("default", "w1").node_name == "host-1"
+        assert api.get_pod("default", "w0").node_name == ""
+        assert planner.stats()["default/train"]["committed"]
+
+        # housekeeping retry drains the unbound member
+        assert planner.retry_unbound() == 1
+        assert api.get_pod("default", "w0").node_name == "host-0"
+        assert planner.stats() == {}  # fully bound -> forgotten
+
+    def test_committed_member_retry_is_idempotent(self, api):
+        """Scheduler retries a member after its group committed: no
+        re-allocation, no double-count, immediate success."""
+        cache = make_cluster(api)
+        planner = GangPlanner(cache, api, ttl=60)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
+        planner.bind_member(p1, "host-1")  # commits both
+
+        fresh = api.get_pod("default", "w0")
+        assert fresh.node_name == "host-0"
+        chips_before = podutils.get_chip_ids_from_annotation(fresh)
+        planner.bind_member(fresh, "host-1")  # retry with a DIFFERENT node
+        after = api.get_pod("default", "w0")
+        assert after.node_name == "host-0"  # unchanged
+        assert podutils.get_chip_ids_from_annotation(after) == chips_before
+        # ledger: host-1 only holds w1's chips, nothing phantom
+        assert len(cache.get_node_info("host-1").get_free_chips()) == 0
+        assert len(cache.get_node_info("host-0").get_free_chips()) == 0
+
+    def test_expiry_never_rolls_back_committed_groups(self, api):
+        cache = make_cluster(api)
+        client = FlakyBindClient(api, fail_names={"w0", "w0"})
+        planner = GangPlanner(cache, client, ttl=0.01)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
+        with pytest.raises(ApiError):
+            planner.bind_member(p1, "host-1")
+        time.sleep(0.02)
+        assert planner.expire_stale() == 0  # committed: not rolled back
+        planner.retry_unbound()
+        assert api.get_pod("default", "w0").node_name == "host-0"
+
+    def test_housekeeping_thread_expires(self, api):
+        cache = make_cluster(api, hosts=1)
+        planner = GangPlanner(cache, api, ttl=0.05,
+                              housekeeping_interval=0.02)
+        planner.start()
+        try:
+            p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+            with pytest.raises(GangPending):
+                planner.bind_member(p0, "host-0")
+            assert len(cache.get_node_info("host-0").get_free_chips()) == 0
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline:
+                if len(cache.get_node_info("host-0").get_free_chips()) == 4:
+                    break
+                time.sleep(0.02)
+            assert len(cache.get_node_info("host-0").get_free_chips()) == 4
+        finally:
+            planner.stop()
+
+
+class TestTopologyMismatch:
+    def test_extra_chip_capacities_fall_back_to_flat(self, api):
+        """chip-hbm advertises more chips than the topology covers: the
+        allocator must degrade gracefully, not IndexError."""
+        doc = make_node("odd", chip_hbm=[95] * 5, topology="2x2x1",
+                        tpu_type="v5p")
+        api.create_node(doc)
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        info = cache.get_node_info("odd")
+        assert info.topology.chip_count == 5  # flat fallback
+        pod = api.create_pod(make_pod("p", hbm=44))
+        placed = info.allocate(api, pod)
+        assert podutils.get_chip_ids_from_annotation(placed) != []
+
+
+class TestRelistResync:
+    def test_relist_synthesizes_missed_delete(self, api, v5e_node):
+        """A pod deleted while the watch was down is reconciled when the
+        reconnect LIST is replayed into the stream."""
+        deleted, added = [], []
+        hub = InformerHub(api)
+        hub.add_pod_handler(on_add=lambda p: added.append(p.name),
+                            on_delete=lambda p: deleted.append(p.name))
+        hub.start()
+        api.create_pod(make_pod("ghost", hbm=4))
+        time.sleep(0.05)
+        assert added == ["ghost"]
+
+        # Simulate a watch gap: the pod vanished; replay a fresh LIST that
+        # no longer contains it (plus a brand-new pod).
+        hub._watch_queue.put(("Pod", "RELIST",
+                              [make_pod("newcomer", hbm=4)]))
+        time.sleep(0.05)
+        hub.stop()
+        assert deleted == ["ghost"]
+        assert "newcomer" in added
+        assert hub.get_pod("default", "ghost") is None
+        assert hub.get_pod("default", "newcomer") is not None

@@ -1,0 +1,196 @@
+"""Handler and HTTP wire-protocol tests (golden JSON request/response,
+SURVEY.md §4 test plan)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tpushare.api.extender import ExtenderArgs, ExtenderBindingArgs
+from tpushare.cache.cache import SchedulerCache
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+from tpushare.scheduler.bind import Bind
+from tpushare.scheduler.inspect import Inspect
+from tpushare.scheduler.predicate import Predicate
+from tpushare.utils import const
+
+
+def build_stack(api: FakeApiServer):
+    cache = SchedulerCache(api.get_node, api.list_pods)
+    return (cache, Predicate(cache), Bind(cache, api),
+            Inspect(cache, api.list_nodes))
+
+
+class TestPredicateHandler:
+    def test_filter_node_names_form(self, api, v5e_node):
+        api.create_node(make_node("cpu-only", chips=0, hbm_per_chip=0,
+                                  topology="1"))
+        _, pred, _, _ = build_stack(api)
+        args = ExtenderArgs.from_json({
+            "Pod": make_pod("p", hbm=8),
+            "NodeNames": ["v5e-node-0", "cpu-only", "ghost"],
+        })
+        result = pred.handle(args)
+        assert result.node_names == ["v5e-node-0"]
+        assert set(result.failed_nodes) == {"cpu-only", "ghost"}
+
+    def test_filter_full_nodes_form(self, api, v5e_node):
+        """nodeCacheCapable:false sends full Node objects — the form the
+        reference nil-derefed on (defect 8)."""
+        _, pred, _, _ = build_stack(api)
+        args = ExtenderArgs.from_json({
+            "Pod": make_pod("p", hbm=8),
+            "Nodes": {"items": [v5e_node.raw]},
+        })
+        result = pred.handle(args)
+        assert result.node_names is None
+        assert [n.name for n in result.nodes] == ["v5e-node-0"]
+
+    def test_non_tpu_pod_passes_through(self, api, v5e_node):
+        _, pred, _, _ = build_stack(api)
+        args = ExtenderArgs.from_json({
+            "Pod": make_pod("plain"), "NodeNames": ["v5e-node-0", "other"]})
+        result = pred.handle(args)
+        assert result.node_names == ["v5e-node-0", "other"]
+        assert result.failed_nodes == {}
+
+
+class TestBindHandler:
+    def test_bind_success(self, api, v5e_node):
+        cache, _, binder, _ = build_stack(api)
+        api.create_pod(make_pod("p", hbm=8, uid="u1"))
+        result = binder.handle(ExtenderBindingArgs(
+            pod_name="p", pod_namespace="default", pod_uid="u1",
+            node="v5e-node-0"))
+        assert result.error == ""
+        stored = api.get_pod("default", "p")
+        assert stored.node_name == "v5e-node-0"
+        assert cache.known_pod(stored.uid)
+
+    def test_bind_no_fit(self, api, v5e_node):
+        _, _, binder, _ = build_stack(api)
+        api.create_pod(make_pod("p", hbm=99, uid="u1"))
+        result = binder.handle(ExtenderBindingArgs(
+            pod_name="p", pod_namespace="default", pod_uid="u1",
+            node="v5e-node-0"))
+        assert "no chip" in result.error
+
+    def test_bind_unknown_pod(self, api, v5e_node):
+        _, _, binder, _ = build_stack(api)
+        result = binder.handle(ExtenderBindingArgs(
+            pod_name="ghost", pod_namespace="default", pod_uid="x",
+            node="v5e-node-0"))
+        assert "not found" in result.error
+
+    def test_bind_unknown_node(self, api):
+        _, _, binder, _ = build_stack(api)
+        api.create_pod(make_pod("p", hbm=8, uid="u1"))
+        result = binder.handle(ExtenderBindingArgs(
+            pod_name="p", pod_namespace="default", pod_uid="u1",
+            node="ghost"))
+        assert "unknown node" in result.error
+
+
+class TestInspectHandler:
+    def test_inspect_packing(self, api, v5e_node):
+        cache, _, binder, inspect = build_stack(api)
+        for i, hbm in enumerate([8, 8, 12]):
+            api.create_pod(make_pod(f"p{i}", hbm=hbm, uid=f"u{i}"))
+            binder.handle(ExtenderBindingArgs(
+                pod_name=f"p{i}", pod_namespace="default", pod_uid=f"u{i}",
+                node="v5e-node-0"))
+            api.update_pod_status("default", f"p{i}", "Running")
+        doc = inspect.handle()
+        assert len(doc["nodes"]) == 1
+        node = doc["nodes"][0]
+        assert node["totalHBM"] == 64
+        assert node["usedHBM"] == 28
+        assert node["tpuType"] == "v5e"
+        chip0 = node["chips"][0]
+        assert chip0["usedHBM"] == 16 and len(chip0["pods"]) == 2
+        assert node["chips"][1]["usedHBM"] == 12
+
+    def test_inspect_unknown_node(self, api):
+        _, _, _, inspect = build_stack(api)
+        assert "error" in inspect.handle("ghost")
+
+
+@pytest.fixture
+def http_stack(api, v5e_node):
+    _, pred, binder, inspect = build_stack(api)
+    server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect)
+    serve_forever(server)
+    port = server.server_address[1]
+    yield api, f"http://127.0.0.1:{port}"
+    server.shutdown()
+
+
+def _post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, resp.read()
+
+
+class TestHTTPGolden:
+    def test_filter_bind_inspect_over_http(self, http_stack):
+        api, base = http_stack
+        api.create_pod(make_pod("p", hbm=8, uid="u1"))
+        status, doc = _post(f"{base}/tpushare-scheduler/filter", {
+            "Pod": make_pod("p", hbm=8),
+            "NodeNames": ["v5e-node-0"],
+        })
+        assert status == 200
+        assert doc["NodeNames"] == ["v5e-node-0"]
+        assert doc["FailedNodes"] == {} and doc["Error"] == ""
+
+        status, doc = _post(f"{base}/tpushare-scheduler/bind", {
+            "PodName": "p", "PodNamespace": "default", "PodUID": "u1",
+            "Node": "v5e-node-0",
+        })
+        assert status == 200 and doc["Error"] == ""
+
+        api.update_pod_status("default", "p", "Running")
+        status, body = _get(f"{base}/tpushare-scheduler/inspect/v5e-node-0")
+        doc = json.loads(body)
+        assert doc["nodes"][0]["usedHBM"] == 8
+
+    def test_bind_failure_returns_500(self, http_stack):
+        api, base = http_stack
+        api.create_pod(make_pod("big", hbm=99, uid="u9"))
+        status, doc = _post(f"{base}/tpushare-scheduler/bind", {
+            "PodName": "big", "PodNamespace": "default", "PodUID": "u9",
+            "Node": "v5e-node-0",
+        })
+        assert status == 500 and doc["Error"]
+
+    def test_malformed_body_400_and_stops(self, http_stack):
+        _, base = http_stack
+        req = urllib.request.Request(
+            f"{base}/tpushare-scheduler/filter", data=b"{not json",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 400
+
+    def test_version_health_metrics(self, http_stack):
+        _, base = http_stack
+        status, body = _get(f"{base}/version")
+        assert status == 200 and json.loads(body)["version"]
+        status, body = _get(f"{base}/healthz")
+        assert body == b"ok"
+        status, body = _get(f"{base}/metrics")
+        assert b"tpushare_filter_latency_seconds" in body
+        status, body = _get(f"{base}/debug/threads")
+        assert b"tpushare-http" in body or b"MainThread" in body

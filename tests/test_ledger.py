@@ -1,0 +1,187 @@
+"""Ledger tests: admission, bin-pack policy, commit path, rebuild.
+
+Covers the behaviors the reference demonstrated only by demo video
+(SURVEY.md §4): three 2-GiB pods packing onto one chip, the "fits node
+total but no single chip" rejection (demo 2), completion freeing HBM,
+and crash-restart rebuild from annotations.
+"""
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tpushare.api.objects import Node, Pod
+from tpushare.cache.cache import SchedulerCache
+from tpushare.cache.nodeinfo import AllocationError, NodeInfo
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.utils import const
+from tpushare.utils import pod as podutils
+
+
+def new_cache(api: FakeApiServer) -> SchedulerCache:
+    return SchedulerCache(api.get_node, api.list_pods)
+
+
+class TestAssume:
+    def test_fits_one_chip(self, api, v5e_node):
+        info = NodeInfo(v5e_node)
+        ok, _ = info.assume(Pod(make_pod("p", hbm=16)))
+        assert ok
+
+    def test_fits_node_not_chip(self, api, v5e_node):
+        """Demo 2: node has 64 GiB total free but no chip has 17."""
+        info = NodeInfo(v5e_node)
+        ok, reason = info.assume(Pod(make_pod("p", hbm=17)))
+        assert not ok
+        assert "HBM in one chip" in reason
+
+    def test_no_tpu_request(self, api, v5e_node):
+        info = NodeInfo(v5e_node)
+        ok, reason = info.assume(Pod(make_pod("p")))
+        assert not ok
+
+    def test_chip_request(self, api, v5e_node):
+        info = NodeInfo(v5e_node)
+        ok, _ = info.assume(Pod(make_pod("p", chips=4)))
+        assert ok
+        ok, reason = info.assume(Pod(make_pod("p", chips=5)))
+        assert not ok
+        assert "free TPU chips" in reason
+
+
+class TestBinpack:
+    def test_tightest_fit(self, api):
+        """Reference policy (nodeinfo.go:226-234): pick the chip with the
+        least free HBM that still fits."""
+        node = api.create_node(make_node("n", chip_hbm=[16, 16, 16, 16]))
+        info = NodeInfo(node)
+        # Occupy chip 2 with 10 GiB -> free = [16, 16, 6, 16]
+        p0 = Pod(make_pod("warm", hbm=10, node_name="n", uid="u0"))
+        p0 = podutils.updated_pod_annotation_spec(p0, [2], 10, 16)
+        info.add_or_update_pod(p0)
+        # A 4-GiB pod must land on chip 2 (tightest fit), not an empty chip.
+        assert info.pick_chips(Pod(make_pod("p", hbm=4))) == [2]
+
+    def test_three_pods_pack_one_chip(self, api, v5e_node):
+        """Demo 1 (samples/1-3.yaml): three 2-GiB pods share chip 0."""
+        client = api
+        info = NodeInfo(v5e_node)
+        for i in range(3):
+            pod = client.create_pod(make_pod(f"binpack-{i}", hbm=2))
+            placed = info.allocate(client, pod)
+            assert podutils.get_chip_ids_from_annotation(placed) == [0]
+        assert info.get_available_hbm()[0] == 10
+
+    def test_heterogeneous_chips(self, api):
+        node = api.create_node(make_node("n", chip_hbm=[16, 32, 16, 32]))
+        info = NodeInfo(node)
+        pod = api.create_pod(make_pod("big", hbm=20))
+        placed = info.allocate(api, pod)
+        assert podutils.get_chip_ids_from_annotation(placed)[0] in (1, 3)
+
+    def test_whole_chip_compact(self, api):
+        node = api.create_node(make_node("n", chips=8, hbm_per_chip=16,
+                                         topology="2x4"))
+        info = NodeInfo(node)
+        pod = api.create_pod(make_pod("pair", chips=2))
+        placed = info.allocate(api, pod)
+        ids = podutils.get_chip_ids_from_annotation(placed)
+        assert len(ids) == 2
+        assert info.topology.distance(ids[0], ids[1]) == 1  # ICI-adjacent
+        # both chips now fully pinned
+        avail = info.get_available_hbm()
+        assert avail[ids[0]] == 0 and avail[ids[1]] == 0
+
+    def test_no_fit_raises(self, api, v5e_node):
+        info = NodeInfo(v5e_node)
+        with pytest.raises(AllocationError):
+            info.pick_chips(Pod(make_pod("p", hbm=99)))
+
+    def test_tie_break_keeps_holes_whole(self, api):
+        """Among equally-tight fits, prefer the chip with fewer free ICI
+        neighbors so contiguous free regions survive."""
+        node = api.create_node(make_node("n", chips=8, hbm_per_chip=16,
+                                         topology="2x4"))
+        info = NodeInfo(node)
+        # Pin chip 0 partially: free(0)=8; all others 16.
+        seed = Pod(make_pod("seed", hbm=8, node_name="n", uid="s"))
+        seed = podutils.updated_pod_annotation_spec(seed, [0], 8, 16)
+        info.add_or_update_pod(seed)
+        # 8-GiB pod: chip 0 is tightest (8 free) -> still chosen.
+        assert info.pick_chips(Pod(make_pod("p", hbm=8))) == [0]
+
+
+class TestAllocateCommit:
+    def test_annotations_persisted_and_bound(self, api, v5e_node):
+        info = NodeInfo(v5e_node)
+        pod = api.create_pod(make_pod("p", hbm=8))
+        info.allocate(api, pod)
+        stored = api.get_pod("default", "p")
+        assert stored.node_name == "v5e-node-0"
+        assert podutils.get_hbm_from_pod_annotation(stored) == 8
+        assert stored.annotations[const.ANN_ASSIGNED] == "false"
+        assert podutils.get_assume_time(stored) > 0
+
+    def test_conflict_retry(self, api, v5e_node):
+        """A stale resourceVersion triggers one refetch+retry (typed 409,
+        reference nodeinfo.go:150-168)."""
+        info = NodeInfo(v5e_node)
+        pod = api.create_pod(make_pod("p", hbm=8))
+        # Make the extender's copy stale: someone updates the pod after us.
+        api.update_pod(api.get_pod("default", "p"))
+        info.allocate(api, pod)  # must succeed via retry
+        assert api.get_pod("default", "p").node_name == "v5e-node-0"
+
+    def test_completion_frees_hbm(self, api, v5e_node):
+        info = NodeInfo(v5e_node)
+        pod = api.create_pod(make_pod("p", hbm=16))
+        placed = info.allocate(api, pod)
+        assert info.get_available_hbm()[0] == 0
+        done = Pod(placed.raw)
+        done.raw["status"] = {"phase": "Succeeded"}
+        # used-HBM accounting ignores complete pods even before removal
+        assert info.get_available_hbm()[0] == 16
+        info.remove_pod(done)
+        assert info.get_available_hbm()[0] == 16
+
+
+class TestSchedulerCache:
+    def test_lazy_node_build(self, api, v5e_node):
+        cache = new_cache(api)
+        info = cache.get_node_info("v5e-node-0")
+        assert info is not None and info.chip_count == 4
+        assert cache.get_node_info("missing") is None
+
+    def test_rebuild_from_annotations(self, api, v5e_node):
+        """Crash-restart: a fresh cache reconstructs the ledger purely from
+        pod annotations (reference cache.go:49-74)."""
+        cache = new_cache(api)
+        pod = api.create_pod(make_pod("p", hbm=8, phase="Running"))
+        info = cache.get_node_info("v5e-node-0")
+        placed = info.allocate(api, pod)
+        cache.add_or_update_pod(placed)
+
+        api.update_pod_status("default", "p", "Running")
+        cache2 = new_cache(api)
+        assert cache2.build() == 1
+        info2 = cache2.get_node_info("v5e-node-0")
+        assert info2.get_available_hbm()[0] == 8
+        assert cache2.known_pod(placed.uid)
+
+    def test_capacity_change_rebuilds_ledger(self, api):
+        node = api.create_node(make_node("grow", chips=2, hbm_per_chip=16,
+                                         topology="2x1"))
+        cache = new_cache(api)
+        assert cache.get_node_info("grow").chip_count == 2
+        api.update_node(Node(make_node("grow", chips=4, hbm_per_chip=16)))
+        assert cache.get_node_info("grow").chip_count == 4
+
+    def test_remove_pod(self, api, v5e_node):
+        cache = new_cache(api)
+        pod = api.create_pod(make_pod("p", hbm=8, phase="Running"))
+        info = cache.get_node_info("v5e-node-0")
+        placed = info.allocate(api, pod)
+        cache.add_or_update_pod(placed)
+        assert cache.known_pod(placed.uid)
+        cache.remove_pod(placed)
+        assert not cache.known_pod(placed.uid)
+        assert cache.get_node_info("v5e-node-0").get_available_hbm()[0] == 16

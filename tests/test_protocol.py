@@ -1,0 +1,133 @@
+"""Unit tests for the convention layer: quantities, object model, pod/node
+helpers, annotation round-trips (SURVEY.md §4 test-pyramid base)."""
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tpushare.api.objects import Node, Pod, parse_quantity
+from tpushare.utils import const
+from tpushare.utils import node as nodeutils
+from tpushare.utils import pod as podutils
+
+
+class TestQuantity:
+    @pytest.mark.parametrize("raw,expected", [
+        ("2", 2),
+        (2, 2),
+        ("16Gi", 16 * 2**30),
+        ("100M", 100 * 10**6),
+        ("1.5Ki", 1536),
+        ("500m", 0),
+        ("0", 0),
+    ])
+    def test_parse(self, raw, expected):
+        assert parse_quantity(raw) == expected
+
+    @pytest.mark.parametrize("raw", ["", "abc", "1Q", "--3"])
+    def test_invalid(self, raw):
+        with pytest.raises(ValueError):
+            parse_quantity(raw)
+
+
+class TestPodClassifiers:
+    def test_sharing_pod(self):
+        assert podutils.is_tpu_sharing_pod(Pod(make_pod("p", hbm=2)))
+        assert not podutils.is_tpu_sharing_pod(Pod(make_pod("p")))
+
+    def test_chip_pod(self):
+        assert podutils.is_tpu_chip_pod(Pod(make_pod("p", chips=2)))
+        assert not podutils.is_tpu_chip_pod(Pod(make_pod("p", hbm=2)))
+
+    def test_complete_pod_phases(self):
+        assert podutils.is_complete_pod(Pod(make_pod("p", phase="Succeeded")))
+        assert podutils.is_complete_pod(Pod(make_pod("p", phase="Failed")))
+        assert not podutils.is_complete_pod(Pod(make_pod("p", phase="Running")))
+
+    def test_deletion_timestamp_is_complete(self):
+        doc = make_pod("p", phase="Running")
+        doc["metadata"]["deletionTimestamp"] = "2026-07-29T00:00:00Z"
+        assert podutils.is_complete_pod(Pod(doc))
+        # ...and frees its HBM (fix of reference defect 6, deviceinfo.go:46)
+        doc["metadata"]["annotations"] = {const.ANN_HBM_POD: "8",
+                                          const.ANN_CHIP_IDX: "0"}
+        assert podutils.pod_used_hbm(Pod(doc)) == 0
+
+    def test_assigned_non_terminated(self):
+        assert podutils.is_assigned_non_terminated(
+            Pod(make_pod("p", node_name="n", phase="Running")))
+        assert not podutils.is_assigned_non_terminated(
+            Pod(make_pod("p", phase="Running")))  # unscheduled
+
+
+class TestAnnotations:
+    def test_round_trip(self):
+        pod = Pod(make_pod("p", hbm=8))
+        new = podutils.updated_pod_annotation_spec(pod, [1], 8, 16,
+                                                   assume_time_ns=12345)
+        assert podutils.get_chip_ids_from_annotation(new) == [1]
+        assert podutils.get_chip_id_from_annotation(new) == 1
+        assert podutils.get_hbm_from_pod_annotation(new) == 8
+        assert podutils.get_assume_time(new) == 12345
+        assert podutils.is_assumed(new)
+        assert not podutils.is_assigned(new)
+        assert new.annotations[const.ANN_ASSIGNED] == "false"
+        # source pod untouched (deep copy, reference pod.go:193)
+        assert not podutils.is_assumed(pod)
+
+    def test_multi_chip_annotation(self):
+        pod = Pod(make_pod("p", chips=2))
+        new = podutils.updated_pod_annotation_spec(pod, [0, 2], 32, 16)
+        assert podutils.get_chip_ids_from_annotation(new) == [0, 2]
+
+    def test_malformed_annotations(self):
+        pod = Pod(make_pod("p", annotations={
+            const.ANN_CHIP_IDX: "zero", const.ANN_HBM_POD: "NaN",
+            const.ANN_ASSUME_TIME: "never"}))
+        assert podutils.get_chip_ids_from_annotation(pod) == []
+        assert podutils.get_chip_id_from_annotation(pod) == const.NO_CHIP
+        assert podutils.get_hbm_from_pod_annotation(pod) == 0
+        assert podutils.get_assume_time(pod) == 0
+
+    def test_pod_group(self):
+        pod = Pod(make_pod("p", annotations={const.ANN_POD_GROUP: "g1",
+                                             const.ANN_POD_GROUP_MIN: "4"}))
+        assert podutils.get_pod_group(pod) == ("g1", 4)
+        assert podutils.get_pod_group(Pod(make_pod("p"))) == ("", 0)
+
+
+class TestNodeHelpers:
+    def test_sharing_node(self):
+        node = Node(make_node("n", chips=4, hbm_per_chip=16))
+        assert nodeutils.is_tpu_sharing_node(node)
+        assert nodeutils.get_total_hbm(node) == 64
+        assert nodeutils.get_chip_count(node) == 4
+        assert nodeutils.get_chip_capacities(node) == [16, 16, 16, 16]
+        assert nodeutils.get_topology(node) == "2x2x1"
+        assert nodeutils.get_tpu_type(node) == "v5e"
+
+    def test_heterogeneous_chips(self):
+        node = Node(make_node("n", chip_hbm=[16, 16, 32, 32]))
+        assert nodeutils.get_chip_capacities(node) == [16, 16, 32, 32]
+        assert nodeutils.get_total_hbm(node) == 96
+
+    def test_equal_split_fallback(self):
+        doc = make_node("n", chips=4, hbm_per_chip=16)
+        del doc["metadata"]["annotations"][const.ANN_NODE_CHIP_HBM]
+        assert nodeutils.get_chip_capacities(Node(doc)) == [16, 16, 16, 16]
+
+    def test_non_tpu_node(self):
+        node = Node({"metadata": {"name": "cpu-node"}, "status": {}})
+        assert not nodeutils.is_tpu_sharing_node(node)
+        assert nodeutils.get_chip_capacities(node) == []
+
+    def test_gke_label_fallback(self):
+        node = Node({
+            "metadata": {"name": "gke", "labels": {
+                const.GKE_TPU_TOPOLOGY_LABEL: "2x4",
+                const.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+            }},
+            "status": {"capacity": {const.HBM_RESOURCE: "128",
+                                    const.CHIP_RESOURCE: "8"}},
+        })
+        assert nodeutils.get_topology(node) == "2x4"
+        assert nodeutils.get_tpu_type(node) == "v5e"

@@ -1,0 +1,80 @@
+"""Topology model tests: coordinates, ICI distance, compact selection."""
+
+import pytest
+
+from tpushare.topology.topology import Topology, parse_topology
+
+
+class TestParse:
+    def test_parse(self):
+        assert parse_topology("2x2x1") == (2, 2, 1)
+        assert parse_topology("2x4") == (2, 4)
+
+    @pytest.mark.parametrize("bad", ["", "0x2", "2x-1", "axb"])
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            parse_topology(bad)
+
+
+class TestMesh:
+    def test_coords_round_trip(self):
+        t = Topology.from_spec("2x4")
+        for i in range(t.chip_count):
+            assert t.index(t.coords(i)) == i
+
+    def test_distance_mesh(self):
+        t = Topology.from_spec("2x4")  # mesh, no wrap
+        # chips: (0,0)=0 (0,1)=1 (0,2)=2 (0,3)=3 (1,0)=4 ...
+        assert t.distance(0, 1) == 1
+        assert t.distance(0, 3) == 3
+        assert t.distance(0, 7) == 4
+
+    def test_torus_wraps(self):
+        t = Topology.from_spec("4x4x4", tpu_type="v5p")
+        assert t.torus
+        # (0,0,0) to (3,0,0): 1 hop over the wraparound link
+        assert t.distance(0, t.index((3, 0, 0))) == 1
+
+    def test_host_block_is_mesh(self):
+        t = Topology.from_spec("2x2x1", tpu_type="v5p")
+        assert not t.torus
+
+    def test_neighbors(self):
+        t = Topology.from_spec("2x2")
+        assert sorted(t.neighbors(0)) == [1, 2]
+        assert sorted(t.neighbors(3)) == [1, 2]
+
+    def test_flat(self):
+        t = Topology.flat(4)
+        assert t.chip_count == 4
+        assert t.distance(0, 3) == 3
+
+
+class TestCompactSelection:
+    def test_pairs_are_adjacent(self):
+        t = Topology.from_spec("2x2")
+        # all four free: any adjacent pair has dispersion 1
+        chosen = t.select_compact([0, 1, 2, 3], 2)
+        assert t.dispersion(chosen) == 1
+
+    def test_avoids_diagonal(self):
+        t = Topology.from_spec("2x2")
+        # free = {0, 3} (diagonal) plus {1}: best pair is an edge
+        chosen = t.select_compact([0, 1, 3], 2)
+        assert t.dispersion(chosen) == 1
+
+    def test_insufficient(self):
+        t = Topology.from_spec("2x2")
+        assert t.select_compact([0], 2) is None
+        assert t.select_compact([], 1) is None
+
+    def test_full_host(self):
+        t = Topology.from_spec("2x4")
+        chosen = t.select_compact(list(range(8)), 4)
+        # a 2x2 block has dispersion 1+1+2+1+2+1 = 8; no 4-set does better
+        assert t.dispersion(chosen) <= 8
+
+    def test_free_neighbor_count(self):
+        t = Topology.from_spec("2x2")
+        assert t.free_neighbor_count(0, {1, 2, 3}) == 2
+        assert t.free_neighbor_count(0, {3}) == 0

@@ -1,0 +1,95 @@
+"""Run the full extender stack against a simulated TPU fleet.
+
+Development/demo harness (counterpart of the reference's demo flow,
+README.md:61-69, without needing a real cluster): a fake apiserver is
+populated with TPU nodes, the real controller + HTTP extender serve on
+``PORT``, and a tiny scheduler loop binds any pod you create through the
+HTTP API — so you can drive filter/bind/inspect with curl.
+
+    python tools/demo_cluster.py [--port 39999] [--nodes 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+
+from tpushare.cmd.main import build_stack
+from tpushare.k8s.builders import make_node, make_pod
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=39999)
+    ap.add_argument("--nodes", type=int, default=2)
+    ap.add_argument("--chips", type=int, default=4)
+    ap.add_argument("--hbm", type=int, default=16)
+    ap.add_argument("--tpu-type", default="v5e")
+    ap.add_argument("--topology", default="2x2x1")
+    args = ap.parse_args()
+
+    api = FakeApiServer()
+    for i in range(args.nodes):
+        api.create_node(make_node(
+            f"{args.tpu_type}-{i}", chips=args.chips, hbm_per_chip=args.hbm,
+            topology=args.topology, tpu_type=args.tpu_type))
+
+    controller, pred, binder, inspect = build_stack(api)
+    controller.start(workers=2)
+    server = ExtenderHTTPServer(("127.0.0.1", args.port), pred, binder,
+                                inspect)
+    serve_forever(server)
+    print(f"extender listening on http://127.0.0.1:{args.port} with "
+          f"{args.nodes} simulated {args.tpu_type} nodes "
+          f"({args.chips} chips x {args.hbm} GiB)", flush=True)
+    print("create pods on stdin: NAME HBM_GIB  (e.g. 'demo1 8'); they are "
+          "created in the fake apiserver and scheduled via the HTTP API",
+          flush=True)
+
+    import urllib.request
+
+    def schedule(name: str, hbm: int) -> None:
+        pod = api.create_pod(make_pod(name, hbm=hbm))
+        names = [n.name for n in api.list_nodes()]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{args.port}/tpushare-scheduler/filter",
+            data=json.dumps({"Pod": pod.raw, "NodeNames": names}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            result = json.loads(resp.read())
+        if not result["NodeNames"]:
+            print(f"pod {name}: unschedulable: {result['FailedNodes']}",
+                  flush=True)
+            return
+        target = result["NodeNames"][0]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{args.port}/tpushare-scheduler/bind",
+            data=json.dumps({"PodName": name, "PodNamespace": "default",
+                             "PodUID": pod.uid, "Node": target}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                json.loads(resp.read())
+            print(f"pod {name}: bound to {target}", flush=True)
+        except urllib.error.HTTPError as e:
+            print(f"pod {name}: bind failed: {e.read().decode()}", flush=True)
+
+    try:
+        for line in sys.stdin:
+            parts = line.split()
+            if len(parts) == 2 and parts[1].isdigit():
+                schedule(parts[0], int(parts[1]))
+            elif parts:
+                print(f"usage: NAME HBM_GIB (got {line!r})", flush=True)
+    except KeyboardInterrupt:
+        pass
+    server.shutdown()
+    controller.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""tpushare — a TPU-native Kubernetes share-scheduling framework.
+
+Makes TPU HBM a fine-grained, bin-packable extended resource so multiple
+JAX/XLA pods can share the chips of one TPU node. The system is a
+scheduler-extender webhook (filter/bind/inspect over HTTP) backed by a
+per-chip HBM ledger that is rebuilt from pod annotations on restart, a
+device plugin that discovers chips via libtpu / /dev/accel*, a topology
+layer for ICI-aware packing, and a gang scheduler for multi-host slices.
+
+Capability reference: bnulwh/gpushare-scheduler-extender (Go), surveyed in
+SURVEY.md. This is a ground-up TPU-first redesign, not a port: the GPU
+per-device memory ledger becomes per-chip HBM accounting with topology
+coordinates, and the workload contract injects XLA/TPU environment
+variables instead of CUDA memory fractions.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,96 @@
+"""Scheduler-extender wire types.
+
+JSON-compatible dataclasses for the kube-scheduler ↔ extender webhook
+protocol (counterpart of the vendored
+``k8s.io/kubernetes/pkg/scheduler/api/types.go:258-302`` used by the
+reference). Field names follow the JSON casing the scheduler sends.
+
+Unlike the reference — which dereferences ``args.NodeNames``
+unconditionally and nil-derefs when the scheduler is configured with
+``nodeCacheCapable:false`` (``predicate.go:17``, SURVEY.md §2 defect 8) —
+both the node-name and the full-node forms are supported here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpushare.api.objects import Node, Pod
+
+
+@dataclass
+class ExtenderArgs:
+    """Arguments of ``POST .../filter``."""
+
+    pod: Pod
+    node_names: list[str] | None = None
+    nodes: list[Node] | None = None
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "ExtenderArgs":
+        pod = Pod(doc.get("Pod") or doc.get("pod") or {})
+        node_names = doc.get("NodeNames", doc.get("nodenames"))
+        nodes_doc = doc.get("Nodes", doc.get("nodes"))
+        nodes = None
+        if nodes_doc and nodes_doc.get("items") is not None:
+            nodes = [Node(n) for n in nodes_doc["items"]]
+        return cls(pod=pod, node_names=node_names, nodes=nodes)
+
+    def candidate_names(self) -> list[str]:
+        if self.node_names is not None:
+            return list(self.node_names)
+        if self.nodes is not None:
+            return [n.name for n in self.nodes]
+        return []
+
+
+@dataclass
+class ExtenderFilterResult:
+    """Result of ``POST .../filter``."""
+
+    node_names: list[str] | None = None
+    nodes: list[Node] | None = None
+    failed_nodes: dict[str, str] = field(default_factory=dict)
+    error: str = ""
+
+    def to_json(self) -> dict:
+        doc: dict = {"FailedNodes": self.failed_nodes, "Error": self.error}
+        doc["NodeNames"] = self.node_names
+        if self.nodes is not None:
+            doc["Nodes"] = {
+                "apiVersion": "v1",
+                "kind": "NodeList",
+                "items": [n.raw for n in self.nodes],
+            }
+        else:
+            doc["Nodes"] = None
+        return doc
+
+
+@dataclass
+class ExtenderBindingArgs:
+    """Arguments of ``POST .../bind``."""
+
+    pod_name: str
+    pod_namespace: str
+    pod_uid: str
+    node: str
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "ExtenderBindingArgs":
+        return cls(
+            pod_name=doc.get("PodName", ""),
+            pod_namespace=doc.get("PodNamespace", ""),
+            pod_uid=doc.get("PodUID", ""),
+            node=doc.get("Node", ""),
+        )
+
+
+@dataclass
+class ExtenderBindingResult:
+    """Result of ``POST .../bind``."""
+
+    error: str = ""
+
+    def to_json(self) -> dict:
+        return {"Error": self.error}

@@ -1,0 +1,178 @@
+"""Lightweight Kubernetes object model.
+
+The control plane speaks to the apiserver in raw JSON; these wrappers give
+the rest of the framework a typed, ergonomic view of ``Pod`` / ``Node``
+documents without depending on the (not installed) official client. They
+play the role client-go's ``v1.Pod`` / ``v1.Node`` types play in the
+reference (everything above the convention layer reads pods and nodes only
+through ``tpushare.utils``, mirroring the layering in SURVEY.md §1).
+
+Wrappers hold a reference to the underlying dict (``raw``); mutation
+helpers deep-copy first, matching the reference's ``DeepCopy`` discipline
+before annotation updates (``pkg/utils/pod.go:192-206``).
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+from typing import Any, Iterator
+
+_QUANTITY_RE = re.compile(r"^([+-]?[0-9.]+(?:[eE][+-]?[0-9]+)?)([A-Za-z]*)$")
+
+_SUFFIX_MULTIPLIERS = {
+    "": 1,
+    "k": 10**3,
+    "M": 10**6,
+    "G": 10**9,
+    "T": 10**12,
+    "P": 10**15,
+    "E": 10**18,
+    "Ki": 2**10,
+    "Mi": 2**20,
+    "Gi": 2**30,
+    "Ti": 2**40,
+    "Pi": 2**50,
+    "Ei": 2**60,
+    "m": 1e-3,
+}
+
+
+def parse_quantity(value: Any) -> int:
+    """Parse a Kubernetes resource quantity to an integer.
+
+    Accepts plain ints ("2"), binary suffixes ("16Gi"), and decimal
+    suffixes ("100M", "500m"); fractional results are truncated toward
+    zero, matching resource.Quantity.Value() semantics used by the
+    reference (``pkg/utils/node.go:12-19``).
+    """
+    if isinstance(value, (int, float)):
+        return int(value)
+    m = _QUANTITY_RE.match(str(value).strip())
+    if not m:
+        raise ValueError(f"invalid quantity: {value!r}")
+    number, suffix = m.groups()
+    try:
+        mult = _SUFFIX_MULTIPLIERS[suffix]
+    except KeyError:
+        raise ValueError(f"invalid quantity suffix: {value!r}") from None
+    return int(float(number) * mult)
+
+
+class K8sObject:
+    """Shared accessors over a raw apiserver JSON document."""
+
+    __slots__ = ("raw",)
+
+    def __init__(self, raw: dict):
+        self.raw = raw
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def metadata(self) -> dict:
+        return self.raw.setdefault("metadata", {})
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.get("namespace", "default")
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.get("uid", "")
+
+    @property
+    def resource_version(self) -> str:
+        return self.metadata.get("resourceVersion", "")
+
+    @property
+    def annotations(self) -> dict:
+        return self.metadata.get("annotations") or {}
+
+    @property
+    def labels(self) -> dict:
+        return self.metadata.get("labels") or {}
+
+    @property
+    def deletion_timestamp(self) -> str | None:
+        return self.metadata.get("deletionTimestamp")
+
+    def deepcopy(self):
+        return type(self)(copy.deepcopy(self.raw))
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"{type(self).__name__}({self.namespace}/{self.name})"
+
+    def __eq__(self, other) -> bool:
+        return type(self) is type(other) and self.raw == other.raw
+
+    def __hash__(self):  # identity by UID (falls back to ns/name)
+        return hash((type(self).__name__, self.uid or f"{self.namespace}/{self.name}"))
+
+
+class Pod(K8sObject):
+    """A ``v1.Pod`` view."""
+
+    @property
+    def spec(self) -> dict:
+        return self.raw.setdefault("spec", {})
+
+    @property
+    def status(self) -> dict:
+        return self.raw.get("status") or {}
+
+    @property
+    def node_name(self) -> str:
+        return self.spec.get("nodeName", "")
+
+    @property
+    def phase(self) -> str:
+        return self.status.get("phase", "")
+
+    @property
+    def containers(self) -> list[dict]:
+        return self.spec.get("containers") or []
+
+    def iter_resource_limits(self, resource: str) -> Iterator[int]:
+        """Yield the parsed limit of ``resource`` for each container."""
+        for c in self.containers:
+            limits = (c.get("resources") or {}).get("limits") or {}
+            if resource in limits:
+                yield parse_quantity(limits[resource])
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+class Node(K8sObject):
+    """A ``v1.Node`` view."""
+
+    @property
+    def status(self) -> dict:
+        return self.raw.get("status") or {}
+
+    @property
+    def capacity(self) -> dict:
+        return self.status.get("capacity") or {}
+
+    @property
+    def allocatable(self) -> dict:
+        return self.status.get("allocatable") or {}
+
+    def capacity_of(self, resource: str) -> int:
+        val = self.capacity.get(resource)
+        return parse_quantity(val) if val is not None else 0
+
+
+def binding_doc(pod: Pod, node_name: str) -> dict:
+    """Build the ``v1.Binding`` document POSTed to ``pods/{name}/binding``
+    (counterpart of reference ``nodeinfo.go:174-189``)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Binding",
+        "metadata": {"name": pod.name, "namespace": pod.namespace, "uid": pod.uid},
+        "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
+    }

@@ -1,0 +1,61 @@
+"""Per-chip ledger entry.
+
+Counterpart of the reference's ``pkg/cache/deviceinfo.go``: one TPU chip,
+its HBM capacity, and the set of resident pods. Unlike the reference,
+capacity is per-chip (heterogeneous chips supported) and a chip can be
+held whole by a multi-chip pod, in which case it accounts its full
+capacity as used regardless of the pod's aggregate HBM annotation.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tpushare.api.objects import Pod
+from tpushare.utils import pod as podutils
+
+
+class ChipInfo:
+    """One TPU chip's allocation state."""
+
+    def __init__(self, idx: int, total_hbm: int):
+        self.idx = idx
+        self.total_hbm = total_hbm
+        self.pods: dict[str, Pod] = {}  # uid -> Pod
+        self._lock = threading.RLock()
+
+    def add_pod(self, pod: Pod) -> None:
+        """Register ``pod`` as resident (reference deviceinfo.go:56-66)."""
+        with self._lock:
+            self.pods[pod.uid] = pod
+
+    def remove_pod(self, pod: Pod) -> None:
+        """Drop ``pod`` (reference deviceinfo.go:68-80)."""
+        with self._lock:
+            self.pods.pop(pod.uid, None)
+
+    def get_used_hbm(self) -> int:
+        """HBM GiB currently committed on this chip.
+
+        Counterpart of reference deviceinfo.go:41-54, with two fixes:
+        deletion-timestamped pods count as free (defect 6 in SURVEY.md §2),
+        and a pod holding multiple whole chips pins this chip's full
+        capacity rather than smearing its aggregate grant.
+        """
+        with self._lock:
+            used = 0
+            for p in self.pods.values():
+                if podutils.is_complete_pod(p):
+                    continue
+                if len(podutils.get_chip_ids_from_annotation(p)) > 1:
+                    used += self.total_hbm
+                else:
+                    used += podutils.pod_used_hbm(p)
+            return used
+
+    def snapshot_pods(self) -> list[Pod]:
+        with self._lock:
+            return list(self.pods.values())
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"ChipInfo(idx={self.idx}, hbm={self.get_used_hbm()}/{self.total_hbm})"

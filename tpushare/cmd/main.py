@@ -1,0 +1,88 @@
+"""Extender process entrypoint.
+
+Counterpart of the reference's ``cmd/main.go:88-131``: build the kube
+client, start the sync controller, construct the filter/bind/inspect
+handlers over the shared cache, and serve HTTP until signalled.
+
+Environment (reference cmd/main.go:23,92-98):
+
+* ``PORT``       — listen port, default 39999
+* ``KUBECONFIG`` — kubeconfig path when not in-cluster
+* ``WORKERS``    — sync worker threads, default 4 (the reference's
+  ``THREADNESS`` was dead code, SURVEY.md §2 defect 1)
+* ``LOG_LEVEL``  — debug/info/warning (the reference's manifest set this
+  but the code never read it, SURVEY.md §2 C16)
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+from tpushare.controller.controller import Controller
+from tpushare.gang.planner import GangPlanner
+from tpushare.k8s.client import ApiClient, ClusterConfig
+from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+from tpushare.scheduler.bind import Bind
+from tpushare.scheduler.inspect import Inspect
+from tpushare.scheduler.predicate import Predicate
+
+log = logging.getLogger(__name__)
+
+
+def setup_signals(stop_event: threading.Event) -> None:
+    """First SIGINT/SIGTERM requests shutdown; a second forces exit
+    (reference pkg/utils/signals/signal.go:16-30)."""
+    def handler(signum, frame):
+        if stop_event.is_set():
+            os._exit(1)
+        stop_event.set()
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+
+
+def build_stack(client):
+    """Wire controller + handlers over one shared cache; returns
+    (controller, predicate, bind, inspect)."""
+    controller = Controller(client)
+    gang = GangPlanner(controller.cache, client)
+    gang.start()  # housekeeping tick: gang expiry + bind retries
+    predicate = Predicate(controller.cache)
+    binder = Bind(controller.cache, client, gang_planner=gang,
+                  pod_lister=controller.hub.get_pod)
+    inspect = Inspect(controller.cache, client.list_nodes)
+    return controller, predicate, binder, inspect
+
+
+def main() -> None:
+    level = os.environ.get("LOG_LEVEL", "info").upper()
+    logging.basicConfig(
+        level=getattr(logging, level, logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    port = int(os.environ.get("PORT", "39999"))
+    workers = int(os.environ.get("WORKERS", "4"))
+
+    client = ApiClient(ClusterConfig.auto())
+    controller, predicate, binder, inspect = build_stack(client)
+
+    stop = threading.Event()
+    setup_signals(stop)
+
+    controller.start(workers=workers)
+    server = ExtenderHTTPServer(("0.0.0.0", port), predicate, binder, inspect)
+    serve_forever(server)
+    log.info("tpushare scheduler extender listening on :%d", port)
+
+    stop.wait()
+    log.info("shutting down")
+    server.shutdown()
+    binder.gang_planner.stop()
+    controller.stop()
+
+
+if __name__ == "__main__":
+    main()

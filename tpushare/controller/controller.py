@@ -1,0 +1,168 @@
+"""Sync controller: keeps the ledger consistent with the apiserver.
+
+Counterpart of the reference's ``pkg/gpushare/controller.go``: informer
+event handlers filter to TPU-sharing pods, funnel keys through a
+rate-limited workqueue, and ``sync_pod`` reconciles the cache. Deleted
+pods are stashed (``remove_pod_cache``) until the sync drains them, since
+the apiserver copy is gone by then (reference controller.go:59,185-189).
+
+Fixes over the reference (SURVEY.md §2 defects 1-2): worker threads loop
+until shutdown instead of exiting after each item (the reference's
+``processNextWorkItem`` returned false on success and leaned on a 1s
+restart — up to 1s of added latency per event), and the worker count is
+configurable for real (``THREADNESS`` was parsed to a constant 1).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from tpushare.api.objects import Pod
+from tpushare.cache.cache import SchedulerCache
+from tpushare.k8s.errors import ApiError, NotFoundError
+from tpushare.k8s.informer import InformerHub
+from tpushare.k8s.workqueue import RateLimitedQueue
+from tpushare.utils import pod as podutils
+
+log = logging.getLogger(__name__)
+
+
+class Controller:
+    def __init__(self, client, hub: InformerHub | None = None):
+        self.client = client
+        self.hub = hub or InformerHub(client)
+        self.queue = RateLimitedQueue()
+        self.cache = SchedulerCache(self._get_node, self._list_pods)
+        #: ns/name -> last seen Pod, for deletes (reference removePodCache)
+        self._removed: dict[str, Pod] = {}
+        self._removed_lock = threading.Lock()
+        self._workers: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+        self.hub.add_pod_handler(
+            on_add=self._on_pod_add,
+            on_update=self._on_pod_update,
+            on_delete=self._on_pod_delete,
+            filter_fn=self._is_relevant_pod,
+        )
+
+    # -- listers wired into the cache ----------------------------------- #
+
+    def _get_node(self, name: str):
+        node = self.hub.get_node(name)
+        if node is not None:
+            return node
+        try:  # informer may not have seen the node yet
+            return self.client.get_node(name)
+        except ApiError:
+            return None
+
+    def _list_pods(self):
+        pods = self.hub.pods.list()
+        return pods if pods else self.client.list_pods()
+
+    @staticmethod
+    def _is_relevant_pod(pod: Pod) -> bool:
+        """Informer-side filter (reference controller.go:77-100 filters on
+        IsGPUsharingPod)."""
+        return (podutils.is_tpu_sharing_pod(pod)
+                or podutils.is_tpu_chip_pod(pod)
+                or podutils.is_assumed(pod))
+
+    # -- event handlers (reference controller.go:233-332) ---------------- #
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        self.queue.add(pod.key())
+
+    def _on_pod_update(self, old: Pod | None, new: Pod) -> None:
+        """Enqueue iff the update changes ledger state: a known pod that
+        completed, or an unknown pod that acquired a chip assignment
+        (reference controller.go:257-305)."""
+        known = self.cache.known_pod(new.uid)
+        if known and podutils.is_complete_pod(new):
+            self.queue.add(new.key())
+        elif not known and podutils.is_assumed(new) and new.node_name:
+            self.queue.add(new.key())
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        with self._removed_lock:
+            self._removed[pod.key()] = pod
+        self.queue.add(pod.key())
+
+    # -- reconcile (reference syncPod, controller.go:174-205) ------------ #
+
+    def sync_pod(self, key: str) -> None:
+        namespace, name = key.split("/", 1)
+        pod = self.hub.get_pod(namespace, name)
+        if pod is None:
+            try:
+                pod = self.client.get_pod(namespace, name)
+            except NotFoundError:
+                pod = None
+        if pod is None:
+            with self._removed_lock:
+                stashed = self._removed.pop(key, None)
+            if stashed is not None:
+                self.cache.remove_pod(stashed)
+                log.info("sync: removed deleted pod %s from ledger", key)
+            return
+        if podutils.is_complete_pod(pod):
+            self.cache.remove_pod(pod)
+            log.info("sync: pod %s complete, freed its HBM", key)
+        elif podutils.is_assumed(pod) and pod.node_name:
+            self.cache.add_or_update_pod(pod)
+
+    # -- worker loop (reference runWorker/processNextWorkItem, fixed) ---- #
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            key = self.queue.get(timeout=0.5)
+            if key is None:
+                continue
+            try:
+                self.sync_pod(key)
+            except ApiError as e:
+                log.warning("sync of %s failed (%s); requeueing", key, e)
+                self.queue.add_rate_limited(key)
+            except Exception:
+                log.exception("sync of %s crashed; requeueing", key)
+                self.queue.add_rate_limited(key)
+            else:
+                self.queue.forget(key)
+            finally:
+                self.queue.done(key)
+
+    # -- lifecycle (reference Run/BuildCache) ---------------------------- #
+
+    def start(self, workers: int = 4) -> None:
+        self.hub.start()
+        if not self.hub.wait_for_sync():
+            raise RuntimeError("informer cache never synced")
+        self.cache.build()
+        for i in range(workers):
+            t = threading.Thread(target=self._worker,
+                                 name=f"tpushare-sync-{i}", daemon=True)
+            t.start()
+            self._workers.append(t)
+        log.info("controller started with %d sync workers", workers)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.shut_down()
+        self.hub.stop()
+        for t in self._workers:
+            t.join(timeout=2)
+
+    def wait_idle(self, timeout: float = 5.0) -> bool:
+        """Test helper: block until the queue drains."""
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.queue._cond:
+                busy = (len(self.queue._queue) + len(self.queue._delayed)
+                        + len(self.queue._processing))
+            if busy == 0:
+                return True
+            time.sleep(0.01)
+        return False

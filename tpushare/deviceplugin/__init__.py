@@ -1,0 +1,1 @@
+"""tpushare.deviceplugin subpackage."""

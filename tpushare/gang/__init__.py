@@ -1,0 +1,1 @@
+"""tpushare.gang subpackage."""

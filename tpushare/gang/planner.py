@@ -1,0 +1,276 @@
+"""Gang scheduling: all-or-nothing placement of pod groups.
+
+The reference had no gang concept — every pod was one device on one node
+(``docs/designs/designs.md:36``). Multi-host TPU slices break that model:
+a JAX job spanning hosts is useless until *all* its workers run, so
+binding members one by one can deadlock two half-placed jobs forever.
+
+Protocol (assume/commit with timeout rollback, SURVEY.md §7 delta 3):
+
+1. A gang member arrives at bind. Its chips are **reserved**: the ledger
+   allocation and the annotation write happen (so capacity is held and
+   restart-safe), but the binding is NOT posted.
+2. While the group is below ``tpushare.io/pod-group-min`` members, bind
+   returns an error — the kube-scheduler keeps the pod pending and
+   retries (the same retry loop the reference leaned on when a device
+   had no space, ``docs/designs/designs.md:82``).
+3. When the min-th member reserves, the whole group **commits**: bindings
+   are posted for every reserved member. Members whose binding POST
+   fails stay tracked and are retried — by the scheduler's own retry of
+   the pod, and by the housekeeping tick — until bound; the group is
+   only forgotten once every member is bound.
+4. Uncommitted reservations expire after ``ttl`` seconds; expiry rolls
+   the group back — ledger freed, annotations stripped — so abandoned
+   gangs never leak HBM. Expiry runs on a housekeeping thread
+   (:meth:`start`), not just opportunistically on bind traffic.
+
+Locking: a global lock guards only the group table; each group carries
+its own lock for the reserve/commit path, so apiserver round-trips for
+one gang never stall another gang's bind.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from tpushare.api.objects import Pod, binding_doc
+from tpushare.cache.nodeinfo import AllocationError
+from tpushare.k8s.errors import ApiError
+from tpushare.utils import const
+from tpushare.utils import pod as podutils
+
+log = logging.getLogger(__name__)
+
+
+class GangPending(AllocationError):
+    """Member reserved; group below quorum — scheduler should retry."""
+
+
+class _Group:
+    def __init__(self, name: str, minimum: int, ttl: float):
+        self.name = name
+        self.minimum = minimum
+        self.deadline = time.monotonic() + ttl
+        self.committed = False
+        self.lock = threading.RLock()
+        #: uid -> (annotated pod, node name)
+        self.reservations: dict[str, tuple[Pod, str]] = {}
+        #: uids whose binding POST succeeded
+        self.bound: set[str] = set()
+
+    def fully_bound(self) -> bool:
+        return self.committed and self.bound >= set(self.reservations)
+
+
+class GangPlanner:
+    def __init__(self, cache, client, ttl: float = 120.0,
+                 housekeeping_interval: float = 5.0):
+        self.cache = cache
+        self.client = client
+        self.ttl = ttl
+        self._interval = housekeeping_interval
+        self._groups: dict[tuple[str, str], _Group] = {}
+        self._table_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------ #
+    # Housekeeping driver (finding: expiry needs a tick, not just traffic)
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> None:
+        """Run the expiry/retry tick on a daemon thread."""
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._housekeeping_loop,
+                                        name="tpushare-gang", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _housekeeping_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.expire_stale()
+                self.retry_unbound()
+            except Exception:  # pragma: no cover - defensive
+                log.exception("gang housekeeping tick failed")
+
+    # ------------------------------------------------------------------ #
+
+    def _get_group(self, pod: Pod) -> tuple[tuple[str, str], _Group]:
+        group_name, minimum = podutils.get_pod_group(pod)
+        minimum = max(minimum, 1)
+        key = (pod.namespace, group_name)
+        with self._table_lock:
+            group = self._groups.get(key)
+            if group is None:
+                group = self._groups[key] = _Group(group_name, minimum,
+                                                   self.ttl)
+            group.minimum = max(group.minimum, minimum)
+        return key, group
+
+    def bind_member(self, pod: Pod, node_name: str) -> None:
+        """Reserve-or-commit one gang member; raises GangPending below
+        quorum and AllocationError/ApiError on real failures."""
+        if podutils.is_assumed(pod) and pod.node_name:
+            return  # already fully placed (idempotent retry)
+
+        key, group = self._get_group(pod)
+        with group.lock:
+            if pod.uid not in group.reservations:
+                if podutils.is_assumed(pod):
+                    # Reserved in a previous life (e.g. planner restart):
+                    # adopt the existing grant instead of re-allocating.
+                    self._adopt(group, pod)
+                else:
+                    info = self.cache.get_node_info(node_name)
+                    if info is None:
+                        raise AllocationError(f"unknown node {node_name}")
+                    reserved = info.allocate(self.client, pod, bind=False)
+                    self.cache.add_or_update_pod(reserved)
+                    group.reservations[pod.uid] = (reserved, node_name)
+                    log.info("gang %s/%s: reserved member %s on %s (%d/%d)",
+                             pod.namespace, group.name, pod.name, node_name,
+                             len(group.reservations), group.minimum)
+
+            if group.committed or len(group.reservations) >= group.minimum:
+                self._commit(key, group)  # raises if THIS member won't bind
+                return
+
+        raise GangPending(
+            f"gang {group.name}: {len(group.reservations)}/{group.minimum} "
+            f"members reserved; pod held pending quorum")
+
+    def _adopt(self, group: _Group, pod: Pod) -> None:
+        """Re-register an annotated-but-unbound member after a restart."""
+        node_name = pod.node_name
+        if not node_name:
+            # The annotation write committed but we lost the node choice —
+            # conservatively strip and let the scheduler start over.
+            self._strip_annotations(pod)
+            raise AllocationError(
+                f"gang member {pod.key()} had a stale reservation; reset")
+        group.reservations[pod.uid] = (pod, node_name)
+
+    # ------------------------------------------------------------------ #
+
+    def _bind_one(self, group: _Group, uid: str) -> None:
+        pod, node_name = group.reservations[uid]
+        try:
+            self.client.bind_pod(binding_doc(pod, node_name))
+        except ApiError as e:
+            if e.status != 409:  # 409 == already bound: fine
+                raise
+        group.bound.add(uid)
+
+    def _commit(self, key, group: _Group) -> None:
+        """Post bindings for every reserved member. Partial failures keep
+        the group tracked (finding: never report success while silently
+        leaking an unbound member); only this member's failure is raised.
+        """
+        if not group.committed:
+            log.info("gang %s/%s: quorum reached, committing %d bindings",
+                     key[0], group.name, len(group.reservations))
+            group.committed = True
+        first_error: ApiError | None = None
+        for uid in list(group.reservations):
+            if uid in group.bound:
+                continue
+            try:
+                self._bind_one(group, uid)
+            except ApiError as e:
+                pod, _ = group.reservations[uid]
+                log.warning("gang %s/%s: binding %s failed (%s); will retry",
+                            key[0], group.name, pod.name, e)
+                first_error = first_error or e
+        if group.fully_bound():
+            with self._table_lock:
+                self._groups.pop(key, None)
+        if first_error is not None:
+            raise first_error
+
+    def retry_unbound(self) -> int:
+        """Retry binding committed-but-unbound members; returns how many
+        bindings were attempted."""
+        with self._table_lock:
+            committed = [(k, g) for k, g in self._groups.items()
+                         if g.committed]
+        attempts = 0
+        for key, group in committed:
+            with group.lock:
+                for uid in list(group.reservations):
+                    if uid in group.bound:
+                        continue
+                    attempts += 1
+                    try:
+                        self._bind_one(group, uid)
+                    except ApiError:
+                        pass
+                if group.fully_bound():
+                    with self._table_lock:
+                        self._groups.pop(key, None)
+        return attempts
+
+    # ------------------------------------------------------------------ #
+
+    def expire_stale(self) -> int:
+        """Roll back UNcommitted groups whose reservation window lapsed.
+
+        Frees the ledger and strips the bind-time annotations so the pods
+        schedule cleanly on retry. Committed groups are never rolled back
+        here — their unbound members are retried by :meth:`retry_unbound`.
+        Returns the number of groups rolled back.
+        """
+        now = time.monotonic()
+        with self._table_lock:
+            expired = [(k, g) for k, g in self._groups.items()
+                       if not g.committed and now >= g.deadline]
+        rolled = 0
+        for key, group in expired:
+            with group.lock:
+                if group.committed:  # raced with a commit
+                    continue
+                log.warning("gang %s/%s: expired at %d/%d members; rolling "
+                            "back", key[0], group.name,
+                            len(group.reservations), group.minimum)
+                for pod, _node in group.reservations.values():
+                    self.cache.remove_pod(pod)
+                    self._strip_annotations(pod)
+                group.reservations.clear()
+                with self._table_lock:
+                    self._groups.pop(key, None)
+                rolled += 1
+        return rolled
+
+    def _strip_annotations(self, pod: Pod) -> None:
+        try:
+            fresh = self.client.get_pod(pod.namespace, pod.name)
+            ann = fresh.metadata.get("annotations") or {}
+            for k in (const.ANN_CHIP_IDX, const.ANN_HBM_POD,
+                      const.ANN_HBM_CHIP, const.ANN_ASSIGNED,
+                      const.ANN_ASSUME_TIME):
+                ann.pop(k, None)
+            fresh.raw.setdefault("spec", {}).pop("nodeName", None)
+            self.client.update_pod(fresh)
+        except ApiError as e:
+            log.debug("gang rollback: annotation strip for %s failed (%s); "
+                      "sync will reconcile", pod.key(), e)
+
+    # ------------------------------------------------------------------ #
+
+    def stats(self) -> dict:
+        with self._table_lock:
+            groups = dict(self._groups)
+        return {
+            f"{ns}/{g.name}": {
+                "reserved": len(g.reservations),
+                "bound": len(g.bound),
+                "min": g.minimum,
+                "committed": g.committed,
+            }
+            for (ns, _), g in groups.items()
+        }

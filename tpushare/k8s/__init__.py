@@ -1,0 +1,1 @@
+"""tpushare.k8s subpackage."""

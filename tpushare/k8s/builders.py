@@ -1,0 +1,64 @@
+"""Builders for pod/node documents (tests, benchmarks, samples).
+
+The pod shape mirrors the reference's sample workloads (a single
+container with the extended resource in ``resources.limits``,
+``samples/1.yaml``); the node shape is what the tpushare device plugin
+advertises (capacity + per-chip/topology annotations).
+"""
+
+from __future__ import annotations
+
+from tpushare.utils import const
+
+
+def make_pod(name: str, hbm: int = 0, chips: int = 0,
+             namespace: str = "default", node_name: str = "",
+             annotations: dict | None = None, phase: str = "Pending",
+             uid: str | None = None) -> dict:
+    limits = {}
+    if hbm:
+        limits[const.HBM_RESOURCE] = str(hbm)
+    if chips:
+        limits[const.CHIP_RESOURCE] = str(chips)
+    doc: dict = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": namespace,
+                     "annotations": dict(annotations or {})},
+        "spec": {"containers": [{"name": "main",
+                                 "resources": {"limits": limits}}]},
+        "status": {"phase": phase},
+    }
+    if uid:
+        doc["metadata"]["uid"] = uid
+    if node_name:
+        doc["spec"]["nodeName"] = node_name
+    return doc
+
+
+def make_node(name: str, chips: int = 4, hbm_per_chip: int = 16,
+              topology: str = "2x2x1", tpu_type: str = "v5e",
+              chip_hbm: list[int] | None = None) -> dict:
+    caps = chip_hbm if chip_hbm is not None else [hbm_per_chip] * chips
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {
+            "name": name,
+            "annotations": {
+                const.ANN_NODE_CHIP_HBM: ",".join(str(c) for c in caps),
+                const.ANN_NODE_TOPOLOGY: topology,
+                const.ANN_NODE_TPU_TYPE: tpu_type,
+            },
+        },
+        "status": {
+            "capacity": {
+                const.HBM_RESOURCE: str(sum(caps)),
+                const.CHIP_RESOURCE: str(len(caps)),
+            },
+            "allocatable": {
+                const.HBM_RESOURCE: str(sum(caps)),
+                const.CHIP_RESOURCE: str(len(caps)),
+            },
+        },
+    }

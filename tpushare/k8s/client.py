@@ -1,0 +1,300 @@
+"""Minimal Kubernetes REST client (stdlib only).
+
+The role client-go plays in the reference (``cmd/main.go:67-86`` builds
+the clientset from KUBECONFIG or in-cluster config). Supports exactly the
+surface the framework needs — pods/nodes CRUD, the binding subresource,
+events, and streaming WATCH — and exposes the same interface as
+:class:`tpushare.k8s.fake.FakeApiServer` so every layer runs against
+either.
+
+Auth: in-cluster service-account token + CA, or a kubeconfig with token /
+client-cert auth (``KUBECONFIG`` env, reference cmd/main.go:23,69-73).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import ssl
+import threading
+import urllib.error
+import urllib.request
+
+from tpushare.api.objects import Node, Pod
+from tpushare.k8s.errors import ApiError, ConflictError, NotFoundError
+
+log = logging.getLogger(__name__)
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ClusterConfig:
+    def __init__(self, host: str, token: str = "", ca_file: str | None = None,
+                 client_cert: str | None = None, client_key: str | None = None,
+                 verify: bool = True):
+        self.host = host.rstrip("/")
+        self.token = token
+        self.ca_file = ca_file
+        self.client_cert = client_cert
+        self.client_key = client_key
+        self.verify = verify
+
+    @classmethod
+    def in_cluster(cls) -> "ClusterConfig":
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError("not running in a cluster "
+                               "(KUBERNETES_SERVICE_HOST unset)")
+        with open(f"{SERVICE_ACCOUNT_DIR}/token") as f:
+            token = f.read().strip()
+        return cls(host=f"https://{host}:{port}", token=token,
+                   ca_file=f"{SERVICE_ACCOUNT_DIR}/ca.crt")
+
+    @classmethod
+    def from_kubeconfig(cls, path: str | None = None) -> "ClusterConfig":
+        import base64
+        import tempfile
+
+        import yaml
+
+        path = path or os.environ.get("KUBECONFIG",
+                                      os.path.expanduser("~/.kube/config"))
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+
+        def _by_name(section, name):
+            for item in cfg.get(section, []):
+                if item.get("name") == name:
+                    return item
+            raise RuntimeError(f"kubeconfig: no {section} entry {name!r}")
+
+        ctx_name = cfg.get("current-context")
+        ctx = _by_name("contexts", ctx_name)["context"]
+        cluster = _by_name("clusters", ctx["cluster"])["cluster"]
+        user = _by_name("users", ctx["user"])["user"]
+
+        def _materialize(data_key, file_key):
+            if user.get(file_key):
+                return user[file_key]
+            if user.get(data_key):
+                tmp = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+                tmp.write(base64.b64decode(user[data_key]))
+                tmp.close()
+                return tmp.name
+            return None
+
+        ca_file = cluster.get("certificate-authority")
+        if not ca_file and cluster.get("certificate-authority-data"):
+            import tempfile as _tf
+            tmp = _tf.NamedTemporaryFile(delete=False, suffix=".crt")
+            tmp.write(base64.b64decode(cluster["certificate-authority-data"]))
+            tmp.close()
+            ca_file = tmp.name
+        return cls(
+            host=cluster["server"],
+            token=user.get("token", ""),
+            ca_file=ca_file,
+            client_cert=_materialize("client-certificate-data",
+                                     "client-certificate"),
+            client_key=_materialize("client-key-data", "client-key"),
+            verify=not cluster.get("insecure-skip-tls-verify", False),
+        )
+
+    @classmethod
+    def auto(cls) -> "ClusterConfig":
+        """In-cluster first, then kubeconfig (reference initKubeClient
+        order, cmd/main.go:67-86)."""
+        try:
+            return cls.in_cluster()
+        except (RuntimeError, OSError):
+            return cls.from_kubeconfig()
+
+
+class ApiClient:
+    def __init__(self, config: ClusterConfig):
+        self.config = config
+        self._ssl = self._build_ssl_context()
+        self._watch_threads: dict[int, tuple[threading.Event, list]] = {}
+
+    def _build_ssl_context(self) -> ssl.SSLContext | None:
+        if not self.config.host.startswith("https"):
+            return None
+        ctx = ssl.create_default_context(cafile=self.config.ca_file)
+        if not self.config.verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        if self.config.client_cert:
+            ctx.load_cert_chain(self.config.client_cert,
+                                self.config.client_key)
+        return ctx
+
+    # ------------------------------------------------------------------ #
+    # Plumbing
+    # ------------------------------------------------------------------ #
+
+    def _request(self, method: str, path: str, body: dict | None = None,
+                 timeout: float = 30.0) -> dict:
+        url = f"{self.config.host}{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        if self.config.token:
+            req.add_header("Authorization", f"Bearer {self.config.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout,
+                                        context=self._ssl) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            body_text = e.read().decode(errors="replace")
+            if e.code == 404:
+                raise NotFoundError(body=body_text) from None
+            if e.code == 409:
+                raise ConflictError(body=body_text) from None
+            raise ApiError(e.code, reason=e.reason, body=body_text) from None
+        except urllib.error.URLError as e:
+            raise ApiError(0, reason=str(e.reason)) from None
+
+    # ------------------------------------------------------------------ #
+    # Pods
+    # ------------------------------------------------------------------ #
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        return Pod(self._request(
+            "GET", f"/api/v1/namespaces/{namespace}/pods/{name}"))
+
+    def list_pods(self) -> list[Pod]:
+        doc = self._request("GET", "/api/v1/pods?limit=5000")
+        return [Pod(item) for item in doc.get("items", [])]
+
+    def update_pod(self, pod: Pod) -> Pod:
+        return Pod(self._request(
+            "PUT", f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
+            body=pod.raw))
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._request("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def create_pod(self, raw: dict) -> Pod:
+        ns = raw.get("metadata", {}).get("namespace", "default")
+        return Pod(self._request("POST", f"/api/v1/namespaces/{ns}/pods",
+                                 body=raw))
+
+    def bind_pod(self, binding: dict) -> None:
+        meta = binding["metadata"]
+        ns = meta.get("namespace", "default")
+        self._request(
+            "POST", f"/api/v1/namespaces/{ns}/pods/{meta['name']}/binding",
+            body=binding)
+
+    # ------------------------------------------------------------------ #
+    # Nodes
+    # ------------------------------------------------------------------ #
+
+    def get_node(self, name: str) -> Node | None:
+        try:
+            return Node(self._request("GET", f"/api/v1/nodes/{name}"))
+        except NotFoundError:
+            return None
+
+    def list_nodes(self) -> list[Node]:
+        doc = self._request("GET", "/api/v1/nodes")
+        return [Node(item) for item in doc.get("items", [])]
+
+    def update_node_status(self, node: Node) -> Node:
+        return Node(self._request("PUT", f"/api/v1/nodes/{node.name}/status",
+                                  body=node.raw))
+
+    def patch_node_status(self, name: str, patch: dict) -> Node:
+        # strategic-merge-patch requires a different content type; use a
+        # raw request.
+        url = f"{self.config.host}/api/v1/nodes/{name}/status"
+        data = json.dumps(patch).encode()
+        req = urllib.request.Request(url, data=data, method="PATCH")
+        req.add_header("Content-Type", "application/strategic-merge-patch+json")
+        if self.config.token:
+            req.add_header("Authorization", f"Bearer {self.config.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=30,
+                                        context=self._ssl) as resp:
+                return Node(json.loads(resp.read()))
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, reason=e.reason,
+                           body=e.read().decode(errors="replace")) from None
+
+    # ------------------------------------------------------------------ #
+    # Events (reference controller.go:63-67 event broadcaster)
+    # ------------------------------------------------------------------ #
+
+    def create_event(self, namespace: str, event: dict) -> None:
+        try:
+            self._request("POST", f"/api/v1/namespaces/{namespace}/events",
+                          body=event)
+        except ApiError as e:  # events are best-effort
+            log.debug("event create failed: %s", e)
+
+    # ------------------------------------------------------------------ #
+    # Watch — same queue interface as FakeApiServer.watch()
+    # ------------------------------------------------------------------ #
+
+    def watch(self) -> queue.Queue:
+        q: queue.Queue = queue.Queue()
+        stop = threading.Event()
+        threads = []
+        for kind, path in (("Pod", "/api/v1/pods"),
+                           ("Node", "/api/v1/nodes")):
+            t = threading.Thread(
+                target=self._watch_loop, args=(kind, path, q, stop),
+                name=f"tpushare-watch-{kind.lower()}", daemon=True)
+            t.start()
+            threads.append(t)
+        self._watch_threads[id(q)] = (stop, threads)
+        return q
+
+    def stop_watch(self, q: queue.Queue) -> None:
+        entry = self._watch_threads.pop(id(q), None)
+        if entry:
+            entry[0].set()
+
+    def _watch_loop(self, kind: str, path: str, q: queue.Queue,
+                    stop: threading.Event) -> None:
+        rv = ""
+        while not stop.is_set():
+            try:
+                listing = self._request("GET", path)
+                rv = listing.get("metadata", {}).get("resourceVersion", "")
+                # Replay the LIST into the stream so consumers resync state
+                # that changed while the watch was down (otherwise events in
+                # the reconnect gap are lost forever — e.g. a deleted pod
+                # would hold its HBM in the ledger indefinitely).
+                q.put((kind, "RELIST", listing.get("items", []) or []))
+                url = (f"{self.config.host}{path}?watch=true"
+                       f"&resourceVersion={rv}&timeoutSeconds=300"
+                       "&allowWatchBookmarks=true")
+                req = urllib.request.Request(url)
+                if self.config.token:
+                    req.add_header("Authorization",
+                                   f"Bearer {self.config.token}")
+                with urllib.request.urlopen(req, timeout=330,
+                                            context=self._ssl) as resp:
+                    for line in resp:
+                        if stop.is_set():
+                            return
+                        if not line.strip():
+                            continue
+                        evt = json.loads(line)
+                        etype = evt.get("type", "")
+                        if etype in ("ADDED", "MODIFIED", "DELETED"):
+                            q.put((kind, etype, evt.get("object", {})))
+                        elif etype == "ERROR":
+                            break  # re-list with a fresh resourceVersion
+            except (ApiError, OSError, json.JSONDecodeError) as e:
+                if stop.is_set():
+                    return
+                log.warning("watch %s dropped (%s); re-listing", kind, e)
+                stop.wait(1.0)

@@ -1,0 +1,177 @@
+"""In-memory fake apiserver.
+
+Plays the role client-go's ``fake.Clientset`` plays in the test strategy
+SURVEY.md §4 prescribes: multi-node scenarios need no real cluster because
+nodes and pods are just apiserver objects. Implements the same client
+surface as :class:`tpushare.k8s.client.ApiClient` — reads, optimistic-
+concurrency writes (real 409s on stale resourceVersion), binding
+subresource, and watch streams — so the ledger, handlers, controller, and
+end-to-end tests all run against it unchanged.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+
+from tpushare.api.objects import Node, Pod
+from tpushare.k8s.errors import ConflictError, NotFoundError
+
+
+class FakeApiServer:
+    """Thread-safe in-memory pod/node store with watch fan-out."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pods: dict[str, dict] = {}   # "ns/name" -> raw pod
+        self._nodes: dict[str, dict] = {}  # name -> raw node
+        self._rv = itertools.count(1)
+        self._watchers: list[queue.Queue] = []
+        self._uid = itertools.count(1)
+
+    # ------------------------------------------------------------------ #
+    # Watch plumbing (client-go LIST/WATCH analogue)
+    # ------------------------------------------------------------------ #
+
+    def _notify(self, kind: str, event_type: str, obj: dict) -> None:
+        for q in list(self._watchers):
+            q.put((kind, event_type, copy.deepcopy(obj)))
+
+    def watch(self) -> queue.Queue:
+        """Subscribe to (kind, event_type, raw_obj) tuples; kind in
+        {"Pod","Node"}, event_type in {"ADDED","MODIFIED","DELETED"}."""
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            self._watchers.append(q)
+        return q
+
+    def stop_watch(self, q: queue.Queue) -> None:
+        with self._lock:
+            if q in self._watchers:
+                self._watchers.remove(q)
+
+    def _bump(self, obj: dict) -> None:
+        obj.setdefault("metadata", {})["resourceVersion"] = str(next(self._rv))
+
+    # ------------------------------------------------------------------ #
+    # Pods
+    # ------------------------------------------------------------------ #
+
+    def create_pod(self, raw: dict) -> Pod:
+        with self._lock:
+            pod = copy.deepcopy(raw)
+            meta = pod.setdefault("metadata", {})
+            meta.setdefault("namespace", "default")
+            meta.setdefault("uid", f"uid-{next(self._uid)}")
+            key = f"{meta['namespace']}/{meta['name']}"
+            if key in self._pods:
+                raise ConflictError(reason=f"pod {key} already exists")
+            self._bump(pod)
+            self._pods[key] = pod
+            self._notify("Pod", "ADDED", pod)
+            return Pod(copy.deepcopy(pod))
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            if key not in self._pods:
+                raise NotFoundError(reason=f"pod {key} not found")
+            return Pod(copy.deepcopy(self._pods[key]))
+
+    def list_pods(self) -> list[Pod]:
+        with self._lock:
+            return [Pod(copy.deepcopy(p)) for p in self._pods.values()]
+
+    def update_pod(self, pod: Pod) -> Pod:
+        """Optimistic-concurrency update: stale resourceVersion → 409,
+        exactly the failure mode the allocator's typed retry handles
+        (reference nodeinfo.go:150-168)."""
+        with self._lock:
+            key = pod.key()
+            current = self._pods.get(key)
+            if current is None:
+                raise NotFoundError(reason=f"pod {key} not found")
+            cur_rv = current["metadata"].get("resourceVersion")
+            if pod.resource_version and pod.resource_version != cur_rv:
+                raise ConflictError(
+                    reason="the object has been modified; please apply your "
+                           "changes to the latest version and try again")
+            updated = copy.deepcopy(pod.raw)
+            updated["metadata"]["uid"] = current["metadata"]["uid"]
+            self._bump(updated)
+            self._pods[key] = updated
+            self._notify("Pod", "MODIFIED", updated)
+            return Pod(copy.deepcopy(updated))
+
+    def update_pod_status(self, namespace: str, name: str, phase: str) -> Pod:
+        with self._lock:
+            pod = self._pods.get(f"{namespace}/{name}")
+            if pod is None:
+                raise NotFoundError(reason=f"pod {namespace}/{name} not found")
+            pod.setdefault("status", {})["phase"] = phase
+            self._bump(pod)
+            self._notify("Pod", "MODIFIED", pod)
+            return Pod(copy.deepcopy(pod))
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            pod = self._pods.pop(key, None)
+            if pod is None:
+                raise NotFoundError(reason=f"pod {key} not found")
+            self._notify("Pod", "DELETED", pod)
+
+    def bind_pod(self, binding: dict) -> None:
+        """``POST pods/{name}/binding`` — sets spec.nodeName (reference
+        nodeinfo.go:174-189 via clientset Bind)."""
+        with self._lock:
+            meta = binding.get("metadata", {})
+            key = f"{meta.get('namespace', 'default')}/{meta.get('name')}"
+            pod = self._pods.get(key)
+            if pod is None:
+                raise NotFoundError(reason=f"pod {key} not found")
+            if pod.get("spec", {}).get("nodeName"):
+                raise ConflictError(reason=f"pod {key} is already bound")
+            pod.setdefault("spec", {})["nodeName"] = binding["target"]["name"]
+            self._bump(pod)
+            self._notify("Pod", "MODIFIED", pod)
+
+    # ------------------------------------------------------------------ #
+    # Nodes
+    # ------------------------------------------------------------------ #
+
+    def create_node(self, raw: dict) -> Node:
+        with self._lock:
+            node = copy.deepcopy(raw)
+            name = node["metadata"]["name"]
+            self._bump(node)
+            self._nodes[name] = node
+            self._notify("Node", "ADDED", node)
+            return Node(copy.deepcopy(node))
+
+    def get_node(self, name: str) -> Node | None:
+        with self._lock:
+            raw = self._nodes.get(name)
+            return Node(copy.deepcopy(raw)) if raw else None
+
+    def list_nodes(self) -> list[Node]:
+        with self._lock:
+            return [Node(copy.deepcopy(n)) for n in self._nodes.values()]
+
+    def update_node(self, node: Node) -> Node:
+        with self._lock:
+            if node.name not in self._nodes:
+                raise NotFoundError(reason=f"node {node.name} not found")
+            updated = copy.deepcopy(node.raw)
+            self._bump(updated)
+            self._nodes[node.name] = updated
+            self._notify("Node", "MODIFIED", updated)
+            return Node(copy.deepcopy(updated))
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            if node is not None:
+                self._notify("Node", "DELETED", node)

@@ -1,0 +1,169 @@
+"""Informer: LIST+WATCH → local store + event handlers.
+
+Plays the role of client-go's shared informers in the reference
+(``controller.go:76-111``): one background thread per informer consumes
+the watch stream, keeps a thread-safe object store (the "lister"), and
+invokes registered add/update/delete handlers. Works against anything
+exposing the watch surface of :class:`tpushare.k8s.fake.FakeApiServer`
+or :class:`tpushare.k8s.client.ApiClient`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from tpushare.api.objects import Node, Pod
+
+log = logging.getLogger(__name__)
+
+_WRAPPERS = {"Pod": Pod, "Node": Node}
+
+
+class Store:
+    """Thread-safe keyed object store (the lister)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._items: dict[str, object] = {}
+
+    @staticmethod
+    def key_of(obj) -> str:
+        if isinstance(obj, Pod):
+            return obj.key()
+        return obj.name
+
+    def replace(self, objs) -> None:
+        with self._lock:
+            self._items = {self.key_of(o): o for o in objs}
+
+    def upsert(self, obj) -> None:
+        with self._lock:
+            self._items[self.key_of(obj)] = obj
+
+    def delete(self, obj) -> None:
+        with self._lock:
+            self._items.pop(self.key_of(obj), None)
+
+    def get(self, key: str):
+        with self._lock:
+            return self._items.get(key)
+
+    def list(self) -> list:
+        with self._lock:
+            return list(self._items.values())
+
+
+class InformerHub:
+    """One watch stream fanned out to pod and node informers.
+
+    ``start()`` performs the initial LIST (so ``wait_for_sync`` has the
+    same meaning as the reference's ``WaitForCacheSync``,
+    controller.go:118-128) and then consumes watch events on a daemon
+    thread.
+    """
+
+    def __init__(self, client):
+        self.client = client
+        self.pods = Store()
+        self.nodes = Store()
+        self._handlers: dict[str, list] = {"Pod": [], "Node": []}
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._watch_queue = None
+
+    # -- registration --------------------------------------------------- #
+
+    def add_pod_handler(self, on_add=None, on_update=None, on_delete=None,
+                        filter_fn=None) -> None:
+        self._handlers["Pod"].append((on_add, on_update, on_delete, filter_fn))
+
+    def add_node_handler(self, on_add=None, on_update=None, on_delete=None,
+                         filter_fn=None) -> None:
+        self._handlers["Node"].append((on_add, on_update, on_delete, filter_fn))
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def start(self) -> None:
+        self._watch_queue = self.client.watch()
+        self.pods.replace(self.client.list_pods())
+        self.nodes.replace(self.client.list_nodes())
+        self._synced.set()
+        self._thread = threading.Thread(
+            target=self._run, name="tpushare-informer", daemon=True)
+        self._thread.start()
+
+    def wait_for_sync(self, timeout: float = 30.0) -> bool:
+        return self._synced.wait(timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch_queue is not None:
+            self.client.stop_watch(self._watch_queue)
+            self._watch_queue.put(None)  # unblock the consumer
+
+    # -- event loop ----------------------------------------------------- #
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            item = self._watch_queue.get()
+            if item is None:
+                break
+            kind, event_type, raw = item
+            wrapper = _WRAPPERS.get(kind)
+            if wrapper is None:
+                continue
+            store = self.pods if kind == "Pod" else self.nodes
+            if event_type == "RELIST":
+                # Watch stream reconnected: diff the fresh LIST against the
+                # store and synthesize the events missed during the gap.
+                self._handle_relist(kind, store, [wrapper(r) for r in raw])
+                continue
+            obj = wrapper(raw)
+            old = store.get(Store.key_of(obj))
+            if event_type == "DELETED":
+                store.delete(obj)
+            else:
+                store.upsert(obj)
+            self._dispatch(kind, event_type, old, obj)
+
+    def _handle_relist(self, kind: str, store: Store, objs: list) -> None:
+        fresh = {Store.key_of(o): o for o in objs}
+        stale = {k: o for k, o in
+                 ((key, store.get(key)) for key in
+                  [Store.key_of(o) for o in store.list()])
+                 if k not in fresh and o is not None}
+        for obj in objs:
+            old = store.get(Store.key_of(obj))
+            store.upsert(obj)
+            self._dispatch(kind, "ADDED" if old is None else "MODIFIED",
+                           old, obj)
+        for obj in stale.values():
+            store.delete(obj)
+            self._dispatch(kind, "DELETED", None, obj)
+
+    def _dispatch(self, kind: str, event_type: str, old, obj) -> None:
+        for on_add, on_update, on_delete, filter_fn in self._handlers[kind]:
+            try:
+                relevant = filter_fn is None or filter_fn(obj) or (
+                    old is not None and filter_fn(old))
+                if not relevant:
+                    continue
+                if event_type == "ADDED" and on_add:
+                    on_add(obj)
+                elif event_type == "MODIFIED" and on_update:
+                    on_update(old, obj)
+                elif event_type == "DELETED" and on_delete:
+                    on_delete(obj)
+            except Exception:  # pragma: no cover - handler bugs
+                log.exception("informer handler failed for %s %s",
+                              event_type, Store.key_of(obj))
+
+    # -- lister convenience --------------------------------------------- #
+
+    def get_pod(self, namespace: str, name: str) -> Pod | None:
+        return self.pods.get(f"{namespace}/{name}")
+
+    def get_node(self, name: str) -> Node | None:
+        return self.nodes.get(name)

@@ -1,0 +1,58 @@
+"""Prometheus metrics for the extender.
+
+The reference had pprof but no metrics (SURVEY.md §5 calls this out as a
+gap: BASELINE's p50 filter+bind latency target needs one). Histograms
+here are the source of the bench harness's latency numbers.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
+
+REGISTRY = CollectorRegistry()
+
+_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+FILTER_LATENCY = Histogram(
+    "tpushare_filter_latency_seconds",
+    "Latency of extender filter requests",
+    registry=REGISTRY, buckets=_BUCKETS,
+)
+BIND_LATENCY = Histogram(
+    "tpushare_bind_latency_seconds",
+    "Latency of extender bind requests",
+    registry=REGISTRY, buckets=_BUCKETS,
+)
+BIND_ERRORS = Counter(
+    "tpushare_bind_errors_total",
+    "Bind requests that returned an error",
+    registry=REGISTRY,
+)
+FILTER_REQUESTS = Counter(
+    "tpushare_filter_requests_total",
+    "Filter requests served",
+    registry=REGISTRY,
+)
+HBM_TOTAL = Gauge(
+    "tpushare_node_hbm_total_gib", "Total shareable HBM per node",
+    ["node"], registry=REGISTRY,
+)
+HBM_USED = Gauge(
+    "tpushare_node_hbm_used_gib", "Committed HBM per node",
+    ["node"], registry=REGISTRY,
+)
+
+
+def render() -> bytes:
+    return generate_latest(REGISTRY)
+
+
+def observe_cache(cache) -> None:
+    """Refresh per-node utilization gauges from the ledger."""
+    for info in cache.get_node_infos():
+        HBM_TOTAL.labels(node=info.name).set(info.total_hbm)
+        used = sum(c.get_used_hbm() for c in info.chips.values())
+        HBM_USED.labels(node=info.name).set(used)

@@ -1,0 +1,166 @@
+"""HTTP API layer: the extender webhook server.
+
+Counterpart of the reference's ``pkg/routes/routes.go`` (+ ``pprof.go``).
+Routes:
+
+* ``POST {prefix}/filter``  — predicate (reference routes.go:58-99)
+* ``POST {prefix}/bind``    — bind; HTTP 500 on error (routes.go:101-148)
+* ``GET  {prefix}/inspect[/<node>]`` — utilization dump (routes.go:39-56)
+* ``GET  /version``         — version string (routes.go:150-156)
+* ``GET  /healthz``         — liveness
+* ``GET  /metrics``         — Prometheus (new; SURVEY.md §5 gap)
+* ``GET  /debug/threads``   — stack dump of all threads (pprof analogue)
+
+A malformed body is rejected with HTTP 400 *and the handler returns* —
+the reference kept executing after writing the 400 (``checkBody``,
+routes.go:32-37, SURVEY.md §2 C10 quirk).
+
+Built on ``ThreadingHTTPServer``: each request gets a thread, and the
+ledger's locks provide the concurrency control (the reference similarly
+relied on Go's ``net/http`` goroutine-per-request).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import tpushare
+from tpushare.api.extender import ExtenderArgs, ExtenderBindingArgs
+from tpushare.routes import metrics
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PREFIX = "/tpushare-scheduler"
+
+
+class ExtenderHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, predicate, binder, inspect,
+                 prefix: str = DEFAULT_PREFIX):
+        self.predicate = predicate
+        self.binder = binder
+        self.inspect = inspect
+        self.prefix = prefix
+        super().__init__(addr, _Handler)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server: ExtenderHTTPServer
+
+    # -- plumbing ----------------------------------------------------------
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+    def _send_json(self, doc: dict, status: int = 200) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, text: bytes, status: int = 200,
+                   ctype: str = "text/plain; charset=utf-8") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(text)))
+        self.end_headers()
+        self.wfile.write(text)
+
+    def _read_json(self) -> dict | None:
+        """Parse the request body; None (after a 400) when malformed."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) if length else b""
+            if not raw:
+                self._send_json({"Error": "empty request body"}, 400)
+                return None
+            return json.loads(raw)
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json({"Error": f"malformed request body: {e}"}, 400)
+            return None
+
+    # -- verbs -------------------------------------------------------------
+    def do_GET(self):  # noqa: N802 (stdlib casing)
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        prefix = self.server.prefix
+        try:
+            if path == "/version":
+                self._send_json({"version": tpushare.__version__})
+            elif path == "/healthz":
+                self._send_text(b"ok")
+            elif path == "/metrics":
+                # Refresh per-node utilization gauges on scrape.
+                metrics.observe_cache(self.server.inspect.cache)
+                self._send_text(metrics.render(), ctype="text/plain; version=0.0.4")
+            elif path == "/debug/threads":
+                self._send_text(_thread_dump().encode())
+            elif path == f"{prefix}/inspect" or path.startswith(f"{prefix}/inspect/"):
+                node = None
+                rest = path[len(f"{prefix}/inspect"):]
+                if rest.startswith("/"):
+                    node = rest[1:]
+                self._send_json(self.server.inspect.handle(node))
+            else:
+                self._send_json({"Error": f"no route for {path}"}, 404)
+        except Exception as e:  # pragma: no cover - defensive
+            log.exception("GET %s failed", path)
+            self._send_json({"Error": str(e)}, 500)
+
+    def do_POST(self):  # noqa: N802
+        path = self.path.split("?", 1)[0].rstrip("/")
+        prefix = self.server.prefix
+        try:
+            if path == f"{prefix}/filter":
+                doc = self._read_json()
+                if doc is None:
+                    return
+                metrics.FILTER_REQUESTS.inc()
+                with metrics.FILTER_LATENCY.time():
+                    result = self.server.predicate.handle(ExtenderArgs.from_json(doc))
+                self._send_json(result.to_json())
+            elif path == f"{prefix}/bind":
+                doc = self._read_json()
+                if doc is None:
+                    return
+                with metrics.BIND_LATENCY.time():
+                    result = self.server.binder.handle(
+                        ExtenderBindingArgs.from_json(doc))
+                if result.error:
+                    metrics.BIND_ERRORS.inc()
+                # Reference returns HTTP 500 when bind fails
+                # (routes.go:139-143) so the scheduler retries.
+                self._send_json(result.to_json(), 500 if result.error else 200)
+            else:
+                self._send_json({"Error": f"no route for {path}"}, 404)
+        except Exception as e:  # pragma: no cover - defensive
+            log.exception("POST %s failed", path)
+            self._send_json({"Error": str(e)}, 500)
+
+
+def _thread_dump() -> str:
+    """All-threads stack dump — the goroutine-profile analogue of the
+    reference's pprof mount (pkg/routes/pprof.go:10-22)."""
+    lines = []
+    for tid, frame in sys._current_frames().items():
+        thread = next((t for t in threading.enumerate() if t.ident == tid), None)
+        name = thread.name if thread else f"thread-{tid}"
+        lines.append(f"--- {name} ({tid}) ---")
+        lines.extend(traceback.format_stack(frame))
+    return "\n".join(lines)
+
+
+def serve_forever(server: ExtenderHTTPServer) -> threading.Thread:
+    """Run the server on a daemon thread; returns the thread."""
+    t = threading.Thread(target=server.serve_forever, name="tpushare-http",
+                         daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,84 @@
+"""Workload-side runtime contract: injected env → JAX process config.
+
+Counterpart of the reference's userguide convention
+(``docs/userguide.md:56-77``): the GPU workload read ``SHARED_GPU_MEM_*``
+env and set TensorFlow's ``per_process_gpu_memory_fraction``
+(``samples/docker/main.py:37``, demo factor 0.7). The TPU-native contract
+maps the device plugin's injected env onto the knobs JAX/libtpu honor:
+
+* ``TPU_VISIBLE_CHIPS`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` — restrict the
+  process to its granted chip(s);
+* ``XLA_PYTHON_CLIENT_MEM_FRACTION`` — cap the premapped HBM pool to the
+  granted fraction, which is what makes co-tenancy of one chip safe.
+
+Call :func:`configure` BEFORE importing jax (it only sets env vars).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from tpushare.utils import const
+
+#: Safety headroom applied to the granted fraction. The reference demo
+#: used 0.7 (samples/docker/main.py:37) to leave room for framework
+#: overhead; XLA's premapped budget is tighter, so 0.9 is enough.
+DEFAULT_HEADROOM = 0.9
+
+
+@dataclasses.dataclass(frozen=True)
+class ShareGrant:
+    """What the device plugin granted this process."""
+
+    chip_ids: tuple[int, ...]
+    hbm_pod_gib: int
+    hbm_chip_gib: int
+
+    @property
+    def mem_fraction(self) -> float:
+        if self.hbm_chip_gib <= 0:
+            return 1.0
+        return min(self.hbm_pod_gib / self.hbm_chip_gib, 1.0)
+
+    @property
+    def whole_chips(self) -> bool:
+        return self.hbm_pod_gib >= self.hbm_chip_gib * len(self.chip_ids)
+
+
+def read_grant(environ=None) -> ShareGrant | None:
+    """Parse the injected env; None when not running under tpushare."""
+    env = os.environ if environ is None else environ
+    raw_idx = env.get(const.ENV_CHIP_IDX)
+    if raw_idx is None:
+        return None
+    try:
+        chip_ids = tuple(int(p) for p in str(raw_idx).split(",") if p != "")
+        hbm_pod = int(env.get(const.ENV_HBM_POD, "0"))
+        hbm_chip = int(env.get(const.ENV_HBM_CHIP, "0"))
+    except ValueError:
+        return None
+    return ShareGrant(chip_ids, hbm_pod, hbm_chip)
+
+
+def configure(environ=None, headroom: float = DEFAULT_HEADROOM) -> ShareGrant | None:
+    """Apply the grant to this process's env (before jax import).
+
+    Returns the grant, or None (no-op) outside a tpushare pod.
+    """
+    env = os.environ if environ is None else environ
+    grant = read_grant(env)
+    if grant is None:
+        return None
+    if grant.chip_ids:
+        env.setdefault(const.ENV_TPU_VISIBLE_CHIPS,
+                       ",".join(str(c) for c in grant.chip_ids))
+        bounds = f"1,1,{len(grant.chip_ids)}"
+        env.setdefault(const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS, bounds)
+        env.setdefault(const.ENV_TPU_PROCESS_BOUNDS, "1,1,1")
+    if not grant.whole_chips:
+        # Only HBM-slice tenants cap the premapped pool; whole-chip pods
+        # keep XLA's default (they own the chip's HBM outright).
+        fraction = round(grant.mem_fraction * headroom, 3)
+        env.setdefault(const.ENV_XLA_MEM_FRACTION, str(fraction))
+    return grant

@@ -1,0 +1,1 @@
+"""tpushare.scheduler subpackage."""

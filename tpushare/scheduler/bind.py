@@ -1,0 +1,76 @@
+"""Bind handler — the critical mutation path.
+
+Counterpart of the reference's ``pkg/scheduler/bind.go`` +
+``gpushare-bind.go``: fetch the pod (cache first, apiserver fallback on
+UID mismatch — reference gpushare-bind.go:44-65), then run the node
+ledger's allocate (annotate → bind → ledger update, SURVEY.md §3.3).
+
+Gang pods are routed through the gang planner instead of being bound
+individually, so a multi-host pod group is only ever committed
+all-or-nothing.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpushare.api.extender import ExtenderBindingArgs, ExtenderBindingResult
+from tpushare.cache.cache import SchedulerCache
+from tpushare.cache.nodeinfo import AllocationError
+from tpushare.k8s.errors import ApiError
+from tpushare.utils import pod as podutils
+
+log = logging.getLogger(__name__)
+
+
+class Bind:
+    name = "tpushare-bind"
+
+    def __init__(self, cache: SchedulerCache, client, gang_planner=None,
+                 pod_lister=None):
+        self.cache = cache
+        self.client = client
+        self.gang_planner = gang_planner
+        #: Optional informer-store fetch ``(ns, name) -> Pod | None``; when
+        #: wired, reads go to the local cache first like the reference's
+        #: lister path.
+        self.pod_lister = pod_lister
+
+    def _get_pod(self, args: ExtenderBindingArgs):
+        """Lister-first pod fetch with UID-guarded apiserver fallback
+        (reference gpushare-bind.go:44-65 guards stale identity)."""
+        pod = None
+        if self.pod_lister is not None:
+            pod = self.pod_lister(args.pod_namespace, args.pod_name)
+        if pod is not None and args.pod_uid and pod.uid != args.pod_uid:
+            log.warning(
+                "pod %s/%s UID mismatch: scheduler sent %s, lister has %s; "
+                "refetching from apiserver",
+                args.pod_namespace, args.pod_name, args.pod_uid, pod.uid,
+            )
+            pod = None
+        if pod is None:
+            pod = self.client.get_pod(args.pod_namespace, args.pod_name)
+        return pod
+
+    def handle(self, args: ExtenderBindingArgs) -> ExtenderBindingResult:
+        try:
+            pod = self._get_pod(args)
+        except ApiError as e:
+            return ExtenderBindingResult(error=str(e))
+
+        info = self.cache.get_node_info(args.node)
+        if info is None:
+            return ExtenderBindingResult(error=f"unknown node {args.node}")
+
+        try:
+            if self.gang_planner is not None and podutils.is_gang_pod(pod):
+                self.gang_planner.bind_member(pod, args.node)
+            else:
+                new_pod = info.allocate(self.client, pod)
+                self.cache.add_or_update_pod(new_pod)
+            return ExtenderBindingResult()
+        except (AllocationError, ApiError) as e:
+            log.warning("bind failed for pod %s/%s on node %s: %s",
+                        args.pod_namespace, args.pod_name, args.node, e)
+            return ExtenderBindingResult(error=str(e))

@@ -1,0 +1,77 @@
+"""Inspect handler: the utilization/debug API.
+
+Counterpart of the reference's ``pkg/scheduler/inspect.go`` +
+``gpushare-inspect.go``: dump per-node, per-chip totals/used and the
+resident (assigned, non-terminated) pods as JSON. Feeds the
+``kubectl-inspect-tpushare`` CLI (reference ``docs/userguide.md:10-17``).
+
+TPU extensions: each node carries its ICI topology and TPU generation,
+and each chip its coordinates, so operators can see *where* in the mesh
+the free HBM is.
+"""
+
+from __future__ import annotations
+
+from tpushare.cache.cache import SchedulerCache
+from tpushare.utils import node as nodeutils
+from tpushare.utils import pod as podutils
+
+
+class Inspect:
+    name = "tpushare-inspect"
+
+    def __init__(self, cache: SchedulerCache, node_lister=None):
+        self.cache = cache
+        self._node_lister = node_lister  # () -> list[Node], for all-nodes view
+
+    def _build_node(self, info) -> dict:
+        """Per-node document (reference inspect.go:33-71)."""
+        chips = []
+        used_total = 0
+        for idx in sorted(info.chips):
+            chip = info.chips[idx]
+            pods = []
+            for p in chip.snapshot_pods():
+                if not podutils.is_assigned_non_terminated(p):
+                    continue  # reference inspect.go:49 filter
+                pods.append({
+                    "name": p.name,
+                    "namespace": p.namespace,
+                    "usedHBM": podutils.pod_used_hbm(p),
+                    "chipIds": podutils.get_chip_ids_from_annotation(p),
+                })
+            used = chip.get_used_hbm()
+            used_total += used
+            chips.append({
+                "id": idx,
+                "coords": list(info.topology.coords(idx))
+                          if idx < info.topology.chip_count else [],
+                "totalHBM": chip.total_hbm,
+                "usedHBM": used,
+                "pods": pods,
+            })
+        return {
+            "name": info.name,
+            "tpuType": nodeutils.get_tpu_type(info.node),
+            "topology": nodeutils.get_topology(info.node),
+            "totalHBM": info.total_hbm,
+            "usedHBM": used_total,
+            "chips": chips,
+        }
+
+    def handle(self, node_name: str | None = None) -> dict:
+        """All nodes, or one (reference inspect.go:9-31)."""
+        if node_name:
+            info = self.cache.get_node_info(node_name)
+            if info is None:
+                return {"nodes": [], "error": f"unknown node {node_name}"}
+            return {"nodes": [self._build_node(info)]}
+        infos = {i.name: i for i in self.cache.get_node_infos()}
+        if self._node_lister is not None:
+            for node in self._node_lister():
+                if node.name not in infos and nodeutils.is_tpu_sharing_node(node):
+                    built = self.cache.get_node_info(node.name)
+                    if built is not None:
+                        infos[built.name] = built
+        return {"nodes": [self._build_node(i)
+                          for _, i in sorted(infos.items())]}

@@ -1,0 +1,74 @@
+"""Filter (predicate) handler.
+
+Counterpart of the reference's ``pkg/scheduler/predicate.go`` +
+``gpushare-predicate.go``: a generic named predicate looping candidate
+nodes, with the TPU-share admission check bound over the cache. Pure read
+path — no apiserver round-trips (SURVEY.md §3.2).
+
+Unlike the reference it accepts both wire forms (``NodeNames`` when the
+scheduler is ``nodeCacheCapable``, full ``Nodes`` otherwise — defect 8),
+and understands gang pods: a gang pod passes a node only if the node can
+host it, while the all-or-nothing decision is made by the gang planner at
+bind time.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpushare.api.extender import ExtenderArgs, ExtenderFilterResult
+from tpushare.cache.cache import SchedulerCache
+from tpushare.utils import node as nodeutils
+from tpushare.utils import pod as podutils
+
+log = logging.getLogger(__name__)
+
+
+class Predicate:
+    name = "tpushare-filter"
+
+    def __init__(self, cache: SchedulerCache):
+        self.cache = cache
+
+    def filter_node(self, pod, node_name: str) -> tuple[bool, str]:
+        """The per-node admission check (reference
+        gpushare-predicate.go:16-37)."""
+        info = self.cache.get_node_info(node_name)
+        if info is None:
+            return False, f"unknown node {node_name}"
+        if not nodeutils.is_tpu_sharing_node(info.node):
+            return False, f"node {node_name} advertises no shareable TPU HBM"
+        ok, reason = info.assume(pod)
+        return ok, reason
+
+    def handle(self, args: ExtenderArgs) -> ExtenderFilterResult:
+        """Loop candidates, partition into schedulable / failed (reference
+        predicate.go:15-39)."""
+        pod = args.pod
+        if not (podutils.is_tpu_sharing_pod(pod) or podutils.is_tpu_chip_pod(pod)):
+            # Not ours: pass everything through untouched.
+            return ExtenderFilterResult(
+                node_names=args.node_names, nodes=args.nodes, failed_nodes={}
+            )
+
+        passed_names: list[str] = []
+        passed_nodes: list = []
+        failed: dict[str, str] = {}
+        for name in args.candidate_names():
+            ok, reason = self.filter_node(pod, name)
+            if ok:
+                passed_names.append(name)
+            else:
+                failed[name] = reason
+        if args.nodes is not None:
+            by_name = {n.name: n for n in args.nodes}
+            passed_nodes = [by_name[n] for n in passed_names if n in by_name]
+        log.debug(
+            "filter pod %s: %d passed, %d failed",
+            pod.key(), len(passed_names), len(failed),
+        )
+        return ExtenderFilterResult(
+            node_names=passed_names if args.node_names is not None else None,
+            nodes=passed_nodes if args.nodes is not None else None,
+            failed_nodes=failed,
+        )

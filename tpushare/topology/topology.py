@@ -1,0 +1,152 @@
+"""TPU topology model: chip coordinates, ICI adjacency, compact selection.
+
+This layer has no counterpart in the reference, which treated a node as a
+flat ``map[int]*DeviceInfo`` (``nodeinfo.go:22``) because CUDA devices on
+one host are interchangeable. TPU chips are not: they sit on an ICI mesh
+(v5e hosts are 2x2 or 2x4; v5p hosts are 2x2x1 blocks of a 3D torus), and
+a multi-chip placement that is ICI-contiguous runs collectives over ICI
+instead of DCN. The bin-packer uses this module to (a) pick compact chip
+sets for multi-chip pods and (b) tie-break equally-tight single-chip fits
+toward chips whose neighbors are free (keeping contiguous holes open for
+future multi-chip pods).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from itertools import combinations
+
+#: Per-chip HBM GiB by generation (public specs; used by discovery when the
+#: runtime does not report memory directly).
+CHIP_HBM_GIB = {
+    "v2": 8,
+    "v3": 16,
+    "v4": 32,
+    "v5e": 16,
+    "v5p": 95,
+    "v6e": 32,
+}
+
+#: Chips per host by generation (typical GKE machine shapes).
+DEFAULT_HOST_TOPOLOGY = {
+    "v4": "2x2x1",
+    "v5e": "2x2x1",
+    "v5p": "2x2x1",
+    "v6e": "2x2x1",
+}
+
+
+def parse_topology(spec: str) -> tuple[int, ...]:
+    """Parse "2x2x1" → (2, 2, 1). Raises ValueError on malformed specs."""
+    parts = spec.lower().split("x")
+    dims = tuple(int(p) for p in parts)
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"invalid topology spec: {spec!r}")
+    return dims
+
+
+@dataclass(frozen=True)
+class Topology:
+    """An ICI mesh/torus of chips, indexed row-major over coordinates."""
+
+    dims: tuple[int, ...]
+    torus: bool = False  # v5p 3D tori wrap; single-host meshes do not
+
+    @classmethod
+    def from_spec(cls, spec: str, tpu_type: str = "") -> "Topology":
+        dims = parse_topology(spec)
+        # Wraparound links only exist on pod-scale v5p/v4 tori where every
+        # dimension is a multiple of 4; host-scale blocks are plain meshes.
+        torus = tpu_type in ("v4", "v5p") and all(d >= 4 for d in dims)
+        return cls(dims=dims, torus=torus)
+
+    @classmethod
+    def flat(cls, count: int) -> "Topology":
+        """Degenerate 1-D topology for hosts with unknown wiring."""
+        return cls(dims=(max(count, 0),))
+
+    @property
+    def chip_count(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def coords(self, idx: int) -> tuple[int, ...]:
+        """Row-major index → coordinate tuple."""
+        if not 0 <= idx < self.chip_count:
+            raise IndexError(idx)
+        out = []
+        for d in reversed(self.dims):
+            out.append(idx % d)
+            idx //= d
+        return tuple(reversed(out))
+
+    def index(self, coords: tuple[int, ...]) -> int:
+        idx = 0
+        for c, d in zip(coords, self.dims):
+            idx = idx * d + c
+        return idx
+
+    def distance(self, a: int, b: int) -> int:
+        """ICI hop distance (Manhattan on the mesh, wrapped on a torus)."""
+        ca, cb = self.coords(a), self.coords(b)
+        total = 0
+        for x, y, d in zip(ca, cb, self.dims):
+            delta = abs(x - y)
+            if self.torus:
+                delta = min(delta, d - delta)
+            total += delta
+        return total
+
+    def neighbors(self, idx: int) -> list[int]:
+        """Chips one ICI hop away."""
+        base = self.coords(idx)
+        out = []
+        for axis, d in enumerate(self.dims):
+            if d == 1:
+                continue
+            for step in (-1, 1):
+                c = base[axis] + step
+                if self.torus:
+                    c %= d
+                elif not 0 <= c < d:
+                    continue
+                coords = base[:axis] + (c,) + base[axis + 1:]
+                nb = self.index(coords)
+                if nb != idx and nb not in out:
+                    out.append(nb)
+        return out
+
+    def dispersion(self, chip_ids: list[int]) -> int:
+        """Sum of pairwise ICI distances — lower is more compact."""
+        return sum(self.distance(a, b) for a, b in combinations(chip_ids, 2))
+
+    def select_compact(self, free: list[int], k: int) -> list[int] | None:
+        """Choose ``k`` chips from ``free`` minimizing ICI dispersion.
+
+        Greedy with every free chip as seed (host-scale chip counts are
+        small — ≤16 — so this is effectively exact and O(n^3)).
+        Returns None when fewer than ``k`` chips are free.
+        """
+        if k <= 0 or len(free) < k:
+            return None
+        if k == 1:
+            return [free[0]]
+        best: list[int] | None = None
+        best_cost = None
+        for seed in free:
+            chosen = [seed]
+            pool = [c for c in free if c != seed]
+            while len(chosen) < k:
+                nxt = min(pool, key=lambda c: sum(self.distance(c, x) for x in chosen))
+                chosen.append(nxt)
+                pool.remove(nxt)
+            cost = self.dispersion(chosen)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = chosen, cost
+        return sorted(best) if best else None
+
+    def free_neighbor_count(self, idx: int, free: set[int]) -> int:
+        """How many of ``idx``'s ICI neighbors are in ``free``."""
+        return sum(1 for nb in self.neighbors(idx) if nb in free)

@@ -1,0 +1,1 @@
+"""tpushare.utils subpackage."""

@@ -1,0 +1,110 @@
+"""Protocol constants: extended-resource names and the annotation schema.
+
+This is the convention layer of the whole system (counterpart of the
+reference's ``pkg/utils/const.go:4-12``): every other layer reads and
+writes pods/nodes only through these names. The scheduler extender, the
+device plugin, and the workload runtime all agree on them.
+
+Differences from the reference, by design:
+
+* Resources are TPU-native: HBM gibibytes and chip count, advertised by
+  the tpushare device plugin (no NVML / NVIDIA anywhere).
+* The annotation schema is namespaced (``tpushare.io/...``) instead of
+  env-var-shaped keys, and adds node-side annotations for per-chip
+  capacities (heterogeneous chips are supported; the reference assumed
+  homogeneous devices, ``nodeinfo.go:33-35``) and ICI topology.
+* Gang scheduling (absent from the reference, which caps every pod at a
+  single device — ``docs/designs/designs.md:36``) gets pod-group keys.
+"""
+
+# --------------------------------------------------------------------------
+# Extended resources (counterpart of reference const.go:4-5:
+#   "shared-gpu/gpu-mem" / "shared-gpu/gpu-count")
+# --------------------------------------------------------------------------
+
+#: HBM request/capacity, in GiB. A pod asks for N GiB of a single chip's HBM.
+HBM_RESOURCE = "tpushare.io/tpu-hbm"
+
+#: Whole-chip request/capacity. A pod asking for chips (not HBM slices) uses
+#: this; the device plugin advertises the chip count of the host.
+CHIP_RESOURCE = "tpushare.io/tpu-chip"
+
+# --------------------------------------------------------------------------
+# Pod annotations written by the extender at bind time (counterpart of
+# reference const.go:8-12 SHARED_GPU_MEM_{IDX,POD,DEV,ASSIGNED,ASSUME_TIME}).
+# These are the durable state of the whole system: the ledger is rebuilt
+# from them on restart (reference cache.go:49-74).
+# --------------------------------------------------------------------------
+
+#: Chip index (or comma-separated indices for multi-chip pods) on the node.
+ANN_CHIP_IDX = "tpushare.io/chip-idx"
+
+#: HBM GiB granted to the pod.
+ANN_HBM_POD = "tpushare.io/hbm-pod"
+
+#: Total HBM GiB of the granted chip (workloads derive their memory fraction
+#: from hbm-pod / hbm-chip).
+ANN_HBM_CHIP = "tpushare.io/hbm-chip"
+
+#: Two-phase flag: extender writes "false"; the device plugin flips it to
+#: "true" once kubelet Allocate() actually pins the chip.
+ANN_ASSIGNED = "tpushare.io/assigned"
+
+#: Nanosecond timestamp when the extender assumed the pod; orders the device
+#: plugin's matching of pending pods (reference pod.go:198-203).
+ANN_ASSUME_TIME = "tpushare.io/assume-time"
+
+# --------------------------------------------------------------------------
+# Node annotations (new — the reference had no node-side schema beyond the
+# capacity numbers and so could not express heterogeneity or topology).
+# --------------------------------------------------------------------------
+
+#: Comma-separated per-chip HBM GiB, e.g. "95,95,95,95". Optional: when
+#: absent, capacity is split equally across chips like the reference did.
+ANN_NODE_CHIP_HBM = "tpushare.io/chip-hbm"
+
+#: Physical chip topology of the host/slice, e.g. "2x2x1" (v5e host) or
+#: "2x2x2" (v5p host in a 3D torus). Drives ICI-aware packing.
+ANN_NODE_TOPOLOGY = "tpushare.io/topology"
+
+#: TPU generation label value, e.g. "v5e", "v5p", "v6e".
+ANN_NODE_TPU_TYPE = "tpushare.io/tpu-type"
+
+# GKE well-known labels used as a discovery fallback by the device plugin.
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+
+# --------------------------------------------------------------------------
+# Gang scheduling (pod groups spanning a multi-host slice).
+# --------------------------------------------------------------------------
+
+#: Name of the pod group this pod belongs to (same namespace).
+ANN_POD_GROUP = "tpushare.io/pod-group"
+
+#: Minimum number of group members that must be placeable before any member
+#: is bound (all-or-nothing admission).
+ANN_POD_GROUP_MIN = "tpushare.io/pod-group-min"
+
+# --------------------------------------------------------------------------
+# Environment variables injected into containers by the device plugin at
+# Allocate() time (counterpart of the reference's SHARED_GPU_MEM_* env
+# consumed by samples/docker/run.sh; ours speak JAX/XLA natively).
+# --------------------------------------------------------------------------
+
+ENV_CHIP_IDX = "TPUSHARE_CHIP_IDX"
+ENV_HBM_POD = "TPUSHARE_HBM_POD_GIB"
+ENV_HBM_CHIP = "TPUSHARE_HBM_CHIP_GIB"
+
+#: Standard knobs JAX/XLA honor: restrict the process to its granted chip(s)
+#: and cap the premapped HBM pool to the granted fraction.
+ENV_TPU_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"
+ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
+ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
+ENV_XLA_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+
+#: Value used for ANN_ASSIGNED.
+ASSIGNED_FALSE = "false"
+ASSIGNED_TRUE = "true"
+
+#: Sentinel chip index meaning "no assignment recorded".
+NO_CHIP = -1

@@ -1,0 +1,83 @@
+"""Node-level protocol helpers.
+
+Counterpart of the reference's ``pkg/utils/node.go:6-30``, extended with
+per-chip capacities and topology (the reference's homogeneous-device
+assumption — per-device mem = node total / count, ``nodeinfo.go:33-35`` —
+is kept only as the fallback when the device plugin publishes no per-chip
+annotation).
+"""
+
+from __future__ import annotations
+
+from tpushare.api.objects import Node
+from tpushare.utils import const
+
+
+def is_tpu_sharing_node(node: Node) -> bool:
+    """Node advertises shareable HBM (reference ``IsGPUSharingNode``,
+    node.go:6-8)."""
+    return get_total_hbm(node) > 0
+
+
+def get_total_hbm(node: Node) -> int:
+    """Total shareable HBM GiB on the node (reference ``GetTotalGPUMemory``,
+    node.go:11-19)."""
+    return node.capacity_of(const.HBM_RESOURCE)
+
+
+def get_chip_count(node: Node) -> int:
+    """Number of TPU chips on the node (reference ``GetGPUCountInNode``,
+    node.go:22-30)."""
+    return node.capacity_of(const.CHIP_RESOURCE)
+
+
+def get_chip_capacities(node: Node) -> list[int]:
+    """Per-chip HBM GiB.
+
+    Prefers the device plugin's ``tpushare.io/chip-hbm`` annotation (which
+    supports heterogeneous chips); falls back to an equal split of the node
+    total, like the reference did unconditionally.
+    """
+    count = get_chip_count(node)
+    total = get_total_hbm(node)
+    ann = node.annotations.get(const.ANN_NODE_CHIP_HBM)
+    if ann:
+        try:
+            caps = [int(part) for part in str(ann).split(",") if part != ""]
+        except ValueError:
+            caps = []
+        if caps and all(c > 0 for c in caps):
+            return caps
+    if count <= 0:
+        return []
+    return [total // count] * count
+
+
+def get_topology(node: Node) -> str:
+    """Physical chip topology string, e.g. "2x2x1"; empty when unknown.
+
+    Reads the tpushare annotation first, then the GKE well-known label
+    (SURVEY.md §5 'distributed communication backend' TPU mapping).
+    """
+    topo = node.annotations.get(const.ANN_NODE_TOPOLOGY, "")
+    if topo:
+        return topo
+    return node.labels.get(const.GKE_TPU_TOPOLOGY_LABEL, "")
+
+
+def get_tpu_type(node: Node) -> str:
+    """TPU generation, e.g. "v5e" / "v5p"; empty when unknown."""
+    t = node.annotations.get(const.ANN_NODE_TPU_TYPE, "")
+    if t:
+        return t
+    accel = node.labels.get(const.GKE_TPU_ACCELERATOR_LABEL, "")
+    # e.g. "tpu-v5-lite-podslice" → "v5e", "tpu-v5p-slice" → "v5p"
+    if "v5-lite" in accel or "v5e" in accel:
+        return "v5e"
+    if "v5p" in accel:
+        return "v5p"
+    if "v6e" in accel or "trillium" in accel:
+        return "v6e"
+    if "v4" in accel:
+        return "v4"
+    return ""

@@ -1,0 +1,183 @@
+"""Pod-level protocol helpers: classifiers, readers, and writers.
+
+Counterpart of the reference's ``pkg/utils/pod.go``. Everything the
+scheduler and device plugin know about a pod flows through these pure
+functions, so the annotation schema stays in one place.
+
+Deliberate fixes over the reference (SURVEY.md §2 defect list):
+
+* ``pod_used_hbm`` treats deletion-timestamped pods as terminated, unlike
+  ``GetUsedGPUMemory`` (``deviceinfo.go:46``) which only skipped
+  Succeeded/Failed and so double-counted terminating pods against
+  capacity.
+* Multi-chip assignments are first-class (comma-separated chip indices),
+  enabling whole-chip and gang placements the reference could not express
+  (it capped requests at one device, ``docs/designs/designs.md:36``).
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpushare.api.objects import Pod
+from tpushare.utils import const
+
+
+# --------------------------------------------------------------------------
+# Classifiers (reference pod.go:13-42)
+# --------------------------------------------------------------------------
+
+def is_complete_pod(pod: Pod) -> bool:
+    """True if the pod no longer consumes resources: terminated phase or
+    marked for deletion (reference ``IsCompletePod``, pod.go:28-37)."""
+    if pod.deletion_timestamp:
+        return True
+    return pod.phase in ("Succeeded", "Failed")
+
+
+def is_assigned_non_terminated(pod: Pod) -> bool:
+    """Scheduled onto a node and still running (reference
+    ``AssignedNonTerminatedPod``, pod.go:13-25)."""
+    if pod.deletion_timestamp:
+        return False
+    if not pod.node_name:
+        return False
+    return pod.phase not in ("Succeeded", "Failed")
+
+
+def is_tpu_sharing_pod(pod: Pod) -> bool:
+    """Pod participates in HBM sharing (requests tpu-hbm) — reference
+    ``IsGPUsharingPod``, pod.go:40-42."""
+    return get_hbm_from_pod_resource(pod) > 0
+
+
+def is_tpu_chip_pod(pod: Pod) -> bool:
+    """Pod requests whole chips rather than an HBM slice."""
+    return get_chips_from_pod_resource(pod) > 0
+
+
+def is_gang_pod(pod: Pod) -> bool:
+    return const.ANN_POD_GROUP in pod.annotations
+
+
+# --------------------------------------------------------------------------
+# Resource readers (reference pod.go:145-155)
+# --------------------------------------------------------------------------
+
+def get_hbm_from_pod_resource(pod: Pod) -> int:
+    """Sum of ``tpu-hbm`` limits across containers, GiB."""
+    return sum(pod.iter_resource_limits(const.HBM_RESOURCE))
+
+
+def get_chips_from_pod_resource(pod: Pod) -> int:
+    """Sum of whole-chip limits across containers."""
+    return sum(pod.iter_resource_limits(const.CHIP_RESOURCE))
+
+
+# --------------------------------------------------------------------------
+# Annotation readers (reference pod.go:45-113)
+# --------------------------------------------------------------------------
+
+def get_chip_ids_from_annotation(pod: Pod) -> list[int]:
+    """Granted chip indices, or [] when unassigned/invalid."""
+    value = pod.annotations.get(const.ANN_CHIP_IDX)
+    if value is None:
+        return []
+    try:
+        ids = [int(part) for part in str(value).split(",") if part != ""]
+    except ValueError:
+        return []
+    return [i for i in ids if i >= 0]
+
+
+def get_chip_id_from_annotation(pod: Pod) -> int:
+    """First granted chip index or NO_CHIP (reference
+    ``GetGPUIDFromAnnotation``, pod.go:45-60)."""
+    ids = get_chip_ids_from_annotation(pod)
+    return ids[0] if ids else const.NO_CHIP
+
+
+def get_hbm_from_pod_annotation(pod: Pod) -> int:
+    """Granted HBM GiB recorded at bind time (reference
+    ``GetGPUMemoryFromPodAnnotation``, pod.go:94-113)."""
+    value = pod.annotations.get(const.ANN_HBM_POD)
+    if value is None:
+        return 0
+    try:
+        hbm = int(value)
+    except ValueError:
+        return 0
+    return max(hbm, 0)
+
+
+def get_assume_time(pod: Pod) -> int:
+    """Nanosecond assume timestamp, or 0 when absent."""
+    value = pod.annotations.get(const.ANN_ASSUME_TIME)
+    try:
+        return int(value) if value is not None else 0
+    except ValueError:
+        return 0
+
+
+def is_assumed(pod: Pod) -> bool:
+    """Extender has placed the pod (annotation present, any flag value)."""
+    return const.ANN_CHIP_IDX in pod.annotations
+
+
+def is_assigned(pod: Pod) -> bool:
+    """Device plugin has confirmed the placement (two-phase commit done)."""
+    return pod.annotations.get(const.ANN_ASSIGNED) == const.ASSIGNED_TRUE
+
+
+def get_pod_group(pod: Pod) -> tuple[str, int]:
+    """(group name, min members) or ("", 0) for non-gang pods."""
+    group = pod.annotations.get(const.ANN_POD_GROUP, "")
+    if not group:
+        return "", 0
+    try:
+        minimum = int(pod.annotations.get(const.ANN_POD_GROUP_MIN, "0"))
+    except ValueError:
+        minimum = 0
+    return group, max(minimum, 0)
+
+
+def pod_used_hbm(pod: Pod) -> int:
+    """HBM this pod currently holds against a chip's capacity.
+
+    Zero for complete pods — including deletion-timestamped ones, fixing
+    reference defect 6 (``deviceinfo.go:46`` vs ``inspect.go:49``).
+    """
+    if is_complete_pod(pod):
+        return 0
+    return get_hbm_from_pod_annotation(pod)
+
+
+# --------------------------------------------------------------------------
+# Writers (reference pod.go:192-206)
+# --------------------------------------------------------------------------
+
+def updated_pod_annotation_spec(
+    pod: Pod,
+    chip_ids: list[int],
+    hbm_pod: int,
+    hbm_chip: int,
+    assume_time_ns: int | None = None,
+) -> Pod:
+    """Deep-copy ``pod`` with the bind-time annotation set applied.
+
+    Writes chip index/indices, granted HBM, chip HBM, assigned=false, and
+    the nanosecond assume time — the durable commit record the ledger is
+    rebuilt from on restart and the device plugin matches on (reference
+    ``GetUpdatedPodAnnotationSpec``, pod.go:192-206).
+    """
+    new_pod = pod.deepcopy()
+    ann = new_pod.metadata.setdefault("annotations", {})
+    if ann is None:  # metadata.annotations may be explicit null
+        ann = new_pod.metadata["annotations"] = {}
+    now_ns = time.time_ns() if assume_time_ns is None else assume_time_ns
+    ann[const.ANN_CHIP_IDX] = ",".join(str(i) for i in chip_ids)
+    ann[const.ANN_HBM_POD] = str(hbm_pod)
+    ann[const.ANN_HBM_CHIP] = str(hbm_chip)
+    ann[const.ANN_ASSIGNED] = const.ASSIGNED_FALSE
+    ann[const.ANN_ASSUME_TIME] = str(now_ns)
+    return new_pod

@@ -1,0 +1,178 @@
+"""Flagship workload: a TPU-first transformer LM in pure JAX.
+
+This is the framework's counterpart of the reference's probe workload
+(``samples/docker/main.py`` — a TF matmul loop that honored the injected
+GPU memory fraction): a real model that runs under the scheduler's env
+contract (:mod:`tpushare.runtime.jaxenv`) and demonstrates the sharing
+story end-to-end — several of these packed per chip, or one spanning a
+gang-scheduled slice.
+
+TPU-first choices: bfloat16 params/activations (MXU-native), fused
+projections (large matmuls, no per-head loops), rotary embeddings
+computed with static shapes, RMSNorm + SwiGLU as fusable elementwise
+chains, and no data-dependent Python control flow anywhere under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 1536
+    max_seq_len: int = 2048
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = True  # jax.checkpoint each block: HBM for FLOPs
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def tiny(self) -> "ModelConfig":
+        return dataclasses.replace(
+            self, vocab_size=256, d_model=64, n_heads=4, n_layers=2,
+            d_ff=128, max_seq_len=128)
+
+
+# --------------------------------------------------------------------------
+# Parameters
+# --------------------------------------------------------------------------
+
+def init_params(key: jax.Array, cfg: ModelConfig) -> dict:
+    """Initialize the parameter pytree.
+
+    Layout is chosen for tensor parallelism: qkv/out projections carry an
+    explicit head axis, and ffn weights put the sharded (hidden) axis
+    last/first consistently so tp sharding rules are pure tree-path
+    pattern matches (see parallel.shard_rules).
+    """
+    keys = iter(jax.random.split(key, 4 + 6 * cfg.n_layers))
+    dt = cfg.dtype
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                / math.sqrt(fan_in)).astype(dt)
+
+    params: dict = {
+        "embed": dense(next(keys), (cfg.vocab_size, cfg.d_model), cfg.d_model),
+        "final_norm": jnp.ones((cfg.d_model,), dt),
+        "blocks": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["blocks"].append({
+            "attn_norm": jnp.ones((cfg.d_model,), dt),
+            "wqkv": dense(next(keys),
+                          (cfg.d_model, 3, cfg.n_heads, cfg.head_dim),
+                          cfg.d_model),
+            "wo": dense(next(keys), (cfg.n_heads, cfg.head_dim, cfg.d_model),
+                        cfg.d_model),
+            "ffn_norm": jnp.ones((cfg.d_model,), dt),
+            "w_gate": dense(next(keys), (cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w_up": dense(next(keys), (cfg.d_model, cfg.d_ff), cfg.d_model),
+            "w_down": dense(next(keys), (cfg.d_ff, cfg.d_model), cfg.d_ff),
+        })
+    return params
+
+
+def param_count(params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))
+
+
+# --------------------------------------------------------------------------
+# Layers (stateless functions; everything static-shaped and jit-friendly)
+# --------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-6) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale
+
+
+def rotary(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding over the last (head_dim) axis.
+
+    ``positions``: [B, L] absolute positions — passed explicitly so
+    sequence-parallel shards can feed their global offsets.
+    """
+    half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     q_offset: jax.Array | int = 0,
+                     kv_offset: jax.Array | int = 0) -> jax.Array:
+    """Masked attention between (possibly different) Q and KV blocks.
+
+    Shapes: q [B, Lq, H, D], k/v [B, Lk, H, D]. Offsets are the global
+    positions of element 0 of each block, which is what makes this the
+    building block for ring attention (parallel.ring_attention): a causal
+    mask between arbitrary blocks is just a comparison of global indices.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    q_pos = q_offset + jnp.arange(q.shape[1])
+    kv_pos = kv_offset + jnp.arange(k.shape[1])
+    mask = q_pos[:, None] >= kv_pos[None, :]
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention_block(block: dict, x: jax.Array, positions: jax.Array,
+                    attn_fn) -> jax.Array:
+    h = rms_norm(x, block["attn_norm"])
+    qkv = jnp.einsum("bld,dthc->btlhc", h, block["wqkv"])
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    q = rotary(q, positions)
+    k = rotary(k, positions)
+    out = attn_fn(q, k, v)
+    return x + jnp.einsum("blhc,hcd->bld", out, block["wo"])
+
+
+def ffn_block(block: dict, x: jax.Array) -> jax.Array:
+    h = rms_norm(x, block["ffn_norm"])
+    gate = jax.nn.silu(h @ block["w_gate"])
+    out = (gate * (h @ block["w_up"])) @ block["w_down"]
+    return x + out
+
+
+def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,
+            positions: jax.Array | None = None, attn_fn=None) -> jax.Array:
+    """Token ids [B, L] → logits [B, L, vocab].
+
+    ``attn_fn`` defaults to single-device causal attention; the parallel
+    layer swaps in ring attention for sequence-parallel execution.
+    """
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]),
+                                     tokens.shape)
+    if attn_fn is None:
+        attn_fn = causal_attention
+    x = params["embed"][tokens]
+
+    def run_block(x, block):
+        x = attention_block(block, x, positions, attn_fn)
+        return ffn_block(block, x)
+
+    if cfg.remat:
+        run_block = jax.checkpoint(run_block)
+    for block in params["blocks"]:
+        x = run_block(x, block)
+    x = rms_norm(x, params["final_norm"])
+    # fp32 logits for a stable softmax/loss
+    return jnp.einsum("bld,vd->blv", x, params["embed"]).astype(jnp.float32)

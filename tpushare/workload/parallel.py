@@ -1,0 +1,197 @@
+"""Parallelism layer: device mesh, sharding rules, ring attention.
+
+SPMD over a ``jax.sharding.Mesh`` with named axes:
+
+* ``dp`` — data parallel (batch axis; gradients all-reduce over ICI)
+* ``tp`` — tensor parallel (heads / ffn-hidden axes of every weight)
+* ``sp`` — sequence/context parallel (sequence axis of activations;
+  attention runs as a ring over ``sp`` with ``ppermute`` rotating KV
+  blocks — long-context support without materializing full attention)
+
+The reference scheduler never touched tensors (SURVEY.md §2 parallelism
+note); this module is the *workload-side* capability that makes the
+scheduler's gang/topology features meaningful: a gang-scheduled slice
+runs one of these meshes across hosts, with XLA inserting ICI
+collectives.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpushare.workload import model as M
+
+
+# --------------------------------------------------------------------------
+# Mesh construction
+# --------------------------------------------------------------------------
+
+def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
+              devices=None) -> Mesh:
+    """Build a (dp, tp, sp) mesh over ``devices`` (default: all)."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * tp * sp
+    if len(devices) < need:
+        raise ValueError(f"mesh {dp}x{tp}x{sp} needs {need} devices, "
+                         f"have {len(devices)}")
+    import numpy as np
+    arr = np.array(devices[:need]).reshape(dp, tp, sp)
+    return Mesh(arr, ("dp", "tp", "sp"))
+
+
+def auto_mesh_shape(n: int) -> tuple[int, int, int]:
+    """Factor ``n`` devices into a balanced (dp, tp, sp) shape."""
+    best = (n, 1, 1)
+    best_score = None
+    for tp in range(1, n + 1):
+        if n % tp:
+            continue
+        rest = n // tp
+        for sp in range(1, rest + 1):
+            if rest % sp:
+                continue
+            dp = rest // sp
+            score = abs(math.log(max(dp, 1)) - math.log(max(tp, 1))) + \
+                abs(math.log(max(tp, 1)) - math.log(max(sp, 1)))
+            if best_score is None or score < best_score:
+                best, best_score = (dp, tp, sp), score
+    return best
+
+
+# --------------------------------------------------------------------------
+# Sharding rules (params + activations)
+# --------------------------------------------------------------------------
+
+def param_spec(path: str) -> P:
+    """Tree-path → PartitionSpec. TP shards the head axis of attention
+    weights and the hidden axis of ffn weights; everything else is
+    replicated (norms) or vocab-sharded (embedding)."""
+    if path.endswith("embed"):
+        return P(None, None)  # replicated: vocab gather stays local
+    if "wqkv" in path:
+        return P(None, None, "tp", None)   # [d, 3, heads/tp, head_dim]
+    if "wo" in path:
+        return P("tp", None, None)         # [heads/tp, head_dim, d]
+    if "w_gate" in path or "w_up" in path:
+        return P(None, "tp")               # [d, ff/tp]
+    if "w_down" in path:
+        return P("tp", None)               # [ff/tp, d]
+    return P()  # norms
+
+
+def param_shardings(mesh: Mesh, params) -> dict:
+    """Pytree of NamedShardings matching ``params``."""
+    def to_sharding(path_tuple, _leaf):
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path_tuple)
+        return NamedSharding(mesh, param_spec(path))
+    return jax.tree_util.tree_map_with_path(to_sharding, params)
+
+
+def batch_spec() -> P:
+    """Tokens/targets: batch over dp, sequence over sp."""
+    return P("dp", "sp")
+
+
+def activation_spec() -> P:
+    return P("dp", "sp", None)
+
+
+# --------------------------------------------------------------------------
+# Ring attention (sequence parallelism over the 'sp' axis)
+# --------------------------------------------------------------------------
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = "sp",
+                   vary_axes: tuple[str, ...] | None = None) -> jax.Array:
+    """Causal attention with the sequence sharded over ``axis_name``.
+
+    Each device holds one block of Q/K/V ([B, L/sp, H, D]). KV blocks
+    rotate around the ring with ``ppermute`` while each device
+    accumulates its Q-block's output in online-softmax form (running max
+    ``m``, normalizer ``l``, weighted accumulator ``acc``), so the full
+    [L, L] score matrix never materializes — the standard ring/flash
+    decomposition (Liu et al., Ring Attention; blockwise parallel
+    transformers), expressed with XLA collectives so it rides ICI.
+
+    Must be called inside shard_map with ``axis_name`` bound.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q32 = q.astype(jnp.float32)
+    acc0 = jnp.zeros((b, h, lq, d), jnp.float32)
+    m0 = jnp.full((b, h, lq), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    if vary_axes:
+        # Align the varying-manual-axes type of the fresh carries with the
+        # loop outputs (required by shard_map's typed collectives).
+        try:
+            acc0, m0, l0 = (jax.lax.pcast(x, vary_axes, to="varying")
+                            for x in (acc0, m0, l0))
+        except (AttributeError, TypeError):  # pragma: no cover - older jax
+            acc0, m0, l0 = (jax.lax.pvary(x, vary_axes)
+                            for x in (acc0, m0, l0))
+
+    def step(carry, _):
+        k_blk, v_blk, acc, m, l, src = carry
+        q_off = idx * lq
+        kv_off = src * lq
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_blk.astype(jnp.float32)) * scale
+        q_pos = q_off + jnp.arange(lq)
+        kv_pos = kv_off + jnp.arange(k_blk.shape[1])
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, -1e30)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        src_next = (src - 1) % n  # after rotation we hold our left
+        return (k_next, v_next, acc, m_new, l, src_next), None
+
+    (_, _, acc, _, l, _), _ = jax.lax.scan(
+        step, (k, v, acc0, m0, l0, idx), None, length=n)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]       # [B, H, Lq, D]
+    return out.transpose(0, 2, 1, 3).astype(v.dtype)   # [B, Lq, H, D]
+
+
+def make_ring_attn_fn(mesh: Mesh):
+    """Wrap ring_attention in shard_map so it can slot in as the model's
+    ``attn_fn`` (heads sharded over tp, sequence over sp, batch over dp)."""
+    qkv_spec = P("dp", "sp", "tp", None)
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(qkv_spec, qkv_spec, qkv_spec),
+             out_specs=qkv_spec)
+    def attn(q, k, v):
+        return ring_attention(q, k, v, axis_name="sp",
+                              vary_axes=mesh.axis_names)
+
+    return attn
+
+
+def global_positions(mesh: Mesh, batch: int, seq: int) -> jax.Array:
+    """[B, L] absolute positions, sharded like the tokens, so each sp
+    shard applies rotary with its global offset."""
+    pos = jnp.broadcast_to(jnp.arange(seq), (batch, seq))
+    return jax.device_put(
+        pos, NamedSharding(mesh, batch_spec()))

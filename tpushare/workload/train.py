@@ -1,0 +1,80 @@
+"""Training/inference steps over the sharded model.
+
+The full train step — forward, loss, backward, optimizer update — is one
+jit region over the mesh: parameters keep their tp shardings, the batch
+is dp×sp sharded, and XLA inserts the gradient all-reduces over ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding
+
+from tpushare.workload import model as M
+from tpushare.workload import parallel as par
+
+
+def loss_fn(params, tokens, targets, cfg: M.ModelConfig,
+            positions=None, attn_fn=None):
+    logits = M.forward(params, tokens, cfg, positions=positions,
+                       attn_fn=attn_fn)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_optimizer(lr: float = 3e-4):
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def make_train_step(cfg: M.ModelConfig, mesh=None, optimizer=None,
+                    use_ring_attention: bool = True):
+    """Build (init_fn, step_fn).
+
+    With a mesh: params/opt-state land in their tp shardings, batches in
+    (dp, sp), and attention runs as the sp ring. Without: plain
+    single-device jit (the form the scheduler's HBM-sharing pods run).
+    """
+    optimizer = optimizer or make_optimizer()
+    attn_fn = par.make_ring_attn_fn(mesh) if (mesh is not None and
+                                              use_ring_attention) else None
+
+    def init_fn(key, example_tokens):
+        params = M.init_params(key, cfg)
+        if mesh is not None:
+            params = jax.device_put(params, par.param_shardings(mesh, params))
+        opt_state = optimizer.init(params)
+        return params, opt_state
+
+    def step(params, opt_state, tokens, targets, positions=None):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, targets, cfg, positions=positions,
+            attn_fn=attn_fn)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if mesh is not None:
+        batch_sharding = NamedSharding(mesh, par.batch_spec())
+
+        def place_batch(tokens, targets):
+            return (jax.device_put(tokens, batch_sharding),
+                    jax.device_put(targets, batch_sharding))
+
+        step = jax.jit(step, donate_argnums=(0, 1))
+        return init_fn, step, place_batch
+
+    step = jax.jit(step, donate_argnums=(0, 1))
+    return init_fn, step, lambda t, g: (t, g)
+
+
+def make_forward_fn(cfg: M.ModelConfig):
+    """Jittable single-device forward (the graft entry surface)."""
+    @jax.jit
+    def fwd(params, tokens):
+        return M.forward(params, tokens, cfg)
+    return fwd
