@@ -1,0 +1,159 @@
+// tpudisc — native TPU chip discovery shim.
+//
+// TPU-native counterpart of the NVML enumeration the reference system's
+// device plugin performs (reference docs/designs/designs.md:53-61: the
+// gpushare device plugin asks NVML for device count + per-device memory).
+// TPUs have no NVML; chips surface as Linux accel devices (/dev/accel*)
+// backed by the Google PCI vendor, with metadata in sysfs. This shim
+// enumerates them through raw filesystem + PCI config reads — the layer
+// below what Python can do portably — and exposes a tiny C ABI consumed
+// from Python via ctypes (tpushare/deviceplugin/discovery.py).
+//
+// Both filesystem roots are parameters so tests can point the shim at a
+// synthetic tree; production passes "/dev" and "/sys".
+//
+// Build: `make -C native` → libtpudisc.so (g++, no external deps).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <string>
+
+extern "C" {
+
+// Keep in sync with the ctypes.Structure in deviceplugin/discovery.py.
+struct TpudiscChip {
+  int32_t index;          // chip index on the host (accelN -> N)
+  int32_t pci_vendor;     // PCI vendor id (0x1ae0 == Google) or 0
+  int32_t pci_device;     // PCI device id or 0
+  int32_t numa_node;      // NUMA node or -1
+  int64_t hbm_bytes;      // HBM bytes if the driver exports it, else 0
+  char device_path[128];  // e.g. "/dev/accel3"
+  char chip_type[32];     // e.g. "v5p" when identifiable, else ""
+};
+
+const char* tpudisc_version(void) { return "tpudisc/1.0"; }
+
+}  // extern "C"
+
+namespace {
+
+// Read a whole small file into `out`; false when unreadable.
+bool ReadFileString(const std::string& path, std::string* out) {
+  FILE* f = std::fopen(path.c_str(), "r");
+  if (f == nullptr) return false;
+  char buf[256];
+  size_t n = std::fread(buf, 1, sizeof(buf) - 1, f);
+  std::fclose(f);
+  buf[n] = '\0';
+  // Trim trailing whitespace/newline.
+  while (n > 0 && (buf[n - 1] == '\n' || buf[n - 1] == ' ')) buf[--n] = '\0';
+  *out = buf;
+  return true;
+}
+
+bool ReadFileHex(const std::string& path, int32_t* out) {
+  std::string s;
+  if (!ReadFileString(path, &s)) return false;
+  return std::sscanf(s.c_str(), "%x", reinterpret_cast<unsigned*>(out)) == 1;
+}
+
+bool ReadFileInt64(const std::string& path, int64_t* out) {
+  std::string s;
+  if (!ReadFileString(path, &s)) return false;
+  return std::sscanf(s.c_str(), "%lld", reinterpret_cast<long long*>(out)) == 1;
+}
+
+// PCI device-id -> chip generation. Google's TPU PCI ids are visible on
+// any TPU VM via lspci; unknown ids simply leave chip_type empty and the
+// Python layer falls back to env/labels.
+const char* ChipTypeFromPciDevice(int32_t vendor, int32_t device) {
+  if (vendor != 0x1ae0) return "";
+  switch (device) {
+    case 0x0056: return "v4";
+    case 0x0062: return "v5e";
+    case 0x0063: return "v5p";
+    case 0x006f: return "v6e";
+    default: return "";
+  }
+}
+
+// Fill sysfs-derived fields for accel<index>.
+void FillFromSysfs(const std::string& sysfs_root, TpudiscChip* chip) {
+  // Linux accel class: /sys/class/accel/accel<N>/device is a symlink to
+  // the PCI function directory holding vendor/device/numa_node.
+  std::string base = sysfs_root + "/class/accel/accel" +
+                     std::to_string(chip->index) + "/device";
+  ReadFileHex(base + "/vendor", &chip->pci_vendor);
+  ReadFileHex(base + "/device", &chip->pci_device);
+  int64_t numa = -1;
+  if (ReadFileInt64(base + "/numa_node", &numa))
+    chip->numa_node = static_cast<int32_t>(numa);
+  // Non-standard but cheap to probe: some driver builds export the HBM
+  // size directly.
+  int64_t hbm = 0;
+  if (ReadFileInt64(base + "/hbm_size", &hbm) ||
+      ReadFileInt64(base + "/accel/hbm_size_bytes", &hbm))
+    chip->hbm_bytes = hbm;
+  std::snprintf(chip->chip_type, sizeof(chip->chip_type), "%s",
+                ChipTypeFromPciDevice(chip->pci_vendor, chip->pci_device));
+}
+
+// Scan one directory for accel<N> entries; returns number appended.
+int ScanDir(const std::string& dir, const std::string& sysfs_root,
+            TpudiscChip* out, int max_chips, int found) {
+  DIR* d = opendir(dir.c_str());
+  if (d == nullptr) return found;
+  struct dirent* ent;
+  while ((ent = readdir(d)) != nullptr && found < max_chips) {
+    int index = -1;
+    if (std::sscanf(ent->d_name, "accel%d", &index) != 1 || index < 0)
+      continue;
+    // Reject names like "accel0foo": require the suffix be pure digits.
+    char expect[32];
+    std::snprintf(expect, sizeof(expect), "accel%d", index);
+    if (std::strcmp(expect, ent->d_name) != 0) continue;
+    bool dup = false;
+    for (int i = 0; i < found; i++)
+      if (out[i].index == index) dup = true;
+    if (dup) continue;
+    TpudiscChip* chip = &out[found];
+    std::memset(chip, 0, sizeof(*chip));
+    chip->index = index;
+    chip->numa_node = -1;
+    std::snprintf(chip->device_path, sizeof(chip->device_path), "%s/%s",
+                  dir.c_str(), ent->d_name);
+    FillFromSysfs(sysfs_root, chip);
+    found++;
+  }
+  closedir(d);
+  return found;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Enumerate TPU chips under devfs_root (+ sysfs metadata). Returns the
+// number of chips written to `out` (sorted by index), 0 when none found,
+// -1 on argument errors. NULL roots default to "/dev" and "/sys".
+int tpudisc_enumerate(TpudiscChip* out, int max_chips,
+                      const char* devfs_root, const char* sysfs_root) {
+  if (out == nullptr || max_chips <= 0) return -1;
+  std::string dev = devfs_root ? devfs_root : "/dev";
+  std::string sys = sysfs_root ? sysfs_root : "/sys";
+  int found = ScanDir(dev, sys, out, max_chips, 0);
+  // Some images expose the accel class under a subdirectory (/dev/accel/accelN).
+  found = ScanDir(dev + "/accel", sys, out, max_chips, found);
+  // Insertion-sort by index (tiny N).
+  for (int i = 1; i < found; i++) {
+    TpudiscChip key = out[i];
+    int j = i - 1;
+    while (j >= 0 && out[j].index > key.index) { out[j + 1] = out[j]; j--; }
+    out[j + 1] = key;
+  }
+  return found;
+}
+
+}  // extern "C"

@@ -1,0 +1,307 @@
+"""Device-plugin tests: discovery chain, advertisement, Allocate matching.
+
+Covers the behavior the reference system specifies for its companion
+device plugin (reference docs/designs/designs.md:53-61,92-104): capacity
+reporting, pod matching by (request size, earliest assume-time), the
+assigned false→true commit, and env injection.
+"""
+
+import subprocess
+import time
+
+import pytest
+
+from tpushare.deviceplugin import discovery as disc
+from tpushare.deviceplugin.plugin import (
+    AllocateError, HBM_DEV_FMT, HEALTHY, UNHEALTHY, TPUSharePlugin)
+from tpushare.k8s.builders import make_node, make_pod
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.utils import const
+
+# --------------------------------------------------------------------------
+# Discovery
+# --------------------------------------------------------------------------
+
+
+def _make_synthetic_tree(tmp_path, chips, vendor="0x1ae0", device="0x0063"):
+    """Fabricate /dev + /sys trees the way a TPU VM exposes them."""
+    dev = tmp_path / "dev"
+    sys = tmp_path / "sys"
+    dev.mkdir()
+    for i in range(chips):
+        (dev / f"accel{i}").write_text("")
+        d = sys / "class" / "accel" / f"accel{i}" / "device"
+        d.mkdir(parents=True)
+        (d / "vendor").write_text(vendor + "\n")
+        (d / "device").write_text(device + "\n")
+        (d / "numa_node").write_text(str(i // 2) + "\n")
+    return str(dev), str(sys)
+
+
+def test_native_shim_enumerates_synthetic_tree(tmp_path):
+    native = disc.NativeDiscovery("/nonexistent", "/nonexistent")
+    if not native.available:
+        subprocess.run(["make", "-C", "native"], check=True,
+                       capture_output=True)
+        native = disc.NativeDiscovery("/nonexistent", "/nonexistent")
+    assert native.available, "libtpudisc.so should build in this image"
+
+    devfs, sysfs = _make_synthetic_tree(tmp_path, chips=4)
+    inv = disc.NativeDiscovery(devfs, sysfs).discover()
+    assert inv is not None and inv.source == "native"
+    assert inv.chip_count == 4
+    # PCI id 0x1ae0/0x0063 -> v5p -> 95 GiB from the spec table.
+    assert inv.tpu_type == "v5p"
+    assert [c.hbm_gib for c in inv.chips] == [95] * 4
+    assert inv.chips[2].numa_node == 1
+    assert inv.chips[3].device_path.endswith("accel3")
+    assert [c.index for c in inv.chips] == [0, 1, 2, 3]
+
+
+def test_native_shim_empty_tree(tmp_path):
+    native = disc.NativeDiscovery(str(tmp_path), str(tmp_path))
+    if native.available:
+        assert native.discover() is None
+
+
+def test_devfs_scan_fallback(tmp_path):
+    devfs, _ = _make_synthetic_tree(tmp_path, chips=2)
+    inv = disc.devfs_scan(devfs, chip_type_hint="v5e")
+    assert inv is not None and inv.source == "devfs"
+    assert inv.chip_count == 2
+    assert inv.total_hbm_gib == 32  # 2 x 16 GiB (v5e)
+    assert disc.devfs_scan(str(tmp_path / "nope")) is None
+
+
+@pytest.mark.parametrize("raw,gen,count", [
+    ("v5litepod-16", "v5e", 16),
+    ("v5p-8", "v5p", 8),
+    ("v4-8", "v4", 4),       # TensorCores -> chips
+    ("v6e-4", "v6e", 4),
+    ("banana", "", 0),
+])
+def test_parse_accelerator_type(raw, gen, count):
+    assert disc.parse_accelerator_type(raw) == (gen, count)
+
+
+def test_env_discover():
+    inv = disc.env_discover({"TPU_ACCELERATOR_TYPE": "v5litepod-4"})
+    assert inv is not None and inv.tpu_type == "v5e" and inv.chip_count == 4
+    assert disc.env_discover({}) is None
+
+
+def test_gke_label_discover():
+    inv = disc.gke_label_discover({
+        const.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5p-slice",
+        const.GKE_TPU_TOPOLOGY_LABEL: "2x2x1",
+    })
+    assert inv is not None
+    assert (inv.tpu_type, inv.chip_count, inv.topology) == ("v5p", 4, "2x2x1")
+    assert inv.chips[0].hbm_gib == 95
+    assert disc.gke_label_discover({}) is None
+
+
+def test_discover_host_chain_prefers_devfs_over_labels(tmp_path):
+    devfs, sysfs = _make_synthetic_tree(tmp_path, chips=4)
+    inv = disc.discover_host(devfs, sysfs,
+                             environ={},
+                             node_labels={
+                                 const.GKE_TPU_ACCELERATOR_LABEL:
+                                     "tpu-v5-lite-podslice"})
+    assert inv is not None and inv.source in ("native", "devfs")
+    inv2 = disc.discover_host(str(tmp_path / "no"), str(tmp_path / "no"),
+                              environ={},
+                              node_labels={
+                                  const.GKE_TPU_ACCELERATOR_LABEL:
+                                      "tpu-v5-lite-podslice"})
+    assert inv2 is not None and inv2.source == "gke-labels"
+
+
+def test_discover_host_merges_label_type_into_devfs_count(tmp_path):
+    """devfs counts chips it cannot identify; the GKE label supplies the
+    generation so HBM capacity is never advertised as zero."""
+    devfs = tmp_path / "dev"
+    devfs.mkdir()
+    for i in range(8):
+        (devfs / f"accel{i}").write_text("")
+    inv = disc.discover_host(str(devfs), str(tmp_path / "nosys"),
+                             environ={},
+                             node_labels={
+                                 const.GKE_TPU_ACCELERATOR_LABEL:
+                                     "tpu-v5-lite-podslice",
+                                 const.GKE_TPU_TOPOLOGY_LABEL: "2x4"})
+    assert inv is not None
+    assert inv.chip_count == 8          # counted from devfs
+    assert inv.tpu_type == "v5e"        # identified from the label
+    assert inv.total_hbm_gib == 128     # 8 x 16 GiB, not 0
+    assert inv.topology == "2x4"
+
+
+# --------------------------------------------------------------------------
+# Advertisement
+# --------------------------------------------------------------------------
+
+
+def _plugin(api, chips=4, hbm=16, node="host-a", tpu_type="v5e"):
+    api.create_node(make_node(node, chips=chips, hbm_per_chip=hbm,
+                              tpu_type=tpu_type))
+    inv = disc.fake_inventory(chips=chips, hbm_gib=hbm, tpu_type=tpu_type)
+    return TPUSharePlugin(node, api, inv)
+
+
+def test_hbm_device_advertisement():
+    plugin = _plugin(FakeApiServer(), chips=2, hbm=16)
+    devs = plugin.hbm_devices()
+    assert len(devs) == 32  # 2 chips x 16 GiB
+    assert devs[0].id == HBM_DEV_FMT.format(chip=0, gib=0)
+    assert all(d.health == HEALTHY for d in devs)
+    assert len(plugin.chip_devices()) == 2
+
+
+def test_health_tracks_device_nodes(tmp_path):
+    inv = disc.HostInventory(
+        tpu_type="v5e", topology="2x4",
+        chips=(disc.ChipSpec(0, 16, device_path="/dev/definitely-missing-0"),))
+    plugin = TPUSharePlugin("n", FakeApiServer(), inv)
+    assert plugin.chip_devices()[0].health == UNHEALTHY
+
+
+def test_annotate_node_publishes_capacities():
+    api = FakeApiServer()
+    api.create_node({"apiVersion": "v1", "kind": "Node",
+                     "metadata": {"name": "bare"}, "status": {}})
+    inv = disc.fake_inventory(chips=4, hbm_gib=95, tpu_type="v5p",
+                              topology="2x2x1")
+    TPUSharePlugin("bare", api, inv).annotate_node()
+    node = api.get_node("bare")
+    assert node.raw["metadata"]["annotations"][const.ANN_NODE_CHIP_HBM] == \
+        "95,95,95,95"
+    assert node.raw["metadata"]["annotations"][const.ANN_NODE_TOPOLOGY] == \
+        "2x2x1"
+    assert node.raw["metadata"]["annotations"][const.ANN_NODE_TPU_TYPE] == \
+        "v5p"
+
+
+# --------------------------------------------------------------------------
+# Allocate: matching + two-phase commit + env injection
+# --------------------------------------------------------------------------
+
+
+def _assumed_pod(name, hbm, chip_ids, assume_ns, hbm_chip=16, node="host-a"):
+    return make_pod(
+        name, hbm=hbm, node_name=node,
+        annotations={
+            const.ANN_CHIP_IDX: ",".join(str(c) for c in chip_ids),
+            const.ANN_HBM_POD: str(hbm),
+            const.ANN_HBM_CHIP: str(hbm_chip),
+            const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+            const.ANN_ASSUME_TIME: str(assume_ns),
+        })
+
+
+def test_allocate_hbm_matches_earliest_assume_time():
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    t0 = time.time_ns()
+    api.create_pod(_assumed_pod("late", 8, [1], t0 + 1000))
+    api.create_pod(_assumed_pod("early", 8, [0], t0))
+    alloc = plugin.allocate_hbm(["x"] * 8)
+    # earliest assume-time pod ("early", chip 0) wins
+    assert alloc.envs[const.ENV_CHIP_IDX] == "0"
+    assert alloc.envs[const.ENV_HBM_POD] == "8"
+    assert alloc.envs[const.ENV_HBM_CHIP] == "16"
+    assert alloc.envs[const.ENV_TPU_VISIBLE_CHIPS] == "0"
+    # 8/16 GiB * 0.9 headroom
+    assert alloc.envs[const.ENV_XLA_MEM_FRACTION] == "0.45"
+    assert alloc.devices == (("/fake/accel0", "/fake/accel0"),)
+    # two-phase commit: assigned flipped on the apiserver object
+    early = api.get_pod("default", "early")
+    assert early.annotations[const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+    late = api.get_pod("default", "late")
+    assert late.annotations[const.ANN_ASSIGNED] == const.ASSIGNED_FALSE
+
+
+def test_allocate_hbm_ignores_other_nodes_and_sizes():
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    api.create_pod(_assumed_pod("other-node", 8, [0], 1, node="host-b"))
+    api.create_pod(_assumed_pod("other-size", 4, [0], 1))
+    with pytest.raises(AllocateError):
+        plugin.allocate_hbm(["x"] * 8)
+
+
+def test_allocate_hbm_skips_already_assigned():
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    pod = _assumed_pod("done", 8, [0], 1)
+    pod["metadata"]["annotations"][const.ANN_ASSIGNED] = const.ASSIGNED_TRUE
+    api.create_pod(pod)
+    with pytest.raises(AllocateError):
+        plugin.allocate_hbm(["x"] * 8)
+
+
+def test_allocate_hbm_never_consumes_whole_chip_pod():
+    """A whole-chip pod with the same GiB footprint must not satisfy an
+    HBM allocation (they arrived through different kubelet resources)."""
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    chip_pod = make_pod("chip-pod", chips=2, node_name="host-a",
+                        annotations={
+                            const.ANN_CHIP_IDX: "0,1",
+                            const.ANN_HBM_POD: "32",
+                            const.ANN_HBM_CHIP: "16",
+                            const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+                            const.ANN_ASSUME_TIME: "1",
+                        })
+    api.create_pod(chip_pod)
+    with pytest.raises(AllocateError):
+        plugin.allocate_hbm(["x"] * 32)
+    # and the chip pod was not corrupted by the failed match
+    assert api.get_pod("default", "chip-pod").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_FALSE
+
+
+def test_whole_chip_allocation_no_mem_fraction():
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    pod = make_pod("chips", chips=2, node_name="host-a",
+                   annotations={
+                       const.ANN_CHIP_IDX: "2,3",
+                       const.ANN_HBM_POD: "32",
+                       const.ANN_HBM_CHIP: "16",
+                       const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+                       const.ANN_ASSUME_TIME: "5",
+                   })
+    api.create_pod(pod)
+    alloc = plugin.allocate_chips(["tpushare-chip-00", "tpushare-chip-01"])
+    # extender's placement (2,3) overrides kubelet's arbitrary pick (0,1)
+    assert alloc.envs[const.ENV_TPU_VISIBLE_CHIPS] == "2,3"
+    assert const.ENV_XLA_MEM_FRACTION not in alloc.envs
+    assert api.get_pod("default", "chips").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+
+def test_chip_allocation_without_extender_pod():
+    """Chip-only pods that bypassed the extender still get devices."""
+    plugin = _plugin(FakeApiServer())
+    alloc = plugin.allocate_chips(["tpushare-chip-01"])
+    assert alloc.envs[const.ENV_TPU_VISIBLE_CHIPS] == "1"
+    assert alloc.annotations == {}
+
+
+def test_allocation_grant_round_trips_through_jaxenv():
+    """The env the plugin injects is exactly what the workload runtime
+    parses (counterpart of samples/docker/run.sh consuming the injected
+    SHARED_GPU_MEM_* env)."""
+    from tpushare.runtime import jaxenv
+
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    api.create_pod(_assumed_pod("w", 12, [3], 1))
+    alloc = plugin.allocate_hbm(["x"] * 12)
+    env = dict(alloc.envs)
+    grant = jaxenv.read_grant(env)
+    assert grant is not None
+    assert grant.chip_ids == (3,)
+    assert grant.hbm_pod_gib == 12 and grant.hbm_chip_gib == 16
+    assert 0.0 < grant.mem_fraction < 1.0

@@ -1,0 +1,109 @@
+"""Wire-level device-plugin tests: real gRPC over unix sockets.
+
+Drives the plugin exactly the way kubelet does (reference
+docs/designs/designs.md:57-61): Register on the kubelet socket, open the
+ListAndWatch stream, then call Allocate with an opaque device-ID set.
+"""
+
+import time
+
+import pytest
+
+from tpushare.deviceplugin import discovery as disc
+from tpushare.deviceplugin.api import deviceplugin_pb2 as pb
+from tpushare.deviceplugin.kubelet import (
+    API_VERSION, FakeKubelet, run_node_daemon, socket_name)
+from tpushare.k8s.builders import make_node, make_pod
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.utils import const
+
+
+@pytest.fixture
+def stack(tmp_path):
+    plugin_dir = str(tmp_path)
+    kubelet = FakeKubelet(plugin_dir)
+    kubelet.start()
+    api = FakeApiServer()
+    api.create_node(make_node("host-a", chips=4, hbm_per_chip=16))
+    inv = disc.fake_inventory(chips=4, hbm_gib=16, tpu_type="v5e")
+    servers = run_node_daemon("host-a", api, inv, plugin_dir=plugin_dir,
+                              poll_interval=0.05)
+    yield kubelet, api, servers
+    for s in servers:
+        s.stop()
+    kubelet.stop()
+
+
+def test_registration_both_resources(stack):
+    kubelet, _, _ = stack
+    resources = {r.resource_name for r in kubelet.registrations}
+    assert resources == {const.HBM_RESOURCE, const.CHIP_RESOURCE}
+    assert all(r.version == API_VERSION for r in kubelet.registrations)
+    assert all(r.endpoint == socket_name(r.resource_name)
+               for r in kubelet.registrations)
+
+
+def test_list_and_watch_advertises_capacity(stack):
+    kubelet, _, _ = stack
+    hbm = kubelet.snapshot_devices(socket_name(const.HBM_RESOURCE))
+    chips = kubelet.snapshot_devices(socket_name(const.CHIP_RESOURCE))
+    assert len(hbm) == 64   # 4 chips x 16 GiB
+    assert len(chips) == 4
+    assert all(d.health == "Healthy" for d in hbm)
+
+
+def test_allocate_over_the_wire(stack):
+    kubelet, api, _ = stack
+    api.create_pod(make_pod(
+        "w", hbm=8, node_name="host-a",
+        annotations={
+            const.ANN_CHIP_IDX: "2",
+            const.ANN_HBM_POD: "8",
+            const.ANN_HBM_CHIP: "16",
+            const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+            const.ANN_ASSUME_TIME: str(time.time_ns()),
+        }))
+    ids = [f"tpushare-hbm-00-{i:03d}" for i in range(8)]  # kubelet's pick
+    resp = kubelet.allocate(socket_name(const.HBM_RESOURCE), ids)
+    assert len(resp.container_responses) == 1
+    creq = resp.container_responses[0]
+    # env follows the EXTENDER's chip choice (2), not the arbitrary IDs
+    assert creq.envs[const.ENV_CHIP_IDX] == "2"
+    assert creq.envs[const.ENV_TPU_VISIBLE_CHIPS] == "2"
+    assert creq.envs[const.ENV_XLA_MEM_FRACTION] == "0.45"
+    assert creq.devices[0].host_path == "/fake/accel2"
+    assert creq.devices[0].permissions == "rw"
+    assert api.get_pod("default", "w").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+
+def test_allocate_no_matching_pod_is_an_rpc_error(stack):
+    kubelet, _, _ = stack
+    import grpc
+
+    with pytest.raises(grpc.RpcError) as err:
+        kubelet.allocate(socket_name(const.HBM_RESOURCE), ["x"] * 3)
+    assert err.value.code() == grpc.StatusCode.INTERNAL
+
+
+def test_get_preferred_allocation_packs_sorted():
+    from tpushare.deviceplugin.kubelet import DevicePluginServicer
+    from tpushare.deviceplugin.plugin import TPUSharePlugin
+
+    plugin = TPUSharePlugin("n", FakeApiServer(), disc.fake_inventory())
+    servicer = DevicePluginServicer(plugin, const.HBM_RESOURCE)
+    req = pb.PreferredAllocationRequest(container_requests=[
+        pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=["tpushare-hbm-01-000", "tpushare-hbm-00-001",
+                                 "tpushare-hbm-00-000"],
+            allocation_size=2)])
+    resp = servicer.GetPreferredAllocation(req, None)
+    assert list(resp.container_responses[0].deviceIDs) == [
+        "tpushare-hbm-00-000", "tpushare-hbm-00-001"]
+
+
+def test_node_annotated_at_daemon_start(stack):
+    _, api, _ = stack
+    node = api.get_node("host-a")
+    assert node.raw["metadata"]["annotations"][
+        const.ANN_NODE_CHIP_HBM] == "16,16,16,16"

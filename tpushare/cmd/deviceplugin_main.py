@@ -1,0 +1,94 @@
+"""Device-plugin daemon entrypoint (runs as a DaemonSet on TPU nodes).
+
+Counterpart of the reference's companion device-plugin process (reference
+``README.md:42-47``, ``docs/designs/designs.md:53-61``): discover chips,
+publish per-chip capacities onto our Node, serve + register both extended
+resources with kubelet, and re-register if the kubelet socket is recreated
+(kubelet restart wipes plugin registrations).
+
+Environment:
+
+* ``NODE_NAME``          — required; the Node this daemon runs on
+  (injected via the downward API in the DaemonSet manifest).
+* ``KUBECONFIG``         — kubeconfig path when not in-cluster.
+* ``DEVICE_PLUGIN_PATH`` — kubelet plugin dir, default
+  ``/var/lib/kubelet/device-plugins``.
+* ``TPU_ACCELERATOR_TYPE`` — discovery hint on Cloud TPU VMs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+
+from tpushare.cmd.main import setup_signals
+from tpushare.deviceplugin import discovery
+from tpushare.deviceplugin.kubelet import (
+    DEVICE_PLUGIN_PATH, KUBELET_SOCKET, run_node_daemon)
+from tpushare.k8s.client import ApiClient, ClusterConfig
+
+log = logging.getLogger(__name__)
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=getattr(logging,
+                      os.environ.get("LOG_LEVEL", "info").upper(),
+                      logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    node_name = os.environ.get("NODE_NAME", "")
+    if not node_name:
+        log.error("NODE_NAME is required (set via the downward API)")
+        sys.exit(2)
+    plugin_dir = os.environ.get("DEVICE_PLUGIN_PATH", DEVICE_PLUGIN_PATH)
+
+    client = ApiClient(ClusterConfig.auto())
+    node = client.get_node(node_name)
+    labels = (node.raw.get("metadata", {}).get("labels", {})
+              if node is not None else {})
+    inventory = discovery.discover_host(node_labels=labels)
+    if inventory is None:
+        log.error("no TPU chips discovered on %s; exiting", node_name)
+        sys.exit(1)
+
+    stop = threading.Event()
+    setup_signals(stop)
+
+    servers = run_node_daemon(node_name, client, inventory,
+                              plugin_dir=plugin_dir)
+    kubelet_sock = os.path.join(plugin_dir, KUBELET_SOCKET)
+    kubelet_ino = _inode(kubelet_sock)
+    while not stop.wait(3.0):
+        # kubelet restart wipes the plugin dir (our .sock files included)
+        # and recreates its own socket: serve fresh sockets, then
+        # re-register — re-registering alone would point kubelet at
+        # endpoints that no longer exist on disk.
+        ino = _inode(kubelet_sock)
+        if ino != kubelet_ino:
+            kubelet_ino = ino
+            if ino is not None:
+                log.info("kubelet socket recreated; re-serving plugins "
+                         "and re-registering")
+                time.sleep(1.0)  # let kubelet finish coming up
+                for server in servers:
+                    server.stop()
+                servers = run_node_daemon(node_name, client, inventory,
+                                          plugin_dir=plugin_dir)
+
+    for server in servers:
+        server.stop()
+
+
+def _inode(path: str) -> int | None:
+    try:
+        return os.stat(path).st_ino
+    except OSError:
+        return None
+
+
+if __name__ == "__main__":
+    main()

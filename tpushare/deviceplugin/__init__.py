@@ -1,1 +1,9 @@
-"""tpushare.deviceplugin subpackage."""
+"""tpushare device plugin: the node-local half of the system.
+
+Discovery (:mod:`.discovery`) finds the host's chips, the plugin core
+(:mod:`.plugin`) advertises them as extended resources and matches
+kubelet allocations back to extender-assumed pods, and :mod:`.kubelet`
+speaks the device-plugin gRPC API (v1beta1) to kubelet. Counterpart of
+the reference system's companion gpushare-device-plugin repo
+(reference docs/designs/designs.md:53-104).
+"""

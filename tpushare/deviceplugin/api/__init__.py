@@ -1,0 +1,1 @@
+"""Generated + hand-written kubelet device-plugin API (v1beta1)."""

@@ -1,0 +1,349 @@
+"""TPU chip discovery: what does this host actually have?
+
+TPU-native counterpart of the NVML enumeration in the reference system's
+device plugin (reference ``docs/designs/designs.md:53-61``: NVML reports
+device count + per-device total memory, which the plugin converts into the
+``gpu-mem`` extended resource). Our discovery chain, first hit wins:
+
+1. **Native shim** (``native/libtpudisc.so`` via ctypes) — enumerates
+   ``/dev/accel*`` and reads PCI vendor/device + NUMA node from sysfs.
+   The C++ layer exists because that is the reference architecture's one
+   native seam (SURVEY.md §7) and because raw devfs/sysfs walking belongs
+   below Python.
+2. **Pure-Python devfs scan** — same walk without the shim, for images
+   where the ``.so`` is not built.
+3. **Environment** — ``TPU_ACCELERATOR_TYPE`` style strings exported on
+   Cloud TPU VMs (e.g. ``v5litepod-16``).
+4. **GKE node labels** — ``cloud.google.com/gke-tpu-accelerator`` +
+   ``gke-tpu-topology``, the discovery source of last resort.
+
+The result is a :class:`HostInventory` the plugin advertises to kubelet.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import glob
+import logging
+import os
+import re
+
+log = logging.getLogger(__name__)
+
+# ---------------------------------------------------------------------------
+# Public chip facts (per-chip HBM by generation; chips per host)
+# ---------------------------------------------------------------------------
+
+#: HBM GiB per chip by TPU generation (public spec sheet numbers).
+HBM_GIB_BY_TYPE = {
+    "v2": 16,   # 8 GiB per core x 2 cores
+    "v3": 32,   # 16 GiB per core x 2 cores
+    "v4": 32,
+    "v5e": 16,
+    "v5p": 95,
+    "v6e": 32,
+}
+
+#: Chips per host by generation (a full host; smaller node shapes exist).
+CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5e": 8, "v5p": 4, "v6e": 8}
+
+#: GKE accelerator label value -> generation.
+GKE_ACCELERATOR_TYPES = {
+    "tpu-v4-podslice": "v4",
+    "tpu-v5-lite-device": "v5e",
+    "tpu-v5-lite-podslice": "v5e",
+    "tpu-v5p-slice": "v5p",
+    "tpu-v6e-slice": "v6e",
+}
+
+#: Default ICI topology of one host, by generation (the node-local mesh the
+#: packer can exploit; multi-host slice topology comes from GKE labels).
+HOST_TOPOLOGY = {"v2": "2x2", "v3": "2x2", "v4": "2x2x1", "v5e": "2x4",
+                 "v5p": "2x2x1", "v6e": "2x4"}
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """One physical chip on this host."""
+
+    index: int
+    hbm_gib: int
+    device_path: str = ""
+    chip_type: str = ""
+    numa_node: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class HostInventory:
+    """Everything the device plugin advertises about this host."""
+
+    tpu_type: str
+    topology: str
+    chips: tuple[ChipSpec, ...]
+    source: str = ""  # which discovery rung produced this
+
+    @property
+    def chip_count(self) -> int:
+        return len(self.chips)
+
+    @property
+    def total_hbm_gib(self) -> int:
+        return sum(c.hbm_gib for c in self.chips)
+
+    def chip(self, index: int) -> ChipSpec | None:
+        for c in self.chips:
+            if c.index == index:
+                return c
+        return None
+
+
+def _inventory(chip_type: str, count: int, paths: dict[int, str] | None = None,
+               numa: dict[int, int] | None = None, topology: str = "",
+               hbm_override: int = 0, source: str = "") -> HostInventory:
+    hbm = hbm_override or HBM_GIB_BY_TYPE.get(chip_type, 0)
+    chips = tuple(
+        ChipSpec(index=i, hbm_gib=hbm,
+                 device_path=(paths or {}).get(i, f"/dev/accel{i}"),
+                 chip_type=chip_type, numa_node=(numa or {}).get(i, -1))
+        for i in sorted((paths or {i: None for i in range(count)}).keys()))
+    return HostInventory(tpu_type=chip_type,
+                         topology=topology or HOST_TOPOLOGY.get(chip_type, ""),
+                         chips=chips, source=source)
+
+
+# ---------------------------------------------------------------------------
+# Rung 1: native shim (ctypes over native/libtpudisc.so)
+# ---------------------------------------------------------------------------
+
+class _TpudiscChip(ctypes.Structure):
+    """Mirror of ``struct TpudiscChip`` in native/tpudisc.cc."""
+
+    _fields_ = [
+        ("index", ctypes.c_int32),
+        ("pci_vendor", ctypes.c_int32),
+        ("pci_device", ctypes.c_int32),
+        ("numa_node", ctypes.c_int32),
+        ("hbm_bytes", ctypes.c_int64),
+        ("device_path", ctypes.c_char * 128),
+        ("chip_type", ctypes.c_char * 32),
+    ]
+
+
+_MAX_CHIPS = 64
+
+
+def _default_lib_paths() -> list[str]:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return [
+        os.environ.get("TPUDISC_LIB", ""),
+        os.path.join(here, "native", "libtpudisc.so"),
+        "libtpudisc.so",
+    ]
+
+
+class NativeDiscovery:
+    """Discovery through the C++ shim; unavailable == returns None."""
+
+    def __init__(self, devfs_root: str = "/dev", sysfs_root: str = "/sys",
+                 lib_path: str | None = None):
+        self.devfs_root = devfs_root
+        self.sysfs_root = sysfs_root
+        self._lib = None
+        paths = [lib_path] if lib_path else _default_lib_paths()
+        for path in paths:
+            if not path:
+                continue
+            try:
+                lib = ctypes.CDLL(path)
+                lib.tpudisc_enumerate.restype = ctypes.c_int
+                lib.tpudisc_enumerate.argtypes = [
+                    ctypes.POINTER(_TpudiscChip), ctypes.c_int,
+                    ctypes.c_char_p, ctypes.c_char_p]
+                lib.tpudisc_version.restype = ctypes.c_char_p
+                self._lib = lib
+                break
+            except OSError:
+                continue
+
+    @property
+    def available(self) -> bool:
+        return self._lib is not None
+
+    def discover(self, chip_type_hint: str = "") -> HostInventory | None:
+        if self._lib is None:
+            return None
+        chips_buf = (_TpudiscChip * _MAX_CHIPS)()
+        n = self._lib.tpudisc_enumerate(
+            chips_buf, _MAX_CHIPS,
+            self.devfs_root.encode(), self.sysfs_root.encode())
+        if n <= 0:
+            return None
+        chips = []
+        chip_type = chip_type_hint
+        for i in range(n):
+            raw = chips_buf[i]
+            ctype = raw.chip_type.decode() or chip_type_hint
+            chip_type = chip_type or ctype
+            hbm_gib = (raw.hbm_bytes // (1 << 30) if raw.hbm_bytes
+                       else HBM_GIB_BY_TYPE.get(ctype, 0))
+            chips.append(ChipSpec(
+                index=raw.index, hbm_gib=hbm_gib,
+                device_path=raw.device_path.decode(), chip_type=ctype,
+                numa_node=raw.numa_node))
+        return HostInventory(
+            tpu_type=chip_type,
+            topology=HOST_TOPOLOGY.get(chip_type, ""),
+            chips=tuple(chips), source="native")
+
+
+# ---------------------------------------------------------------------------
+# Rung 2: pure-Python devfs scan
+# ---------------------------------------------------------------------------
+
+_ACCEL_RE = re.compile(r"accel(\d+)$")
+
+
+def devfs_scan(devfs_root: str = "/dev",
+               chip_type_hint: str = "") -> HostInventory | None:
+    """Walk ``<devfs_root>/accel*`` (and ``accel/accel*``) without the shim."""
+    paths: dict[int, str] = {}
+    for pattern in (f"{devfs_root}/accel*", f"{devfs_root}/accel/accel*"):
+        for path in glob.glob(pattern):
+            m = _ACCEL_RE.search(os.path.basename(path))
+            if m:
+                paths.setdefault(int(m.group(1)), path)
+    if not paths:
+        return None
+    return _inventory(chip_type_hint, len(paths), paths=paths, source="devfs")
+
+
+# ---------------------------------------------------------------------------
+# Rung 3: Cloud TPU VM environment
+# ---------------------------------------------------------------------------
+
+_ACCEL_TYPE_RE = re.compile(r"^(v\d+[a-z]*|v5litepod|v5p|v6e)-?(\d+)?$")
+
+
+def parse_accelerator_type(value: str) -> tuple[str, int]:
+    """``v5litepod-16`` -> ("v5e", 16 devices in slice); ("", 0) if opaque.
+
+    The trailing number counts TensorCores for v2-v4 (2 cores/chip) and
+    chips for v5e/v5p/v6e, matching Cloud TPU naming.
+    """
+    value = value.strip().lower()
+    m = _ACCEL_TYPE_RE.match(value)
+    if not m:
+        return "", 0
+    gen_raw, num = m.group(1), int(m.group(2) or 0)
+    gen = {"v5litepod": "v5e"}.get(gen_raw, gen_raw)
+    if gen not in HBM_GIB_BY_TYPE:
+        return "", 0
+    if gen in ("v2", "v3", "v4") and num:
+        num //= 2  # TensorCores -> chips
+    return gen, num
+
+
+def env_discover(environ=None) -> HostInventory | None:
+    env = os.environ if environ is None else environ
+    raw = env.get("TPU_ACCELERATOR_TYPE", "")
+    if not raw:
+        return None
+    gen, slice_chips = parse_accelerator_type(raw)
+    if not gen:
+        return None
+    per_host = min(slice_chips or CHIPS_PER_HOST[gen], CHIPS_PER_HOST[gen])
+    return _inventory(gen, per_host, source="env")
+
+
+# ---------------------------------------------------------------------------
+# Rung 4: GKE node labels
+# ---------------------------------------------------------------------------
+
+def gke_label_discover(labels: dict[str, str]) -> HostInventory | None:
+    """Infer inventory from GKE's TPU node labels (SURVEY.md §5: the
+    NVML-replacement of last resort)."""
+    from tpushare.utils import const
+
+    accel = labels.get(const.GKE_TPU_ACCELERATOR_LABEL, "")
+    gen = GKE_ACCELERATOR_TYPES.get(accel, "")
+    if not gen:
+        return None
+    topology = labels.get(const.GKE_TPU_TOPOLOGY_LABEL, "")
+    slice_chips = 1
+    if topology:
+        try:
+            for dim in topology.split("x"):
+                slice_chips *= int(dim)
+        except ValueError:
+            slice_chips = 0
+    per_host = min(slice_chips or CHIPS_PER_HOST[gen], CHIPS_PER_HOST[gen])
+    return _inventory(gen, per_host, topology=topology, source="gke-labels")
+
+
+# ---------------------------------------------------------------------------
+# Fake (tests) + the chain
+# ---------------------------------------------------------------------------
+
+def fake_inventory(chips: int = 4, hbm_gib: int = 16, tpu_type: str = "v5e",
+                   topology: str = "", chip_hbm: list[int] | None = None,
+                   ) -> HostInventory:
+    caps = chip_hbm if chip_hbm is not None else [hbm_gib] * chips
+    return HostInventory(
+        tpu_type=tpu_type,
+        topology=topology or HOST_TOPOLOGY.get(tpu_type, ""),
+        chips=tuple(ChipSpec(index=i, hbm_gib=c,
+                             device_path=f"/fake/accel{i}",
+                             chip_type=tpu_type)
+                    for i, c in enumerate(caps)),
+        source="fake")
+
+
+def _retype(inv: HostInventory, gen: str,
+            topology: str = "") -> HostInventory:
+    """Fill in generation-derived facts (HBM size, type) on chips the
+    devfs/native rungs could enumerate but not identify."""
+    chips = tuple(
+        dataclasses.replace(
+            c,
+            chip_type=c.chip_type or gen,
+            hbm_gib=c.hbm_gib or HBM_GIB_BY_TYPE.get(c.chip_type or gen, 0))
+        for c in inv.chips)
+    return dataclasses.replace(
+        inv, chips=chips, tpu_type=inv.tpu_type or gen,
+        topology=inv.topology or topology or HOST_TOPOLOGY.get(gen, ""))
+
+
+def discover_host(devfs_root: str = "/dev", sysfs_root: str = "/sys",
+                  environ=None, node_labels: dict[str, str] | None = None,
+                  ) -> HostInventory | None:
+    """Run the discovery chain; None only when every rung misses."""
+    from tpushare.utils import const
+
+    env = os.environ if environ is None else environ
+    labels = node_labels or {}
+    # Type hint: the env var wins, GKE's accelerator label is the backstop.
+    hint, _ = parse_accelerator_type(env.get("TPU_ACCELERATOR_TYPE", ""))
+    label_gen = GKE_ACCELERATOR_TYPES.get(
+        labels.get(const.GKE_TPU_ACCELERATOR_LABEL, ""), "")
+    hint = hint or label_gen
+
+    native = NativeDiscovery(devfs_root, sysfs_root)
+    inv = native.discover(chip_type_hint=hint) if native.available else None
+    if inv is None:
+        inv = devfs_scan(devfs_root, chip_type_hint=hint)
+    if inv is not None and hint:
+        # devfs/native can count chips without identifying them; graft the
+        # label/env-derived generation in so HBM capacity is never 0.
+        inv = _retype(inv, hint,
+                      topology=labels.get(const.GKE_TPU_TOPOLOGY_LABEL, ""))
+    if inv is None:
+        inv = env_discover(env)
+    if inv is None and labels:
+        inv = gke_label_discover(labels)
+    if inv is not None:
+        log.info("discovered %d %s chip(s) via %s (%d GiB HBM total)",
+                 inv.chip_count, inv.tpu_type or "unknown-type", inv.source,
+                 inv.total_hbm_gib)
+    return inv

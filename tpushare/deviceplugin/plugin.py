@@ -1,0 +1,248 @@
+"""Device-plugin core: advertise HBM/chip devices, match & commit pods.
+
+TPU-native counterpart of the gpushare device plugin the reference system
+requires but keeps in a companion repo (reference
+``docs/designs/designs.md:53-61,92-104`` and ``README.md:42-47``):
+
+* **Advertise** — NVML device memory became the ``gpu-mem`` extended
+  resource there; here discovery (:mod:`.discovery`) reports chips and we
+  advertise two resources: one virtual device per **GiB of HBM**
+  (``tpushare.io/tpu-hbm``) and one device per **whole chip**
+  (``tpushare.io/tpu-chip``).
+* **Allocate** — kubelet hands the plugin an opaque device-ID set with no
+  pod identity. Like the reference (designs.md:92-104), the plugin finds
+  the pod itself: pending pods on this node that the extender has assumed
+  (``assigned=false``) and whose request matches the allocation size, the
+  **earliest assume-time first**. It then flips ``assigned=true`` (the
+  second phase of the two-phase commit) and returns the JAX/XLA env + the
+  ``/dev/accel*`` device nodes for the granted chip(s).
+* **Health** — chips whose device node vanishes are reported unhealthy so
+  kubelet withdraws their capacity.
+
+The kubelet gRPC framing lives in :mod:`.kubelet`; this module is pure
+logic so it is fully testable against the fake apiserver.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+
+from tpushare.api.objects import Pod
+from tpushare.deviceplugin.discovery import HostInventory
+from tpushare.k8s.errors import ConflictError
+from tpushare.utils import const, pod as podutils
+
+log = logging.getLogger(__name__)
+
+#: How a virtual HBM-GiB device is named: chip index + GiB ordinal within
+#: the chip, so an ID set implies nothing about which pod it belongs to
+#: (exactly the information gap the assume-time matching closes).
+HBM_DEV_FMT = "tpushare-hbm-{chip:02d}-{gib:03d}"
+CHIP_DEV_FMT = "tpushare-chip-{chip:02d}"
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+
+@dataclasses.dataclass(frozen=True)
+class VirtualDevice:
+    id: str
+    health: str = HEALTHY
+    numa_node: int = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class ContainerAllocation:
+    """What one container gets back from Allocate()."""
+
+    envs: dict[str, str]
+    devices: tuple[tuple[str, str], ...]  # (host_path, container_path)
+    annotations: dict[str, str]
+
+
+class AllocateError(Exception):
+    pass
+
+
+class TPUSharePlugin:
+    """The node-local half of the two-phase commit protocol."""
+
+    def __init__(self, node_name: str, client, inventory: HostInventory,
+                 headroom: float | None = None):
+        self.node_name = node_name
+        self.client = client
+        self.inventory = inventory
+        self.headroom = headroom
+
+    # ------------------------------------------------------------------ #
+    # Advertisement (reference: ListAndWatch reporting gpu-mem totals)
+    # ------------------------------------------------------------------ #
+
+    def hbm_devices(self) -> list[VirtualDevice]:
+        """One virtual device per GiB of HBM, tagged by owning chip."""
+        devs = []
+        for chip in self.inventory.chips:
+            health = self._chip_health(chip.device_path)
+            for gib in range(chip.hbm_gib):
+                devs.append(VirtualDevice(
+                    id=HBM_DEV_FMT.format(chip=chip.index, gib=gib),
+                    health=health, numa_node=chip.numa_node))
+        return devs
+
+    def chip_devices(self) -> list[VirtualDevice]:
+        return [VirtualDevice(id=CHIP_DEV_FMT.format(chip=c.index),
+                              health=self._chip_health(c.device_path),
+                              numa_node=c.numa_node)
+                for c in self.inventory.chips]
+
+    @staticmethod
+    def _chip_health(device_path: str) -> str:
+        if not device_path or not device_path.startswith("/dev"):
+            return HEALTHY  # fake/synthetic inventory
+        return HEALTHY if os.path.exists(device_path) else UNHEALTHY
+
+    def annotate_node(self) -> None:
+        """Publish per-chip capacities + topology onto our Node object so
+        the extender's ledger models heterogeneity (SURVEY.md §7 delta 4;
+        the reference had no node-side schema and assumed homogeneous
+        devices, nodeinfo.go:33-35)."""
+        node = self.client.get_node(self.node_name)
+        if node is None:
+            raise AllocateError(f"node {self.node_name} not registered")
+        ann = node.raw.setdefault("metadata", {}).setdefault("annotations", {})
+        ann[const.ANN_NODE_CHIP_HBM] = ",".join(
+            str(c.hbm_gib) for c in self.inventory.chips)
+        if self.inventory.topology:
+            ann[const.ANN_NODE_TOPOLOGY] = self.inventory.topology
+        if self.inventory.tpu_type:
+            ann[const.ANN_NODE_TPU_TYPE] = self.inventory.tpu_type
+        self.client.update_node(node)
+
+    # ------------------------------------------------------------------ #
+    # Allocate (reference designs.md:92-104)
+    # ------------------------------------------------------------------ #
+
+    def allocate_hbm(self, device_ids: list[str]) -> ContainerAllocation:
+        """kubelet granted ``len(device_ids)`` GiB; find whose they are."""
+        requested_gib = len(device_ids)
+        pod = self._match_pending_pod(requested_gib)
+        if pod is None:
+            raise AllocateError(
+                f"no assumed pod on {self.node_name} requests "
+                f"{requested_gib} GiB HBM")
+        chip_ids = podutils.get_chip_ids_from_annotation(pod)
+        self._commit_assigned(pod)
+        return self._build_allocation(pod, chip_ids)
+
+    def allocate_chips(self, device_ids: list[str]) -> ContainerAllocation:
+        """Whole-chip allocations carry real chip indices in the IDs."""
+        chip_ids = sorted(
+            int(d.rsplit("-", 1)[1]) for d in device_ids
+            if d.startswith("tpushare-chip-"))
+        if not chip_ids:
+            raise AllocateError(f"unrecognized chip device ids: {device_ids}")
+        pod = self._match_pending_pod(len(chip_ids), chips=True)
+        if pod is not None:
+            # Prefer the extender's placement over kubelet's arbitrary pick.
+            planned = podutils.get_chip_ids_from_annotation(pod)
+            if planned:
+                chip_ids = planned
+            self._commit_assigned(pod)
+            return self._build_allocation(pod, chip_ids, whole_chips=True)
+        # Chip-only pods may bypass the extender (no HBM request): still
+        # hand out the devices kubelet picked.
+        envs = self._chip_envs(chip_ids)
+        return ContainerAllocation(
+            envs=envs, devices=self._device_nodes(chip_ids), annotations={})
+
+    # -- matching ------------------------------------------------------- #
+
+    def _match_pending_pod(self, requested: int,
+                           chips: bool = False) -> Pod | None:
+        """Assumed-but-unassigned pods on this node with a matching
+        request, earliest assume-time first (designs.md:92-104: kubelet's
+        Allocate carries no pod identity, so request size + FIFO order is
+        the join key)."""
+        candidates = []
+        for pod in self.client.list_pods(node_name=self.node_name):
+            if pod.node_name != self.node_name:
+                continue
+            if podutils.is_complete_pod(pod):
+                continue
+            if not podutils.is_assumed(pod) or podutils.is_assigned(pod):
+                continue
+            # An HBM allocation must never consume a whole-chip pod (and
+            # vice versa): both can have the same GiB footprint, but they
+            # came through different kubelet resources.
+            if chips != podutils.is_tpu_chip_pod(pod):
+                continue
+            want = (podutils.get_chips_from_pod_resource(pod) if chips
+                    else podutils.get_hbm_from_pod_annotation(pod))
+            if want != requested:
+                continue
+            candidates.append((podutils.get_assume_time(pod), pod.key(), pod))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda t: (t[0], t[1]))
+        return candidates[0][2]
+
+    # -- commit --------------------------------------------------------- #
+
+    def _commit_assigned(self, pod: Pod, retries: int = 3) -> None:
+        """Flip ``assigned`` false→true with optimistic-lock retry
+        (second phase of the protocol; reference designs.md:101)."""
+        for attempt in range(retries):
+            fresh = self.client.get_pod(pod.namespace, pod.name)
+            ann = fresh.raw.setdefault("metadata", {}).setdefault(
+                "annotations", {})
+            ann[const.ANN_ASSIGNED] = const.ASSIGNED_TRUE
+            try:
+                self.client.update_pod(fresh)
+                return
+            except ConflictError:
+                if attempt == retries - 1:
+                    raise
+                time.sleep(0.05 * (attempt + 1))
+
+    # -- response building ---------------------------------------------- #
+
+    def _device_nodes(self, chip_ids: list[int]) -> tuple[tuple[str, str], ...]:
+        nodes = []
+        for cid in chip_ids:
+            chip = self.inventory.chip(cid)
+            path = chip.device_path if chip else f"/dev/accel{cid}"
+            nodes.append((path, path))
+        return tuple(nodes)
+
+    def _chip_envs(self, chip_ids: list[int]) -> dict[str, str]:
+        return {
+            const.ENV_TPU_VISIBLE_CHIPS: ",".join(str(c) for c in chip_ids),
+            const.ENV_TPU_CHIPS_PER_PROCESS_BOUNDS: f"1,1,{len(chip_ids)}",
+            const.ENV_TPU_PROCESS_BOUNDS: "1,1,1",
+        }
+
+    def _build_allocation(self, pod: Pod, chip_ids: list[int],
+                          whole_chips: bool = False) -> ContainerAllocation:
+        hbm_pod = podutils.get_hbm_from_pod_annotation(pod)
+        chip = self.inventory.chip(chip_ids[0]) if chip_ids else None
+        hbm_chip = chip.hbm_gib if chip else 0
+        envs = {
+            const.ENV_CHIP_IDX: ",".join(str(c) for c in chip_ids),
+            const.ENV_HBM_POD: str(hbm_pod),
+            const.ENV_HBM_CHIP: str(hbm_chip),
+        }
+        envs.update(self._chip_envs(chip_ids))
+        if not whole_chips and 0 < hbm_pod < hbm_chip:
+            from tpushare.runtime import jaxenv
+            headroom = (self.headroom if self.headroom is not None
+                        else jaxenv.DEFAULT_HEADROOM)
+            fraction = round(hbm_pod / hbm_chip * headroom, 3)
+            envs[const.ENV_XLA_MEM_FRACTION] = str(fraction)
+        log.info("allocated chips %s (%d GiB) to pod %s",
+                 chip_ids, hbm_pod, pod.key())
+        return ContainerAllocation(
+            envs=envs, devices=self._device_nodes(chip_ids),
+            annotations={const.ANN_CHIP_IDX: ",".join(map(str, chip_ids))})

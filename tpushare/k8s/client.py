@@ -168,9 +168,21 @@ class ApiClient:
         return Pod(self._request(
             "GET", f"/api/v1/namespaces/{namespace}/pods/{name}"))
 
-    def list_pods(self) -> list[Pod]:
-        doc = self._request("GET", "/api/v1/pods?limit=5000")
-        return [Pod(item) for item in doc.get("items", [])]
+    def list_pods(self, node_name: str | None = None) -> list[Pod]:
+        """All pods, or (cheaply, server-side filtered) one node's pods.
+        Follows list pagination so >limit clusters are not truncated."""
+        base = "/api/v1/pods?limit=5000"
+        if node_name:
+            base += f"&fieldSelector=spec.nodeName%3D{node_name}"
+        pods: list[Pod] = []
+        cont = ""
+        while True:
+            path = base + (f"&continue={cont}" if cont else "")
+            doc = self._request("GET", path)
+            pods.extend(Pod(item) for item in doc.get("items", []))
+            cont = doc.get("metadata", {}).get("continue", "")
+            if not cont:
+                return pods
 
     def update_pod(self, pod: Pod) -> Pod:
         return Pod(self._request(
@@ -205,6 +217,12 @@ class ApiClient:
     def list_nodes(self) -> list[Node]:
         doc = self._request("GET", "/api/v1/nodes")
         return [Node(item) for item in doc.get("items", [])]
+
+    def update_node(self, node: Node) -> Node:
+        """PUT the node object itself — metadata (annotations) changes do
+        not persist through the /status subresource."""
+        return Node(self._request("PUT", f"/api/v1/nodes/{node.name}",
+                                  body=node.raw))
 
     def update_node_status(self, node: Node) -> Node:
         return Node(self._request("PUT", f"/api/v1/nodes/{node.name}/status",

@@ -80,9 +80,12 @@ class FakeApiServer:
                 raise NotFoundError(reason=f"pod {key} not found")
             return Pod(copy.deepcopy(self._pods[key]))
 
-    def list_pods(self) -> list[Pod]:
+    def list_pods(self, node_name: str | None = None) -> list[Pod]:
         with self._lock:
-            return [Pod(copy.deepcopy(p)) for p in self._pods.values()]
+            pods = [Pod(copy.deepcopy(p)) for p in self._pods.values()]
+        if node_name:
+            pods = [p for p in pods if p.node_name == node_name]
+        return pods
 
     def update_pod(self, pod: Pod) -> Pod:
         """Optimistic-concurrency update: stale resourceVersion → 409,
