@@ -1,0 +1,21 @@
+# Two-stage build (counterpart of the reference's golang->slim Dockerfile):
+# stage 1 compiles the native discovery shim, stage 2 is the slim runtime
+# image shared by both components:
+#   scheduler extender:  python -m tpushare.cmd.main
+#   device plugin:       python -m tpushare.cmd.deviceplugin_main
+FROM debian:bookworm-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+COPY native /src/native
+RUN make -C /src/native
+
+FROM python:3.11-slim
+# Control-plane runtime deps only (jax lives in workload images, not here):
+# grpcio/protobuf (kubelet API), prometheus-client (/metrics), pyyaml
+# (kubeconfig parsing).
+RUN pip install --no-cache-dir grpcio protobuf prometheus-client pyyaml
+COPY tpushare /app/tpushare
+COPY --from=build /src/native/libtpudisc.so /app/native/libtpudisc.so
+ENV PYTHONPATH=/app TPUDISC_LIB=/app/native/libtpudisc.so
+WORKDIR /app
+CMD ["python", "-m", "tpushare.cmd.main"]

@@ -1,0 +1,65 @@
+"""Probe workload: a JAX matmul loop that honors its HBM grant.
+
+Counterpart of the reference's TF demo (``samples/docker/main.py``: reads
+the injected env and sets ``per_process_gpu_memory_fraction``). The TPU
+version asks :mod:`tpushare.runtime.jaxenv` to translate the device
+plugin's injected env into JAX/XLA config BEFORE importing jax, then
+sizes its working set to the granted HBM and runs a bf16 matmul loop —
+the MXU-friendly way to demonstrate the chip is both shared and busy.
+
+Run it under tpushare (env injected by the device plugin) or standalone
+(no env → full chip).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from tpushare.runtime import jaxenv
+
+grant = jaxenv.configure()  # must precede `import jax`
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main() -> None:
+    if grant is None:
+        print("no tpushare grant detected: using the whole chip")
+        budget_gib = 0.5  # stay modest outside the scheduler
+    else:
+        print(f"tpushare grant: chips={grant.chip_ids} "
+              f"hbm={grant.hbm_pod_gib}/{grant.hbm_chip_gib} GiB "
+              f"(mem fraction {grant.mem_fraction:.2f})")
+        # Keep the working set inside the grant with headroom to spare.
+        budget_gib = max(grant.hbm_pod_gib * 0.25, 0.25)
+
+    # Square bf16 matrices: 3 live buffers of n*n*2 bytes each.
+    n = int((budget_gib * (1 << 30) / (3 * 2)) ** 0.5)
+    n = max(512, (n // 128) * 128)  # MXU-aligned
+    print(f"devices: {jax.devices()}")
+    print(f"matmul size: {n}x{n} bf16")
+
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (n, n), jnp.bfloat16)
+    b = jax.random.normal(key, (n, n), jnp.bfloat16)
+
+    @jax.jit
+    def step(a, b):
+        return a @ b
+
+    step(a, b).block_until_ready()  # compile
+    iters = int(os.environ.get("ITERS", "100"))
+    t0 = time.perf_counter()
+    out = a
+    for _ in range(iters):
+        out = step(out, b)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    tflops = 2 * n**3 * iters / dt / 1e12
+    print(f"{iters} matmuls in {dt:.2f}s -> {tflops:.2f} TFLOP/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Entrypoint of the demo image: show the injected tpushare env, then run
+# the probe workload (counterpart of the reference's samples/docker/run.sh).
+echo "TPUSHARE_CHIP_IDX=${TPUSHARE_CHIP_IDX:-<unset>}"
+echo "TPUSHARE_HBM_POD_GIB=${TPUSHARE_HBM_POD_GIB:-<unset>}"
+echo "TPUSHARE_HBM_CHIP_GIB=${TPUSHARE_HBM_CHIP_GIB:-<unset>}"
+echo "TPU_VISIBLE_CHIPS=${TPU_VISIBLE_CHIPS:-<unset>}"
+echo "XLA_PYTHON_CLIENT_MEM_FRACTION=${XLA_PYTHON_CLIENT_MEM_FRACTION:-<unset>}"
+exec python /app/main.py

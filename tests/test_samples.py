@@ -1,0 +1,157 @@
+"""Replay the shipped sample manifests through the real stack.
+
+The reference validated behavior by running its samples against a live
+cluster (SURVEY.md §4: samples/1-3 bin-pack, samples/4 is rejected).
+Here the same scenarios run in-process: the actual YAML files are parsed,
+their pod templates extracted, and scheduled through the extender; the
+gang sample exercises all-or-nothing placement; and the full loop test
+closes the circle through the device plugin's gRPC Allocate.
+"""
+
+import json
+import os
+import time
+
+import pytest
+import yaml
+
+from tests.test_e2e import Cluster
+from tpushare.deviceplugin import discovery as disc
+from tpushare.deviceplugin.kubelet import (
+    FakeKubelet, run_node_daemon, socket_name)
+from tpushare.k8s.builders import make_node
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.runtime import jaxenv
+from tpushare.utils import const
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_sample_pod(n: int, name: str | None = None) -> dict:
+    """Pod doc from samples/<n>.yaml's Deployment template."""
+    with open(os.path.join(REPO, "samples", f"{n}.yaml")) as f:
+        dep = yaml.safe_load(f)
+    template = dep["spec"]["template"]
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": name or dep["metadata"]["name"],
+            "namespace": "default",
+            "labels": template["metadata"].get("labels", {}),
+            "annotations": template["metadata"].get("annotations", {}),
+        },
+        "spec": template["spec"],
+        "status": {"phase": "Pending"},
+    }
+    return pod
+
+
+def test_config_files_parse():
+    with open(os.path.join(REPO, "config",
+                           "scheduler-policy-config.json")) as f:
+        policy = json.load(f)
+    ext = policy["extenders"][0]
+    assert ext["filterVerb"] == "filter" and ext["bindVerb"] == "bind"
+    assert ext["nodeCacheCapable"] is True and ext["ignorable"] is False
+    managed = {m["name"] for m in ext["managedResources"]}
+    assert managed == {const.HBM_RESOURCE, const.CHIP_RESOURCE}
+    assert "/tpushare-scheduler" in ext["urlPrefix"]
+
+    for fname in ("kube-scheduler-config.yaml", "kube-scheduler.yaml",
+                  "tpushare-schd-extender.yaml",
+                  "tpushare-device-plugin.yaml"):
+        with open(os.path.join(REPO, "config", fname)) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        assert docs, fname
+
+    sched = yaml.safe_load(
+        open(os.path.join(REPO, "config", "kube-scheduler-config.yaml")))
+    assert sched["extenders"][0]["nodeCacheCapable"] is True
+
+
+def test_samples_binpack_and_rejection(api):
+    """samples/1-3 pack into two chips of one v5e node; samples/4 fits
+    nothing (the reference's demo scenarios 1-3)."""
+    api.create_node(make_node("v5e-0", chips=4, hbm_per_chip=16))
+    cluster = Cluster(api)
+    try:
+        for n in (1, 2, 3):
+            doc = load_sample_pod(n)
+            api.create_pod(doc)
+            bound, where = cluster.schedule(doc)
+            assert bound, where
+        view = cluster.inspect("v5e-0")["nodes"][0]
+        used = [c["usedHBM"] for c in view["chips"]]
+        assert sum(used) == 24
+        assert sorted(used, reverse=True)[:2] == [16, 8]  # tightest fit
+
+        huge = load_sample_pod(4)
+        api.create_pod(huge)
+        bound, detail = cluster.schedule(huge)
+        assert not bound
+        assert "insufficient TPU HBM in one chip" in str(detail)
+    finally:
+        cluster.close()
+
+
+def test_sample_gang_all_or_nothing(api):
+    """samples/5.yaml: 4 workers x 4 chips across 4 v5p hosts, bound only
+    once the whole group fits."""
+    for i in range(4):
+        api.create_node(make_node(f"v5p-{i}", chips=4, hbm_per_chip=95,
+                                  topology="2x2x1", tpu_type="v5p"))
+    cluster = Cluster(api)
+    try:
+        docs = [load_sample_pod(5, name=f"gang-train-{i}") for i in range(4)]
+        for doc in docs[:3]:
+            api.create_pod(doc)
+            bound, _ = cluster.schedule(doc)
+            assert not bound  # reserved, below quorum
+        api.create_pod(docs[3])
+        bound, _ = cluster.schedule(docs[3])
+        assert bound
+        time.sleep(0.05)
+        nodes = {api.get_pod("default", f"gang-train-{i}").node_name
+                 for i in range(4)}
+        assert nodes == {f"v5p-{i}" for i in range(4)}
+    finally:
+        cluster.close()
+
+
+def test_full_loop_extender_to_device_plugin(api, tmp_path):
+    """The complete two-phase story on one node: extender assumes+binds
+    (phase 1), kubelet's Allocate via gRPC commits (phase 2), and the
+    injected env parses back into a workload grant — the in-process
+    version of the reference's end-to-end demo."""
+    api.create_node(make_node("host-a", chips=4, hbm_per_chip=16))
+    cluster = Cluster(api)
+    kubelet = FakeKubelet(str(tmp_path))
+    kubelet.start()
+    servers = run_node_daemon(
+        "host-a", api, disc.fake_inventory(chips=4, hbm_gib=16),
+        plugin_dir=str(tmp_path), poll_interval=0.1)
+    try:
+        doc = load_sample_pod(1)  # 8 GiB
+        api.create_pod(doc)
+        bound, where = cluster.schedule(doc)
+        assert bound and where == "host-a"
+
+        pod = api.get_pod("default", "binpack-1")
+        assert pod.annotations[const.ANN_ASSIGNED] == const.ASSIGNED_FALSE
+        hbm = int(pod.annotations[const.ANN_HBM_POD])
+
+        # kubelet now calls Allocate with <hbm> opaque device IDs
+        resp = kubelet.allocate(socket_name(const.HBM_RESOURCE),
+                                [f"id-{i}" for i in range(hbm)])
+        envs = dict(resp.container_responses[0].envs)
+        grant = jaxenv.read_grant(envs)
+        assert grant is not None and grant.hbm_pod_gib == 8
+        assert grant.chip_ids == tuple(
+            int(c) for c in pod.annotations[const.ANN_CHIP_IDX].split(","))
+        assert api.get_pod("default", "binpack-1").annotations[
+            const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+    finally:
+        for s in servers:
+            s.stop()
+        kubelet.stop()
+        cluster.close()

@@ -1,0 +1,87 @@
+"""Tests for the ops tooling: pprof endpoints + kubectl-inspect CLI."""
+
+import sys
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, "tools")
+
+from tests.test_e2e import Cluster  # noqa: E402
+from tpushare.k8s.builders import make_node, make_pod  # noqa: E402
+from tpushare.routes import pprof  # noqa: E402
+
+
+@pytest.fixture
+def cluster(api):
+    api.create_node(make_node("v5e-0", chips=2, hbm_per_chip=16,
+                              topology="2x1"))
+    c = Cluster(api)
+    yield c
+    c.close()
+
+
+def _get(cluster, path):
+    with urllib.request.urlopen(f"{cluster.base}{path}") as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestPprofEndpoints:
+    def test_index(self, cluster):
+        status, body = _get(cluster, "/debug/pprof")
+        assert status == 200 and "/debug/pprof/profile" in body
+
+    def test_goroutine_dump_lists_server_threads(self, cluster):
+        status, body = _get(cluster, "/debug/pprof/goroutine")
+        assert status == 200
+        assert "tpushare-http" in body
+
+    def test_profile_collapsed_stacks(self, cluster):
+        status, body = _get(cluster, "/debug/pprof/profile?seconds=0.2&hz=50")
+        assert status == 200
+        assert body.startswith("# collapsed-stack profile")
+        # the serving thread itself shows up with stack frames joined by ';'
+        assert ";" in body or "samples" in body
+
+    def test_heap_snapshot(self, cluster):
+        status, body = _get(cluster, "/debug/pprof/heap")
+        assert status == 200
+        # first call warms up tracemalloc; second reports sites
+        status, body = _get(cluster, "/debug/pprof/heap")
+        assert status == 200
+        assert "heap profile" in body or "tracemalloc just enabled" in body
+
+
+class TestInspectCLI:
+    def test_render_table_and_summary(self, api, cluster):
+        import kubectl_inspect_tpushare as cli
+
+        api.create_pod(make_pod("p1", hbm=8))
+        assert cluster.schedule(make_pod("p1", hbm=8))[0]
+        doc = cli.fetch(cluster.base, None)
+        out = cli.render(doc)
+        assert "CHIP0(Used/Total)" in out
+        assert "v5e-0" in out
+        assert "8/32 (25%)" in out  # cluster summary line
+
+    def test_render_details_lists_pods(self, api, cluster):
+        import kubectl_inspect_tpushare as cli
+
+        api.create_pod(make_pod("p1", hbm=8))
+        assert cluster.schedule(make_pod("p1", hbm=8))[0]
+        api.update_pod_status("default", "p1", "Running")
+        doc = cli.fetch(cluster.base, "v5e-0")
+        out = cli.render(doc, details=True)
+        assert "default/p1: 8 GiB" in out
+
+    def test_main_against_live_server(self, api, cluster, capsys):
+        import kubectl_inspect_tpushare as cli
+
+        assert cli.main(["--endpoint", cluster.base]) == 0
+        assert "Allocated/Total TPU HBM" in capsys.readouterr().out
+
+    def test_main_unreachable_endpoint(self, capsys):
+        import kubectl_inspect_tpushare as cli
+
+        assert cli.main(["--endpoint", "http://127.0.0.1:1"]) == 1
+        assert "cannot reach" in capsys.readouterr().err

@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""kubectl-inspect-tpushare — cluster TPU-sharing utilization CLI.
+
+Counterpart of the reference's ``kubectl inspect gpushare`` plugin
+(reference ``docs/userguide.md:7-19``): renders the extender's inspect
+API as a per-node, per-chip allocation table plus a cluster summary;
+``-d/--details`` adds the resident pods of every chip.
+
+Install as a kubectl plugin by dropping an executable named
+``kubectl-inspect_tpushare`` on PATH that execs this script, or run it
+directly:
+
+    python tools/kubectl_inspect_tpushare.py [--endpoint URL] [-d] [node]
+
+The endpoint defaults to ``$TPUSHARE_ENDPOINT`` or the NodePort the
+deploy manifests register (http://127.0.0.1:32766).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+DEFAULT_ENDPOINT = os.environ.get("TPUSHARE_ENDPOINT",
+                                  "http://127.0.0.1:32766")
+
+
+def fetch(endpoint: str, node: str | None) -> dict:
+    url = f"{endpoint}/tpushare-scheduler/inspect"
+    if node:
+        url += f"/{node}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def render(doc: dict, details: bool = False) -> str:
+    nodes = doc.get("nodes", [])
+    if not nodes:
+        return "no TPU-sharing nodes found"
+    max_chips = max(len(n.get("chips", [])) for n in nodes)
+
+    headers = ["NAME", "TYPE", "TOPOLOGY"]
+    headers += [f"CHIP{i}(Used/Total)" for i in range(max_chips)]
+    headers += ["HBM GiB(Used/Total)"]
+    rows = [headers]
+    for n in nodes:
+        row = [n.get("name", "?"), n.get("tpuType", "?"),
+               n.get("topology", "?")]
+        chips = n.get("chips", [])
+        for i in range(max_chips):
+            if i < len(chips):
+                row.append(f"{chips[i]['usedHBM']}/{chips[i]['totalHBM']}")
+            else:
+                row.append("-")
+        row.append(f"{n.get('usedHBM', 0)}/{n.get('totalHBM', 0)}")
+        rows.append(row)
+
+    widths = [max(len(r[i]) for r in rows) for i in range(len(headers))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+
+    total = sum(n.get("totalHBM", 0) for n in nodes)
+    used = sum(n.get("usedHBM", 0) for n in nodes)
+    pct = (100.0 * used / total) if total else 0.0
+    lines.append("-" * max(len(s) for s in lines))
+    lines.append("Allocated/Total TPU HBM (GiB) in Cluster:")
+    lines.append(f"{used}/{total} ({pct:.0f}%)")
+
+    if details:
+        for n in nodes:
+            lines.append("")
+            lines.append(f"NODE {n.get('name', '?')}:")
+            for chip in n.get("chips", []):
+                coords = chip.get("coords")
+                where = f" coords={tuple(coords)}" if coords else ""
+                lines.append(f"  chip {chip['id']}{where}: "
+                             f"{chip['usedHBM']}/{chip['totalHBM']} GiB")
+                for pod in chip.get("pods", []):
+                    lines.append(
+                        f"    {pod['namespace']}/{pod['name']}: "
+                        f"{pod['usedHBM']} GiB "
+                        f"(chips {','.join(map(str, pod['chipIds']))})")
+                if not chip.get("pods"):
+                    lines.append("    (idle)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kubectl inspect tpushare",
+        description="Show TPU HBM allocation across sharing nodes.")
+    parser.add_argument("node", nargs="?", help="restrict to one node")
+    parser.add_argument("--endpoint", default=DEFAULT_ENDPOINT,
+                        help=f"extender base URL (default {DEFAULT_ENDPOINT})")
+    parser.add_argument("-d", "--details", action="store_true",
+                        help="show per-chip resident pods")
+    args = parser.parse_args(argv)
+    try:
+        doc = fetch(args.endpoint, args.node)
+    except (urllib.error.URLError, OSError) as e:
+        print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+              file=sys.stderr)
+        return 1
+    print(render(doc, details=args.details))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

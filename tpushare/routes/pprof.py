@@ -1,0 +1,103 @@
+"""Profiling endpoints: the pprof suite, Python-native.
+
+Counterpart of the reference's ``pkg/routes/pprof.go:10-22``, which
+mounted Go's full pprof set (cpu profile, heap, goroutine, trace, ...)
+on the serving router. The analogues here:
+
+* ``profile``   — time-boxed statistical CPU sampler over
+  ``sys._current_frames`` emitting **collapsed-stack** lines (the
+  flamegraph.pl / speedscope input format), our ``/debug/pprof/profile``.
+* ``heap``      — tracemalloc snapshot of top allocation sites
+  (``/debug/pprof/heap``); tracing starts lazily on first call, so an
+  un-profiled server pays nothing.
+* ``goroutine`` — all-threads stack dump (``/debug/pprof/goroutine``,
+  same payload as ``/debug/threads``).
+
+All return plain text, curl-friendly, like Go's pprof endpoints.
+"""
+
+from __future__ import annotations
+
+import collections
+import sys
+import threading
+import time
+import traceback
+
+
+def thread_dump() -> str:
+    """All-threads stack dump (goroutine-profile analogue)."""
+    lines = []
+    for tid, frame in sys._current_frames().items():
+        thread = next((t for t in threading.enumerate()
+                       if t.ident == tid), None)
+        name = thread.name if thread else f"thread-{tid}"
+        lines.append(f"--- {name} ({tid}) ---")
+        lines.extend(traceback.format_stack(frame))
+    return "\n".join(lines)
+
+
+def sample_profile(seconds: float = 5.0, hz: int = 100,
+                   clock=time.monotonic, sleep=time.sleep) -> str:
+    """Statistical profile of every live thread for ``seconds``.
+
+    Samples ``sys._current_frames()`` at ``hz`` and aggregates identical
+    stacks into collapsed form: ``func;func;func count`` per line —
+    pipeable straight into flamegraph tooling. Sampling skips the
+    profiler's own thread.
+    """
+    counts: collections.Counter[str] = collections.Counter()
+    me = threading.get_ident()
+    interval = 1.0 / max(hz, 1)
+    deadline = clock() + seconds
+    samples = 0
+    while clock() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                stack.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})")
+                f = f.f_back
+            counts[";".join(reversed(stack))] += 1
+        samples += 1
+        sleep(interval)
+    header = (f"# collapsed-stack profile: {samples} samples at {hz}Hz "
+              f"over {seconds:.1f}s\n")
+    body = "\n".join(f"{stack} {n}" for stack, n in counts.most_common())
+    return header + body
+
+
+def heap_snapshot(top: int = 30) -> str:
+    """Top allocation sites by live bytes (heap-profile analogue).
+
+    First call enables ``tracemalloc`` and reports a warm-up notice;
+    subsequent calls report the snapshot delta-free, like Go's in-use
+    heap profile.
+    """
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return ("# tracemalloc just enabled; allocations made from now on "
+                "will appear. Re-request this endpoint after some load.\n")
+    snapshot = tracemalloc.take_snapshot()
+    stats = snapshot.statistics("lineno")
+    total = sum(s.size for s in stats)
+    lines = [f"# heap profile: {len(stats)} allocation sites, "
+             f"{total / 1024:.0f} KiB traced"]
+    for stat in stats[:top]:
+        frame = stat.traceback[0]
+        lines.append(f"{stat.size / 1024:10.1f} KiB {stat.count:8d} objs  "
+                     f"{frame.filename.rsplit('/', 1)[-1]}:{frame.lineno}")
+    return "\n".join(lines)
+
+
+def index(prefix: str = "/debug/pprof") -> str:
+    return (
+        "tpushare pprof endpoints (reference pkg/routes/pprof.go analogue)\n"
+        f"  {prefix}/profile?seconds=5&hz=100  CPU profile, collapsed stacks\n"
+        f"  {prefix}/heap                      live-allocation snapshot\n"
+        f"  {prefix}/goroutine                 all-threads stack dump\n")

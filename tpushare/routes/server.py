@@ -9,7 +9,9 @@ Routes:
 * ``GET  /version``         — version string (routes.go:150-156)
 * ``GET  /healthz``         — liveness
 * ``GET  /metrics``         — Prometheus (new; SURVEY.md §5 gap)
-* ``GET  /debug/threads``   — stack dump of all threads (pprof analogue)
+* ``GET  /debug/pprof``     — profiling suite (reference pprof.go:10-22):
+  ``/profile`` (sampled CPU, collapsed stacks), ``/heap`` (tracemalloc),
+  ``/goroutine`` (= ``/debug/threads``, all-threads stack dump)
 
 A malformed body is rejected with HTTP 400 *and the handler returns* —
 the reference kept executing after writing the 400 (``checkBody``,
@@ -24,14 +26,12 @@ from __future__ import annotations
 
 import json
 import logging
-import sys
 import threading
-import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import tpushare
 from tpushare.api.extender import ExtenderArgs, ExtenderBindingArgs
-from tpushare.routes import metrics
+from tpushare.routes import metrics, pprof
 
 log = logging.getLogger(__name__)
 
@@ -89,6 +89,12 @@ class _Handler(BaseHTTPRequestHandler):
             return None
 
     # -- verbs -------------------------------------------------------------
+    def _query(self) -> dict[str, str]:
+        if "?" not in self.path:
+            return {}
+        from urllib.parse import parse_qsl
+        return dict(parse_qsl(self.path.split("?", 1)[1]))
+
     def do_GET(self):  # noqa: N802 (stdlib casing)
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         prefix = self.server.prefix
@@ -101,8 +107,22 @@ class _Handler(BaseHTTPRequestHandler):
                 # Refresh per-node utilization gauges on scrape.
                 metrics.observe_cache(self.server.inspect.cache)
                 self._send_text(metrics.render(), ctype="text/plain; version=0.0.4")
-            elif path == "/debug/threads":
-                self._send_text(_thread_dump().encode())
+            elif path in ("/debug/threads", "/debug/pprof/goroutine"):
+                self._send_text(pprof.thread_dump().encode())
+            elif path == "/debug/pprof":
+                self._send_text(pprof.index().encode())
+            elif path == "/debug/pprof/profile":
+                q = self._query()
+                try:
+                    seconds = min(max(float(q.get("seconds", "5")), 0.1), 60.0)
+                    hz = min(max(int(q.get("hz", "100")), 1), 1000)
+                except ValueError:
+                    self._send_json(
+                        {"Error": "seconds/hz must be numeric"}, 400)
+                    return
+                self._send_text(pprof.sample_profile(seconds, hz).encode())
+            elif path == "/debug/pprof/heap":
+                self._send_text(pprof.heap_snapshot().encode())
             elif path == f"{prefix}/inspect" or path.startswith(f"{prefix}/inspect/"):
                 node = None
                 rest = path[len(f"{prefix}/inspect"):]
@@ -144,18 +164,6 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # pragma: no cover - defensive
             log.exception("POST %s failed", path)
             self._send_json({"Error": str(e)}, 500)
-
-
-def _thread_dump() -> str:
-    """All-threads stack dump — the goroutine-profile analogue of the
-    reference's pprof mount (pkg/routes/pprof.go:10-22)."""
-    lines = []
-    for tid, frame in sys._current_frames().items():
-        thread = next((t for t in threading.enumerate() if t.ident == tid), None)
-        name = thread.name if thread else f"thread-{tid}"
-        lines.append(f"--- {name} ({tid}) ---")
-        lines.extend(traceback.format_stack(frame))
-    return "\n".join(lines)
 
 
 def serve_forever(server: ExtenderHTTPServer) -> threading.Thread:
