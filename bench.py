@@ -14,10 +14,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 from __future__ import annotations
 
+import http.client
 import json
 import statistics
 import time
-import urllib.error
 import urllib.request
 
 NODES = 16
@@ -27,15 +27,24 @@ CHIPS, CHIP_HBM = 4, 95
 TARGET_UTIL = 90.0    # BASELINE.json north star
 
 
-def post(base, path, doc):
-    req = urllib.request.Request(
-        f"{base}{path}", data=json.dumps(doc).encode(),
-        headers={"Content-Type": "application/json"})
-    try:
-        with urllib.request.urlopen(req) as resp:
-            return resp.status, json.loads(resp.read())
-    except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
+class ExtenderClient:
+    """Persistent keep-alive connection, like kube-scheduler's HTTP
+    transport (connection reuse is the production calling pattern; a
+    fresh TCP handshake per webhook call would charge the benchmark for
+    connection setup the scheduler never pays)."""
+
+    def __init__(self, host: str, port: int):
+        self.conn = http.client.HTTPConnection(host, port)
+
+    def post(self, path, doc):
+        body = json.dumps(doc).encode()
+        self.conn.request("POST", path, body,
+                          {"Content-Type": "application/json"})
+        resp = self.conn.getresponse()
+        return resp.status, json.loads(resp.read())
+
+    def close(self):
+        self.conn.close()
 
 
 def main() -> None:
@@ -54,7 +63,9 @@ def main() -> None:
     controller.start(workers=4)
     server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect)
     serve_forever(server)
-    base = f"http://127.0.0.1:{server.server_address[1]}"
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    client = ExtenderClient(host, port)
     node_names = [f"v5p-{i:02d}" for i in range(NODES)]
 
     latencies = []
@@ -63,17 +74,19 @@ def main() -> None:
         doc = make_pod(f"infer-{i:03d}", hbm=POD_HBM)
         pod = api.create_pod(doc)
         t0 = time.perf_counter()
-        status, result = post(base, "/tpushare-scheduler/filter",
-                              {"Pod": pod.raw, "NodeNames": node_names})
+        status, result = client.post("/tpushare-scheduler/filter",
+                                     {"Pod": pod.raw,
+                                      "NodeNames": node_names})
         assert status == 200, result
         candidates = result["NodeNames"]
         assert candidates, f"pod {i} found no node: {result['FailedNodes']}"
-        status, bind_result = post(base, "/tpushare-scheduler/bind", {
+        status, bind_result = client.post("/tpushare-scheduler/bind", {
             "PodName": pod.name, "PodNamespace": pod.namespace,
             "PodUID": pod.uid, "Node": candidates[0]})
         latencies.append((time.perf_counter() - t0) * 1000.0)
         assert status == 200, bind_result
         bound += 1
+    client.close()
 
     # Utilization from the inspect API (the operator's view).
     with urllib.request.urlopen(f"{base}/tpushare-scheduler/inspect") as r:

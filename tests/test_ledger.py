@@ -136,9 +136,13 @@ class TestAllocateCommit:
         pod = api.create_pod(make_pod("p", hbm=16))
         placed = info.allocate(api, pod)
         assert info.get_available_hbm()[0] == 0
-        done = Pod(placed.raw)
+        import copy
+        done = Pod(copy.deepcopy(placed.raw))
         done.raw["status"] = {"phase": "Succeeded"}
-        # used-HBM accounting ignores complete pods even before removal
+        # the completion MODIFIED event re-prices the pod to zero (the
+        # ledger is O(1) incremental; updates flow through add_or_update,
+        # which is how the sync controller delivers phase changes)
+        info.add_or_update_pod(done)
         assert info.get_available_hbm()[0] == 16
         info.remove_pod(done)
         assert info.get_available_hbm()[0] == 16

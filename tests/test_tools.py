@@ -43,13 +43,41 @@ class TestPprofEndpoints:
         # the serving thread itself shows up with stack frames joined by ';'
         assert ";" in body or "samples" in body
 
-    def test_heap_snapshot(self, cluster):
+    def test_heap_snapshot_and_stop(self, cluster):
+        import tracemalloc
+
         status, body = _get(cluster, "/debug/pprof/heap")
         assert status == 200
         # first call warms up tracemalloc; second reports sites
         status, body = _get(cluster, "/debug/pprof/heap")
         assert status == 200
         assert "heap profile" in body or "tracemalloc just enabled" in body
+        # stop=1 turns the allocation tax back off
+        status, body = _get(cluster, "/debug/pprof/heap?stop=1")
+        assert status == 200 and "stopped" in body
+        assert not tracemalloc.is_tracing()
+
+    def test_concurrent_profiles_rejected(self, cluster):
+        import threading
+        import urllib.error
+
+        results = {}
+
+        def profile(key, seconds):
+            try:
+                results[key] = _get(
+                    cluster, f"/debug/pprof/profile?seconds={seconds}&hz=20")
+            except urllib.error.HTTPError as e:
+                results[key] = (e.code, e.read().decode())
+
+        t1 = threading.Thread(target=profile, args=("long", 0.8))
+        t1.start()
+        import time
+        time.sleep(0.2)  # ensure the first profiler holds the lock
+        profile("second", 0.2)
+        t1.join()
+        statuses = sorted(results[k][0] for k in results)
+        assert statuses == [200, 409], results
 
 
 class TestInspectCLI:

@@ -69,6 +69,11 @@ class SchedulerCache:
                 return self._nodes.get(name)
         with self._lock:
             info = self._nodes.get(name)
+            if (info is not None and node.resource_version
+                    and info.node.resource_version == node.resource_version):
+                # Node document unchanged since we built the ledger: skip
+                # the annotation re-parse on the filter hot path.
+                return info
             fresh_caps = nodeutils.get_chip_capacities(node)
             if info is None or [c.total_hbm for c in
                                 (info.chips[i] for i in sorted(info.chips))] != fresh_caps:

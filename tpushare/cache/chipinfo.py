@@ -22,36 +22,47 @@ class ChipInfo:
         self.idx = idx
         self.total_hbm = total_hbm
         self.pods: dict[str, Pod] = {}  # uid -> Pod
+        self._contrib: dict[str, int] = {}  # uid -> GiB counted
+        self._used = 0
         self._lock = threading.RLock()
 
-    def add_pod(self, pod: Pod) -> None:
-        """Register ``pod`` as resident (reference deviceinfo.go:56-66)."""
-        with self._lock:
-            self.pods[pod.uid] = pod
-
-    def remove_pod(self, pod: Pod) -> None:
-        """Drop ``pod`` (reference deviceinfo.go:68-80)."""
-        with self._lock:
-            self.pods.pop(pod.uid, None)
-
-    def get_used_hbm(self) -> int:
-        """HBM GiB currently committed on this chip.
+    def _contribution(self, pod: Pod) -> int:
+        """What ``pod`` pins on this chip.
 
         Counterpart of reference deviceinfo.go:41-54, with two fixes:
         deletion-timestamped pods count as free (defect 6 in SURVEY.md §2),
         and a pod holding multiple whole chips pins this chip's full
         capacity rather than smearing its aggregate grant.
         """
+        if podutils.is_complete_pod(pod):
+            return 0
+        if len(podutils.get_chip_ids_from_annotation(pod)) > 1:
+            return self.total_hbm
+        return podutils.pod_used_hbm(pod)
+
+    def add_pod(self, pod: Pod) -> None:
+        """Register ``pod`` as resident (reference deviceinfo.go:56-66).
+        Re-adding with a newer pod object (phase change) re-prices it."""
         with self._lock:
-            used = 0
-            for p in self.pods.values():
-                if podutils.is_complete_pod(p):
-                    continue
-                if len(podutils.get_chip_ids_from_annotation(p)) > 1:
-                    used += self.total_hbm
-                else:
-                    used += podutils.pod_used_hbm(p)
-            return used
+            self.pods[pod.uid] = pod
+            self._used -= self._contrib.get(pod.uid, 0)
+            self._contrib[pod.uid] = self._contribution(pod)
+            self._used += self._contrib[pod.uid]
+
+    def remove_pod(self, pod: Pod) -> None:
+        """Drop ``pod`` (reference deviceinfo.go:68-80)."""
+        with self._lock:
+            if self.pods.pop(pod.uid, None) is not None:
+                self._used -= self._contrib.pop(pod.uid, 0)
+
+    def get_used_hbm(self) -> int:
+        """HBM GiB currently committed on this chip — O(1): the ledger
+        prices each pod once at add/update time instead of re-summing
+        the resident set on every filter query (the reference recomputed
+        per query, deviceinfo.go:41-54, which scales O(pods) on the
+        scheduler's hot path)."""
+        with self._lock:
+            return self._used
 
     def snapshot_pods(self) -> list[Pod]:
         with self._lock:

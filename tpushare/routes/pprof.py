@@ -37,6 +37,16 @@ def thread_dump() -> str:
     return "\n".join(lines)
 
 
+#: Only one CPU profile may run at a time (Go's pprof likewise rejects a
+#: concurrent CPU profile) — N stacked samplers would each walk every
+#: thread's frames under the GIL and tax the webhook hot path.
+_profile_lock = threading.Lock()
+
+
+class ProfileBusyError(Exception):
+    pass
+
+
 def sample_profile(seconds: float = 5.0, hz: int = 100,
                    clock=time.monotonic, sleep=time.sleep) -> str:
     """Statistical profile of every live thread for ``seconds``.
@@ -44,8 +54,18 @@ def sample_profile(seconds: float = 5.0, hz: int = 100,
     Samples ``sys._current_frames()`` at ``hz`` and aggregates identical
     stacks into collapsed form: ``func;func;func count`` per line —
     pipeable straight into flamegraph tooling. Sampling skips the
-    profiler's own thread.
+    profiler's own thread. Raises :class:`ProfileBusyError` when a
+    profile is already in progress.
     """
+    if not _profile_lock.acquire(blocking=False):
+        raise ProfileBusyError("a CPU profile is already in progress")
+    try:
+        return _sample_profile_locked(seconds, hz, clock, sleep)
+    finally:
+        _profile_lock.release()
+
+
+def _sample_profile_locked(seconds, hz, clock, sleep) -> str:
     counts: collections.Counter[str] = collections.Counter()
     me = threading.get_ident()
     interval = 1.0 / max(hz, 1)
@@ -70,19 +90,26 @@ def sample_profile(seconds: float = 5.0, hz: int = 100,
     return header + body
 
 
-def heap_snapshot(top: int = 30) -> str:
+def heap_snapshot(top: int = 30, stop: bool = False) -> str:
     """Top allocation sites by live bytes (heap-profile analogue).
 
     First call enables ``tracemalloc`` and reports a warm-up notice;
-    subsequent calls report the snapshot delta-free, like Go's in-use
-    heap profile.
+    subsequent calls report the snapshot. Tracing taxes every allocation,
+    so ``stop=True`` (``?stop=1`` on the endpoint) turns it back off once
+    debugging is done — heap profiling is opt-in per incident, not an
+    always-on cost on the webhook hot path.
     """
     import tracemalloc
 
+    if stop:
+        if tracemalloc.is_tracing():
+            tracemalloc.stop()
+        return "# tracemalloc stopped; heap tracing is off.\n"
     if not tracemalloc.is_tracing():
         tracemalloc.start()
         return ("# tracemalloc just enabled; allocations made from now on "
-                "will appear. Re-request this endpoint after some load.\n")
+                "will appear. Re-request this endpoint after some load; "
+                "finish with ?stop=1 to disable tracing overhead.\n")
     snapshot = tracemalloc.take_snapshot()
     stats = snapshot.statistics("lineno")
     total = sum(s.size for s in stats)
@@ -99,5 +126,6 @@ def index(prefix: str = "/debug/pprof") -> str:
     return (
         "tpushare pprof endpoints (reference pkg/routes/pprof.go analogue)\n"
         f"  {prefix}/profile?seconds=5&hz=100  CPU profile, collapsed stacks\n"
-        f"  {prefix}/heap                      live-allocation snapshot\n"
+        f"  {prefix}/heap[?stop=1]             live-allocation snapshot "
+        "(stop=1 disables tracing)\n"
         f"  {prefix}/goroutine                 all-threads stack dump\n")

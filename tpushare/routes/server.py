@@ -53,6 +53,9 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Webhook latency sits on the scheduler's critical path: never let
+    # Nagle hold a small JSON response hostage to a delayed ACK.
+    disable_nagle_algorithm = True
     server: ExtenderHTTPServer
 
     # -- plumbing ----------------------------------------------------------
@@ -120,9 +123,14 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(
                         {"Error": "seconds/hz must be numeric"}, 400)
                     return
-                self._send_text(pprof.sample_profile(seconds, hz).encode())
+                try:
+                    self._send_text(
+                        pprof.sample_profile(seconds, hz).encode())
+                except pprof.ProfileBusyError as e:
+                    self._send_json({"Error": str(e)}, 409)
             elif path == "/debug/pprof/heap":
-                self._send_text(pprof.heap_snapshot().encode())
+                stop = self._query().get("stop") in ("1", "true")
+                self._send_text(pprof.heap_snapshot(stop=stop).encode())
             elif path == f"{prefix}/inspect" or path.startswith(f"{prefix}/inspect/"):
                 node = None
                 rest = path[len(f"{prefix}/inspect"):]
