@@ -139,9 +139,11 @@ class TestAllocateCommit:
         import copy
         done = Pod(copy.deepcopy(placed.raw))
         done.raw["status"] = {"phase": "Succeeded"}
-        # the completion MODIFIED event re-prices the pod to zero (the
-        # ledger is O(1) incremental; updates flow through add_or_update,
-        # which is how the sync controller delivers phase changes)
+        # Re-pricing a completed pod to zero via add_or_update covers
+        # update events that arrive before the controller's removal (the
+        # controller's sync path frees completed pods with remove_pod,
+        # controller.py sync_pod; both routes must leave the O(1)
+        # counters right)
         info.add_or_update_pod(done)
         assert info.get_available_hbm()[0] == 16
         info.remove_pod(done)

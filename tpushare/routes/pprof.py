@@ -90,6 +90,12 @@ def _sample_profile_locked(seconds, hz, clock, sleep) -> str:
     return header + body
 
 
+#: Serializes start/stop/snapshot on tracemalloc: concurrent ?stop=1 and
+#: snapshot requests on the threading server must not race (stop between
+#: is_tracing() and take_snapshot() would 500 the snapshot).
+_heap_lock = threading.Lock()
+
+
 def heap_snapshot(top: int = 30, stop: bool = False) -> str:
     """Top allocation sites by live bytes (heap-profile analogue).
 
@@ -101,6 +107,11 @@ def heap_snapshot(top: int = 30, stop: bool = False) -> str:
     """
     import tracemalloc
 
+    with _heap_lock:
+        return _heap_snapshot_locked(tracemalloc, top, stop)
+
+
+def _heap_snapshot_locked(tracemalloc, top: int, stop: bool) -> str:
     if stop:
         if tracemalloc.is_tracing():
             tracemalloc.stop()

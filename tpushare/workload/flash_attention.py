@@ -1,0 +1,220 @@
+"""Pallas flash attention: the workload's hot-op kernel on TPU.
+
+Causal attention is the one op in the flagship model XLA cannot fuse into
+a single HBM-friendly pass on its own: the naive path materializes the
+[L, L] score matrix in HBM. This kernel runs the standard blockwise
+online-softmax decomposition entirely in VMEM — Q tiles stream over KV
+tiles, keeping a running max/normalizer/accumulator in fp32 — so HBM
+traffic is O(L·D) instead of O(L²), and the two matmuls per tile land on
+the MXU with fp32 accumulation.
+
+Design notes (per the TPU kernel playbook):
+
+* grid = (batch·heads, Lq/BLK_Q, Lkv/BLK_K) with the KV axis innermost
+  and sequential ("arbitrary" semantics): KV streams through VMEM one
+  tile at a time while the online-softmax carries (m, l, acc) persist in
+  VMEM scratch across the KV axis — VMEM usage is bounded by the tile
+  sizes, independent of L, so 32k+ contexts fit.
+* tiles above the causal diagonal are skipped wholesale with ``pl.when``
+  (no compute, no result write).
+* tiles are 128-multiples (MXU/VPU alignment); positions come from
+  ``broadcasted_iota`` (1-D iota does not exist on TPU).
+* matmuls request ``preferred_element_type=jnp.float32`` so bf16 inputs
+  accumulate in fp32 on the MXU.
+* the kernel is forward-only; gradients flow through a ``custom_vjp``
+  whose backward recomputes attention with the XLA path at the same
+  primal point (exact same math, so grads are exact). Training keeps the
+  forward's memory win via remat; a fused backward kernel is the natural
+  next step.
+
+Falls back to the XLA einsum path (:func:`model.causal_attention`) when
+shapes are not tile-aligned or Pallas is unavailable; on CPU the kernel
+runs in interpreter mode so tests exercise the real kernel logic.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover - pallas ships with jax on TPU
+    HAVE_PALLAS = False
+
+NEG_INF = -2.0 ** 30  # large-but-finite: keeps exp() exact zeros, no NaNs
+
+
+# --------------------------------------------------------------------------
+# Kernel
+# --------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  blk_q: int, blk_k: int, scale: float):
+    """One (Q tile, KV tile) cell of the grid.
+
+    The KV axis is the innermost, sequential grid dimension; m/l/acc
+    scratch persists across it, so this function is the loop body of the
+    online softmax with ``pl.when`` supplying init (first KV tile) and
+    finalize (last KV tile)."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Whole tile above the causal diagonal: nothing to do.
+    @pl.when(kj * blk_k <= qi * blk_q + blk_q - 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale         # [blk_q, D]
+        k_blk = k_ref[0]                                 # [blk_k, D]
+        v_blk = v_ref[0]
+        s = jnp.dot(q, k_blk.T.astype(jnp.float32),
+                    preferred_element_type=jnp.float32)  # [blk_q, blk_k]
+        q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 0)
+        kv_pos = kj * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 1)
+        s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
+
+        m = m_ref[:, :1]                                 # [blk_q, 1]
+        l = l_ref[:, :1]
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, v_blk.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _tile(n: int, cap: int = 512) -> int:
+    """Largest 128-multiple tile ≤ cap dividing n (0 = not tileable)."""
+    for blk in (cap, 256, 128):
+        if n % blk == 0:
+            return blk
+    return 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _flash_call(q, k, v, interpret: bool = False):
+    """q/k/v: [BH, L, D] -> [BH, L, D]. VMEM is bounded by the tile
+    sizes (KV streams through the grid), so any L compiles."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    blk_q = _tile(lq)
+    blk_k = _tile(lk)
+    scale = 1.0 / math.sqrt(d)
+    grid = (bh, lq // blk_q, lk // blk_k)
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return pl.pallas_call(
+        functools.partial(_flash_kernel, blk_q=blk_q, blk_k=blk_k,
+                          scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 128), jnp.float32),   # running max m
+            pltpu.VMEM((blk_q, 128), jnp.float32),   # normalizer l
+            pltpu.VMEM((blk_q, d), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v)
+
+
+# --------------------------------------------------------------------------
+# Public entry: custom-vjp wrapper over [B, L, H, D]
+# --------------------------------------------------------------------------
+
+def _xla_reference(q, k, v):
+    from tpushare.workload import model as M
+    return M.causal_attention(q, k, v)
+
+
+def supported(q, k, v) -> bool:
+    """Can the kernel take these shapes? (tile-aligned, self-attention)"""
+    if not HAVE_PALLAS or os.environ.get("TPUSHARE_NO_PALLAS"):
+        return False
+    if q.shape != k.shape or k.shape != v.shape:
+        return False
+    return _tile(q.shape[1]) != 0
+
+
+def _forward(q, k, v, interpret: bool):
+    b, lq, h, d = q.shape
+    if _tile(lq) == 0 or _tile(k.shape[1]) == 0 or q.shape != k.shape \
+            or k.shape != v.shape:
+        # Shapes the kernel cannot tile: the documented XLA fallback
+        # (shapes are static at trace time, so this is a Python branch).
+        return _xla_reference(q, k, v)
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    out = _flash_call(to_bh(q), to_bh(k), to_bh(v), interpret=interpret)
+    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q, k, v, interpret: bool = False):
+    """Causal flash attention, [B, L, H, D] layout (the model's)."""
+    return _forward(q, k, v, interpret)
+
+
+def _fwd(q, k, v, interpret):
+    return _forward(q, k, v, interpret), (q, k, v)
+
+
+def _bwd(interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(_xla_reference, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def _auto_attn(q, k, v):
+    """Kernel when the (static, trace-time) shapes allow, XLA otherwise."""
+    if supported(q, k, v):
+        return flash_attention(q, k, v)
+    return _xla_reference(q, k, v)
+
+
+def best_attn_fn(seq_len: int):
+    """Pick the attention implementation for this platform/shape:
+    the Pallas kernel on TPU (tile-aligned shapes, with a trace-time
+    fallback for odd shapes), XLA einsum otherwise. CPU gets the XLA
+    path — interpreter mode is for tests, not speed."""
+    platform = jax.default_backend()
+    if platform == "tpu" and _tile(seq_len) != 0 \
+            and not os.environ.get("TPUSHARE_NO_PALLAS"):
+        return _auto_attn
+    return _xla_reference

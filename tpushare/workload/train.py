@@ -72,9 +72,19 @@ def make_train_step(cfg: M.ModelConfig, mesh=None, optimizer=None,
     return init_fn, step, lambda t, g: (t, g)
 
 
-def make_forward_fn(cfg: M.ModelConfig):
-    """Jittable single-device forward (the graft entry surface)."""
+def make_forward_fn(cfg: M.ModelConfig, seq_len: int | None = None):
+    """Jittable single-device forward (the graft entry surface).
+
+    On TPU with tile-aligned sequence lengths, attention runs as the
+    Pallas flash kernel (flash_attention.py); elsewhere the XLA path.
+    """
+    from tpushare.workload import flash_attention as FA
+
+    attn_fn = FA.best_attn_fn(seq_len or cfg.max_seq_len)
+    if attn_fn is FA._xla_reference:
+        attn_fn = None  # model default
+
     @jax.jit
     def fwd(params, tokens):
-        return M.forward(params, tokens, cfg)
+        return M.forward(params, tokens, cfg, attn_fn=attn_fn)
     return fwd
