@@ -237,9 +237,15 @@ class GangPlanner:
                 log.warning("gang %s/%s: expired at %d/%d members; rolling "
                             "back", key[0], group.name,
                             len(group.reservations), group.minimum)
+                from tpushare.k8s import events
                 for pod, _node in group.reservations.values():
                     self.cache.remove_pod(pod)
                     self._strip_annotations(pod)
+                    events.record(
+                        self.client, pod, events.REASON_GANG_EXPIRED,
+                        f"gang {group.name} expired at "
+                        f"{len(group.reservations)}/{group.minimum} members; "
+                        "reservation rolled back", event_type="Warning")
                 group.reservations.clear()
                 with self._table_lock:
                     self._groups.pop(key, None)

@@ -1,0 +1,65 @@
+"""Kubernetes Event emission.
+
+The reference wired an event broadcaster to the apiserver but never
+actually emitted an event on any code path (SURVEY.md §5 observability
+gap). Here bind outcomes are recorded as real v1 Events, so
+``kubectl describe pod`` explains TPU placement decisions — including
+why a pod is waiting on its gang.
+"""
+
+from __future__ import annotations
+
+import datetime
+import itertools
+import logging
+
+from tpushare.api.objects import Pod
+
+log = logging.getLogger(__name__)
+
+_seq = itertools.count(1)
+
+COMPONENT = "tpushare-scheduler-extender"
+
+REASON_BOUND = "TPUShareBound"
+REASON_BIND_FAILED = "TPUShareBindFailed"
+REASON_GANG_PENDING = "TPUShareGangPending"
+REASON_GANG_EXPIRED = "TPUShareGangExpired"
+
+
+def record(client, pod: Pod, reason: str, message: str,
+           event_type: str = "Normal") -> None:
+    """Best-effort Event creation; never lets observability break the
+    scheduling path."""
+    now_dt = datetime.datetime.now(datetime.timezone.utc)
+    now = now_dt.strftime("%Y-%m-%dT%H:%M:%SZ")
+    # Name like client-go's recorder: pod + a time-derived component, so
+    # names stay unique across scheduler restarts (a process-local counter
+    # alone would collide with still-retained Events and 409 silently).
+    stamp = int(now_dt.timestamp() * 1e9)
+    event = {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "name": f"{pod.name}.{stamp:x}.{next(_seq):x}",
+            "namespace": pod.namespace,
+        },
+        "involvedObject": {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "name": pod.name,
+            "namespace": pod.namespace,
+            "uid": pod.uid,
+        },
+        "reason": reason,
+        "message": message,
+        "type": event_type,
+        "source": {"component": COMPONENT},
+        "firstTimestamp": now,
+        "lastTimestamp": now,
+        "count": 1,
+    }
+    try:
+        client.create_event(pod.namespace, event)
+    except Exception as exc:  # noqa: BLE001 - observability must not throw
+        log.debug("event emission failed for %s: %s", pod.key(), exc)

@@ -30,6 +30,7 @@ class FakeApiServer:
         self._rv = itertools.count(1)
         self._watchers: list[queue.Queue] = []
         self._uid = itertools.count(1)
+        self.events: list[tuple[str, dict]] = []  # (namespace, event doc)
 
     # ------------------------------------------------------------------ #
     # Watch plumbing (client-go LIST/WATCH analogue)
@@ -140,6 +141,15 @@ class FakeApiServer:
             pod.setdefault("spec", {})["nodeName"] = binding["target"]["name"]
             self._bump(pod)
             self._notify("Pod", "MODIFIED", pod)
+
+    # ------------------------------------------------------------------ #
+    # Events (reference wired an apiserver event recorder,
+    # controller.go:63-67; tests assert on what we emit through it)
+    # ------------------------------------------------------------------ #
+
+    def create_event(self, namespace: str, event: dict) -> None:
+        with self._lock:
+            self.events.append((namespace, copy.deepcopy(event)))
 
     # ------------------------------------------------------------------ #
     # Nodes

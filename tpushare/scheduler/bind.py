@@ -17,7 +17,10 @@ import logging
 from tpushare.api.extender import ExtenderBindingArgs, ExtenderBindingResult
 from tpushare.cache.cache import SchedulerCache
 from tpushare.cache.nodeinfo import AllocationError
+from tpushare.gang.planner import GangPending
+from tpushare.k8s import events
 from tpushare.k8s.errors import ApiError
+from tpushare.utils import const
 from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
@@ -69,8 +72,20 @@ class Bind:
             else:
                 new_pod = info.allocate(self.client, pod)
                 self.cache.add_or_update_pod(new_pod)
+                events.record(
+                    self.client, new_pod, events.REASON_BOUND,
+                    f"bound to node {args.node} chip(s) "
+                    f"{new_pod.annotations.get(const.ANN_CHIP_IDX)} "
+                    f"({new_pod.annotations.get(const.ANN_HBM_POD)} GiB HBM)")
             return ExtenderBindingResult()
         except (AllocationError, ApiError) as e:
             log.warning("bind failed for pod %s/%s on node %s: %s",
                         args.pod_namespace, args.pod_name, args.node, e)
+            if isinstance(e, GangPending):
+                # Not a failure: the member is reserved, waiting on quorum.
+                events.record(self.client, pod,
+                              events.REASON_GANG_PENDING, str(e))
+            else:
+                events.record(self.client, pod, events.REASON_BIND_FAILED,
+                              f"node {args.node}: {e}", event_type="Warning")
             return ExtenderBindingResult(error=str(e))

@@ -172,10 +172,12 @@ def supported(q, k, v) -> bool:
 
 def _forward(q, k, v, interpret: bool):
     b, lq, h, d = q.shape
-    if _tile(lq) == 0 or _tile(k.shape[1]) == 0 or q.shape != k.shape \
-            or k.shape != v.shape:
-        # Shapes the kernel cannot tile: the documented XLA fallback
-        # (shapes are static at trace time, so this is a Python branch).
+    if not supported(q, k, v) or \
+            (not interpret and jax.default_backend() != "tpu"):
+        # No Pallas, kill-switch env set, shapes the kernel cannot tile,
+        # or a non-TPU backend without interpreter mode: the documented
+        # XLA fallback (everything here is static at trace time, so this
+        # is a Python branch).
         return _xla_reference(q, k, v)
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     out = _flash_call(to_bh(q), to_bh(k), to_bh(v), interpret=interpret)
