@@ -55,17 +55,28 @@ NEG_INF = -2.0 ** 30  # large-but-finite: keeps exp() exact zeros, no NaNs
 # Kernel
 # --------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                  blk_q: int, blk_k: int, scale: float):
+def _flash_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  m_ref, l_ref, acc_ref, *, blk_q: int, blk_k: int,
+                  scale: float):
     """One (Q tile, KV tile) cell of the grid.
 
     The KV axis is the innermost, sequential grid dimension; m/l/acc
     scratch persists across it, so this function is the loop body of the
     online softmax with ``pl.when`` supplying init (first KV tile) and
-    finalize (last KV tile)."""
+    finalize (last KV tile).
+
+    ``qo_ref``/``ko_ref`` are SMEM scalars giving the GLOBAL position of
+    element 0 of the Q and KV blocks: the causal mask compares global
+    positions, which is what lets one kernel serve both self-attention
+    (offsets 0/0) and a ring-attention step (offsets = shard offsets).
+    The second output is the log-sum-exp per query row, the statistic the
+    ring merge needs to combine partial attentions exactly.
+    """
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
+    q_off = qo_ref[0, 0]
+    kv_off = ko_ref[0, 0]
 
     @pl.when(kj == 0)
     def _init():
@@ -73,17 +84,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Whole tile above the causal diagonal: nothing to do.
-    @pl.when(kj * blk_k <= qi * blk_q + blk_q - 1)
+    # Whole tile above the causal diagonal (in global positions): skip.
+    @pl.when(kv_off + kj * blk_k <= q_off + qi * blk_q + blk_q - 1)
     def _compute():
         q = q_ref[0].astype(jnp.float32) * scale         # [blk_q, D]
         k_blk = k_ref[0]                                 # [blk_k, D]
         v_blk = v_ref[0]
         s = jnp.dot(q, k_blk.T.astype(jnp.float32),
                     preferred_element_type=jnp.float32)  # [blk_q, blk_k]
-        q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+        q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(
             jnp.int32, (blk_q, blk_k), 0)
-        kv_pos = kj * blk_k + jax.lax.broadcasted_iota(
+        kv_pos = kv_off + kj * blk_k + jax.lax.broadcasted_iota(
             jnp.int32, (blk_q, blk_k), 1)
         s = jnp.where(q_pos >= kv_pos, s, NEG_INF)
 
@@ -101,8 +112,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(kj == n_kv - 1)
     def _finalize():
+        m = m_ref[:, :1]
         l = l_ref[:, :1]
         o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # lse = m + log(l); fully-masked rows (l == 0) report NEG_INF so
+        # the ring merge weighs them at exactly zero.
+        lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+        # lse block is (1, 8, blk_q): 8 identical sublanes to satisfy the
+        # TPU (8, 128) fp32 tiling; callers read row 0.
+        lse_ref[0] = jnp.broadcast_to(lse.T, lse_ref[0].shape)
 
 
 def _tile(n: int, cap: int = 512) -> int:
@@ -113,16 +131,33 @@ def _tile(n: int, cap: int = 512) -> int:
     return 0
 
 
+def kernel_eligible(seq_len: int) -> bool:
+    """THE gate for running the compiled kernel: pallas importable, the
+    kill switch unset, and a tile-aligned sequence. Platform checks layer
+    on top at each call site (single source for the env var + tiling)."""
+    return (HAVE_PALLAS and _tile(seq_len) != 0
+            and not os.environ.get("TPUSHARE_NO_PALLAS"))
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _flash_call(q, k, v, interpret: bool = False):
-    """q/k/v: [BH, L, D] -> [BH, L, D]. VMEM is bounded by the tile
-    sizes (KV streams through the grid), so any L compiles."""
+def _flash_call(q, k, v, q_offset=None, kv_offset=None,
+                interpret: bool = False):
+    """q/k/v: [BH, L, D] -> ([BH, L, D] out, [BH, L] f32 lse).
+
+    VMEM is bounded by the tile sizes (KV streams through the grid), so
+    any L compiles. Offsets are traced int32 scalars (global position of
+    element 0 of the Q / KV block) delivered to the kernel via SMEM.
+    """
     bh, lq, d = q.shape
     lk = k.shape[1]
     blk_q = _tile(lq)
     blk_k = _tile(lk)
     scale = 1.0 / math.sqrt(d)
     grid = (bh, lq // blk_q, lk // blk_k)
+    q_off = jnp.asarray(0 if q_offset is None else q_offset,
+                        jnp.int32).reshape(1, 1)
+    kv_off = jnp.asarray(0 if kv_offset is None else kv_offset,
+                         jnp.int32).reshape(1, 1)
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
@@ -132,6 +167,10 @@ def _flash_call(q, k, v, interpret: bool = False):
                           scale=scale),
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
+                         memory_space=pltpu.SMEM),
             pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0),
@@ -139,9 +178,16 @@ def _flash_call(q, k, v, interpret: bool = False):
             pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, blk_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, lq), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((blk_q, 128), jnp.float32),   # running max m
             pltpu.VMEM((blk_q, 128), jnp.float32),   # normalizer l
@@ -149,7 +195,7 @@ def _flash_call(q, k, v, interpret: bool = False):
         ],
         interpret=interpret,
         **kwargs,
-    )(q, k, v)
+    )(q_off, kv_off, q, k, v)
 
 
 # --------------------------------------------------------------------------
@@ -163,11 +209,9 @@ def _xla_reference(q, k, v):
 
 def supported(q, k, v) -> bool:
     """Can the kernel take these shapes? (tile-aligned, self-attention)"""
-    if not HAVE_PALLAS or os.environ.get("TPUSHARE_NO_PALLAS"):
-        return False
     if q.shape != k.shape or k.shape != v.shape:
         return False
-    return _tile(q.shape[1]) != 0
+    return kernel_eligible(q.shape[1])
 
 
 def _forward(q, k, v, interpret: bool):
@@ -180,8 +224,102 @@ def _forward(q, k, v, interpret: bool):
         # is a Python branch).
         return _xla_reference(q, k, v)
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    out = _flash_call(to_bh(q), to_bh(k), to_bh(v), interpret=interpret)
+    out, _lse = _flash_call(to_bh(q), to_bh(k), to_bh(v),
+                            interpret=interpret)
     return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+
+
+def _xla_block_with_lse(q, k, v, q_offset, kv_offset):
+    """Offset-aware XLA twin of the kernel: same (out, lse) semantics.
+    Serves as the custom-VJP recompute target and the numerics oracle."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    q_pos = q_offset + jnp.arange(q.shape[1])
+    kv_pos = kv_offset + jnp.arange(k.shape[1])
+    mask = q_pos[:, None] >= kv_pos[None, :]
+    s = jnp.where(mask[None, None], s, NEG_INF)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = p.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / jnp.maximum(l, 1e-30),
+                     v.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]       # [B, H, Lq]
+    lse = jnp.where(l[..., 0] > 0, lse, NEG_INF)
+    return out.astype(q.dtype), lse.transpose(0, 2, 1)       # [B, Lq, H]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def flash_block_with_lse(q, k, v, q_offset=0, kv_offset=0,
+                         interpret: bool = False):
+    """One ring-attention step: local Q against one rotating KV block.
+
+    [B, L, H, D] in; returns (out [B, L, H, D], lse [B, L, H] f32) where
+    ``lse`` is the log-sum-exp of this block's masked scores — exactly
+    what :func:`merge_partials` needs to combine steps without ever
+    materializing cross-block score matrices. Offsets are traced scalars
+    (they come from ``jax.lax.axis_index`` inside shard_map).
+
+    Differentiable: the backward pass recomputes this block through the
+    XLA twin at the same primal point, so the whole ring composition
+    (scan + ppermute + merges) has exact gradients.
+    """
+    return _block_forward(q, k, v, q_offset, kv_offset, interpret)
+
+
+def _block_forward(q, k, v, q_offset, kv_offset, interpret):
+    b, lq, h, d = q.shape
+    use_kernel = (kernel_eligible(lq) and _tile(k.shape[1]) != 0
+                  and (interpret or jax.default_backend() == "tpu"))
+    if not use_kernel:
+        return _xla_block_with_lse(q, k, v, q_offset, kv_offset)
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    out, lse = _flash_call(to_bh(q), to_bh(k), to_bh(v),
+                           q_offset=q_offset, kv_offset=kv_offset,
+                           interpret=interpret)
+    out = out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    lse = lse[:, 0, :].reshape(b, h, lq).transpose(0, 2, 1)
+    return out, lse
+
+
+def _block_fwd(q, k, v, q_offset, kv_offset, interpret):
+    return (_block_forward(q, k, v, q_offset, kv_offset, interpret),
+            (q, k, v, q_offset, kv_offset))
+
+
+def _block_bwd(interpret, res, cots):
+    import numpy as np
+
+    q, k, v, q_offset, kv_offset = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_block_with_lse(q_, k_, v_, q_offset,
+                                               kv_offset), q, k, v)
+    dq, dk, dv = vjp(cots)
+    float0 = lambda x: np.zeros(np.shape(x), jax.dtypes.float0)
+    return dq, dk, dv, float0(q_offset), float0(kv_offset)
+
+
+flash_block_with_lse.defvjp(_block_fwd, _block_bwd)
+
+
+def merge_partials(o1, lse1, o2, lse2):
+    """Exactly combine two normalized partial attentions over disjoint KV
+    sets, given their log-sum-exps (the standard flash/ring merge).
+
+    Returns the merged output in **fp32** — ring callers carry fp32
+    through the scan and cast to the activation dtype once at the end,
+    so bf16 rounding is paid once, not once per ring step."""
+    # NEG_INF is finite, so the all-masked case degrades gracefully:
+    # both weights become exp(0)=1 over zero partials -> zero output.
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    out = (o1.astype(jnp.float32) * (w1 / denom)[..., None]
+           + o2.astype(jnp.float32) * (w2 / denom)[..., None])
+    lse = m + jnp.log(denom)
+    return out, lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -215,8 +353,6 @@ def best_attn_fn(seq_len: int):
     the Pallas kernel on TPU (tile-aligned shapes, with a trace-time
     fallback for odd shapes), XLA einsum otherwise. CPU gets the XLA
     path — interpreter mode is for tests, not speed."""
-    platform = jax.default_backend()
-    if platform == "tpu" and _tile(seq_len) != 0 \
-            and not os.environ.get("TPUSHARE_NO_PALLAS"):
+    if jax.default_backend() == "tpu" and kernel_eligible(seq_len):
         return _auto_attn
     return _xla_reference

@@ -18,6 +18,7 @@ collectives.
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 
 import jax
@@ -174,17 +175,110 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.transpose(0, 2, 1, 3).astype(v.dtype)   # [B, Lq, H, D]
 
 
-def make_ring_attn_fn(mesh: Mesh):
-    """Wrap ring_attention in shard_map so it can slot in as the model's
-    ``attn_fn`` (heads sharded over tp, sequence over sp, batch over dp)."""
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis_name: str = "sp",
+                         vary_axes: tuple[str, ...] | None = None,
+                         interpret: bool = False) -> jax.Array:
+    """Ring attention with the Pallas flash kernel as the per-step op.
+
+    Same ring as :func:`ring_attention` (KV blocks rotate over ICI with
+    ``ppermute``), but each step computes its block attention inside the
+    flash kernel (VMEM-bounded, MXU fp32 accumulation) and steps combine
+    through the exact log-sum-exp merge — the full composition: sequence
+    parallelism across chips, flash tiling within a chip. Blocks entirely
+    above the causal diagonal skip their tiles inside the kernel.
+    """
+    from tpushare.workload import flash_attention as FA
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    lq = q.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Step 0: the shard's own (causal) block. The fp32 carry is cast to
+    # the activation dtype ONCE after the scan (per-step casting would
+    # re-quantize bf16 n-1 times).
+    out, lse = FA.flash_block_with_lse(q, k, v, idx * lq, idx * lq,
+                                       interpret=interpret)
+    out = out.astype(jnp.float32)
+    if vary_axes:
+        try:
+            out, lse = (jax.lax.pcast(x, vary_axes, to="varying")
+                        for x in (out, lse))
+        except (AttributeError, TypeError):  # pragma: no cover - older jax
+            out, lse = (jax.lax.pvary(x, vary_axes) for x in (out, lse))
+
+    def step(carry, _):
+        k_blk, v_blk, out, lse, src = carry
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        src_next = (src - 1) % n  # after rotation we hold our left
+        o_s, lse_s = FA.flash_block_with_lse(
+            q, k_next, v_next, idx * lq, src_next * lq, interpret=interpret)
+        out, lse = FA.merge_partials(out, lse, o_s, lse_s)
+        return (k_next, v_next, out, lse, src_next), None
+
+    (_, _, out, _, _), _ = jax.lax.scan(
+        step, (k, v, out, lse, idx), None, length=n - 1)
+    return out.astype(v.dtype)
+
+
+def make_ring_attn_fn(mesh: Mesh, use_flash: bool | None = None,
+                      interpret: bool = False):
+    """Wrap ring attention in shard_map so it can slot in as the model's
+    ``attn_fn`` (heads sharded over tp, sequence over sp, batch over dp).
+
+    ``use_flash`` selects the per-step implementation: the Pallas flash
+    kernel (default on TPU when the local block is tile-aligned) or the
+    XLA einsum path. ``interpret`` runs the kernel in interpreter mode
+    (tests on the CPU mesh).
+    """
     qkv_spec = P("dp", "sp", "tp", None)
 
-    @partial(shard_map, mesh=mesh,
-             in_specs=(qkv_spec, qkv_spec, qkv_spec),
-             out_specs=qkv_spec)
-    def attn(q, k, v):
+    def attn_impl(q, k, v, flash: bool):
+        if flash:
+            # check_vma is off on this path (see below): no pcast needed.
+            return ring_flash_attention(q, k, v, axis_name="sp",
+                                        vary_axes=None,
+                                        interpret=interpret)
         return ring_attention(q, k, v, axis_name="sp",
                               vary_axes=mesh.axis_names)
+
+    def decide_flash(seq_shard: int) -> bool:
+        from tpushare.workload import flash_attention as FA
+
+        if use_flash:
+            if FA._tile(seq_shard) == 0:
+                raise ValueError(
+                    f"ring-flash requires the per-shard sequence length "
+                    f"to be a multiple of 128; got {seq_shard} "
+                    f"(pad the sequence or pass use_flash=False)")
+            return True
+        if use_flash is not None:
+            return False
+        # Auto: compiled kernel on TPU only (interpreter mode is opt-in
+        # for tests via use_flash=True).
+        return (not interpret and jax.default_backend() == "tpu"
+                and FA.kernel_eligible(seq_shard))
+
+    def attn(q, k, v):
+        sp = mesh.shape["sp"]
+        flash = decide_flash(q.shape[1] // sp)
+        # The pallas-in-shard_map composition trips shard_map's vma type
+        # checker (SMEM scalar offsets vary over sp while interpreter
+        # internals don't); the collectives are unaffected, so disable
+        # the check on the flash path only.
+        kwargs = {"check_vma": False} if flash else {}
+        try:
+            wrapped = shard_map(partial(attn_impl, flash=flash), mesh=mesh,
+                                in_specs=(qkv_spec, qkv_spec, qkv_spec),
+                                out_specs=qkv_spec, **kwargs)
+        except TypeError:  # pragma: no cover - older jax: check_rep
+            kwargs = {"check_rep": False} if flash else {}
+            wrapped = shard_map(partial(attn_impl, flash=flash), mesh=mesh,
+                                in_specs=(qkv_spec, qkv_spec, qkv_spec),
+                                out_specs=qkv_spec, **kwargs)
+        return wrapped(q, k, v)
 
     return attn
 
