@@ -1,0 +1,102 @@
+"""Workload checkpoint / resume (orbax-backed).
+
+The scheduler side of the framework is checkpoint-free by design (the
+kube-apiserver is its store — reference cache.go:49-74); the workload
+side needs real checkpoints: an HBM-sharing inference pod or a
+gang-scheduled training job must survive preemption and resume on a
+possibly different chip/slice. Orbax handles the sharded-array plumbing:
+saving from a dp×tp×sp mesh and restoring onto a DIFFERENT mesh shape
+works because restore re-shards to the target shardings.
+
+Layout: ``<dir>/<step>/`` per step, orbax-managed, with retention.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+import jax
+import orbax.checkpoint as ocp
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    directory: str
+    max_to_keep: int = 3
+    save_interval_steps: int = 1
+
+
+class Checkpointer:
+    """Save/restore (params, opt_state, step) with retention.
+
+    Restore targets the CURRENT mesh's shardings (pass the abstract
+    target built from your freshly-initialized state), so a job saved on
+    a v5p-16 gang restores onto a v5p-8 one with nothing but a different
+    mesh in hand — the elasticity the gang scheduler's rollback story
+    assumes.
+    """
+
+    def __init__(self, cfg: CheckpointConfig):
+        self.cfg = cfg
+        self._mgr = ocp.CheckpointManager(
+            cfg.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=cfg.max_to_keep,
+                save_interval_steps=cfg.save_interval_steps,
+                create=True,
+            ),
+        )
+
+    def save(self, step: int, params, opt_state, *, force: bool = False,
+             wait: bool = False) -> bool:
+        """Async by default (training continues while the write drains);
+        ``wait=True`` blocks until durable."""
+        saved = self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+            ),
+            force=force,
+        )
+        if wait:
+            self._mgr.wait_until_finished()
+        if saved:
+            log.info("checkpoint saved at step %d -> %s", step,
+                     self.cfg.directory)
+        return saved
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, params_target, opt_state_target,
+                step: int | None = None):
+        """Restore onto the shardings/structure of the given targets
+        (use a freshly-initialized state as the template). Returns
+        (params, opt_state, step) or None when no checkpoint exists."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            return None
+        abstract = lambda tree: jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, tree)
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(abstract(params_target)),
+                opt_state=ocp.args.StandardRestore(
+                    abstract(opt_state_target)),
+            ),
+        )
+        log.info("restored checkpoint step %d from %s", step,
+                 self.cfg.directory)
+        return restored["params"], restored["opt_state"], step
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -18,7 +18,6 @@ collectives.
 from __future__ import annotations
 
 import math
-import os
 from functools import partial
 
 import jax
@@ -223,6 +222,94 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(v.dtype)
 
 
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = "sp",
+                      interpret: bool = False,
+                      use_flash: bool = False) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+    The dual of the ring: instead of rotating KV blocks, one
+    ``all_to_all`` re-shards activations from sequence-sharded
+    [B, L/sp, H, D] to head-sharded [B, L, H/sp, D]; each device then
+    runs ordinary causal attention over the FULL sequence for its slice
+    of heads (the flash kernel applies directly — no online merge
+    needed), and a second all_to_all restores sequence sharding.
+
+    Two collectives total vs the ring's n-1 ppermutes: cheaper when
+    heads ≥ sp and the full sequence fits one device's HBM; the ring
+    wins when L is too long to materialize locally. Requires
+    ``H % sp == 0``. Must run inside shard_map with ``axis_name`` bound.
+    """
+    from tpushare.workload import flash_attention as FA
+
+    sp = jax.lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % sp != 0:
+        raise ValueError(
+            f"ulysses attention needs heads % sp == 0; got {h} heads "
+            f"over sp={sp} (use ring attention instead)")
+
+    def seq_to_heads(x):  # [B, L/sp, H, D] -> [B, L, H/sp, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):  # [B, L, H/sp, D] -> [B, L/sp, H, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if use_flash:
+        out, _ = FA.flash_block_with_lse(q, k, v, 0, 0, interpret)
+    else:
+        out = M.causal_attention(q, k, v)
+    return heads_to_seq(out)
+
+
+def _compat_shard_map(fn, mesh: Mesh, specs, disable_check: bool):
+    """shard_map with the vma/rep type check optionally disabled, across
+    the jax versions that renamed the kwarg (check_vma <- check_rep).
+    The pallas-in-shard_map composition needs the check off (SMEM scalar
+    offsets vary over sp while interpreter internals don't)."""
+    kwargs = {"check_vma": False} if disable_check else {}
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=specs,
+                         out_specs=specs[0], **kwargs)
+    except TypeError:  # pragma: no cover - older jax: check_rep
+        kwargs = {"check_rep": False} if disable_check else {}
+        return shard_map(fn, mesh=mesh, in_specs=specs,
+                         out_specs=specs[0], **kwargs)
+
+
+def make_ulysses_attn_fn(mesh: Mesh, use_flash: bool | None = None,
+                         interpret: bool = False):
+    """shard_map wrapper for :func:`ulysses_attention` (same qkv specs as
+    the ring: batch over dp, sequence over sp, heads over tp)."""
+    from tpushare.workload import flash_attention as FA
+
+    qkv_spec = P("dp", "sp", "tp", None)
+
+    def attn(q, k, v):
+        flash = use_flash
+        if flash:
+            # Same contract as the ring factory: forcing the kernel with
+            # shapes it cannot tile is an error, not a silent fallback.
+            if FA._tile(q.shape[1]) == 0:  # full L is local after a2a
+                raise ValueError(
+                    f"ulysses-flash requires the sequence length to be a "
+                    f"multiple of 128; got {q.shape[1]} "
+                    f"(pad the sequence or pass use_flash=False)")
+        elif flash is None:
+            flash = (not interpret and jax.default_backend() == "tpu"
+                     and FA.kernel_eligible(q.shape[1]))
+        wrapped = _compat_shard_map(
+            partial(ulysses_attention, axis_name="sp",
+                    interpret=interpret, use_flash=flash),
+            mesh, (qkv_spec, qkv_spec, qkv_spec), disable_check=flash)
+        return wrapped(q, k, v)
+
+    return attn
+
+
 def make_ring_attn_fn(mesh: Mesh, use_flash: bool | None = None,
                       interpret: bool = False):
     """Wrap ring attention in shard_map so it can slot in as the model's
@@ -262,22 +349,10 @@ def make_ring_attn_fn(mesh: Mesh, use_flash: bool | None = None,
                 and FA.kernel_eligible(seq_shard))
 
     def attn(q, k, v):
-        sp = mesh.shape["sp"]
-        flash = decide_flash(q.shape[1] // sp)
-        # The pallas-in-shard_map composition trips shard_map's vma type
-        # checker (SMEM scalar offsets vary over sp while interpreter
-        # internals don't); the collectives are unaffected, so disable
-        # the check on the flash path only.
-        kwargs = {"check_vma": False} if flash else {}
-        try:
-            wrapped = shard_map(partial(attn_impl, flash=flash), mesh=mesh,
-                                in_specs=(qkv_spec, qkv_spec, qkv_spec),
-                                out_specs=qkv_spec, **kwargs)
-        except TypeError:  # pragma: no cover - older jax: check_rep
-            kwargs = {"check_rep": False} if flash else {}
-            wrapped = shard_map(partial(attn_impl, flash=flash), mesh=mesh,
-                                in_specs=(qkv_spec, qkv_spec, qkv_spec),
-                                out_specs=qkv_spec, **kwargs)
+        flash = decide_flash(q.shape[1] // mesh.shape["sp"])
+        wrapped = _compat_shard_map(
+            partial(attn_impl, flash=flash), mesh,
+            (qkv_spec, qkv_spec, qkv_spec), disable_check=flash)
         return wrapped(q, k, v)
 
     return attn

@@ -12,7 +12,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import NamedSharding
+from jax.sharding import NamedSharding, PartitionSpec
 
 from tpushare.workload import model as M
 from tpushare.workload import parallel as par
@@ -32,22 +32,54 @@ def make_optimizer(lr: float = 3e-4):
 
 
 def make_train_step(cfg: M.ModelConfig, mesh=None, optimizer=None,
-                    use_ring_attention: bool = True):
+                    use_ring_attention: bool = True,
+                    attention: str | None = None):
     """Build (init_fn, step_fn).
 
     With a mesh: params/opt-state land in their tp shardings, batches in
-    (dp, sp), and attention runs as the sp ring. Without: plain
+    (dp, sp), and attention runs sequence-parallel. Without: plain
     single-device jit (the form the scheduler's HBM-sharing pods run).
+
+    ``attention`` picks the sequence-parallel strategy: ``"ring"``
+    (default — KV rotates over ICI, HBM-bounded, arbitrarily long L) or
+    ``"ulysses"`` (all-to-all head re-sharding — fewer collectives when
+    heads ≥ sp and L fits locally). ``use_ring_attention=False`` disables
+    sequence parallelism entirely (legacy knob).
     """
     optimizer = optimizer or make_optimizer()
-    attn_fn = par.make_ring_attn_fn(mesh) if (mesh is not None and
-                                              use_ring_attention) else None
+    if attention is not None and attention not in ("ring", "ulysses"):
+        raise ValueError(f"unknown attention strategy {attention!r}; "
+                         "expected 'ring' or 'ulysses'")
+    if attention is not None and not use_ring_attention:
+        raise ValueError(
+            "attention= requests sequence parallelism but "
+            "use_ring_attention=False disables it; drop one of the two")
+    attn_fn = None
+    if mesh is not None and use_ring_attention:
+        if (attention or "ring") == "ring":
+            attn_fn = par.make_ring_attn_fn(mesh)
+        else:
+            attn_fn = par.make_ulysses_attn_fn(mesh)
 
     def init_fn(key, example_tokens):
         params = M.init_params(key, cfg)
         if mesh is not None:
             params = jax.device_put(params, par.param_shardings(mesh, params))
         opt_state = optimizer.init(params)
+        if mesh is not None:
+            # Moment leaves inherit the param shardings via zeros_like;
+            # optimizer scalars (e.g. adam's count) don't — replicate
+            # them onto the mesh so the whole state lives on one device
+            # set (checkpoint restore and donation both require this).
+            replicated = NamedSharding(mesh, PartitionSpec())
+
+            def place(leaf):
+                if isinstance(leaf, jax.Array) and not isinstance(
+                        leaf.sharding, NamedSharding):
+                    return jax.device_put(leaf, replicated)
+                return leaf
+
+            opt_state = jax.tree_util.tree_map(place, opt_state)
         return params, opt_state
 
     def step(params, opt_state, tokens, targets, positions=None):
