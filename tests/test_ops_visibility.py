@@ -1,0 +1,105 @@
+"""Gang visibility in inspect/CLI + HTTPS serving."""
+
+import json
+import ssl
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+sys.path.insert(0, "tools")
+
+from tests.test_e2e import Cluster  # noqa: E402
+from tpushare.k8s.builders import make_node, make_pod  # noqa: E402
+from tpushare.utils import const  # noqa: E402
+
+
+class TestGangVisibility:
+    def test_pending_gang_in_inspect_and_cli(self, api):
+        import kubectl_inspect_tpushare as cli
+
+        for i in range(2):
+            api.create_node(make_node(f"v5p-{i}", chips=4, hbm_per_chip=95,
+                                      topology="2x2x1", tpu_type="v5p"))
+        cluster = Cluster(api)
+        try:
+            ann = {const.ANN_POD_GROUP: "train",
+                   const.ANN_POD_GROUP_MIN: "2"}
+            doc = make_pod("w0", chips=4, annotations=ann)
+            api.create_pod(doc)
+            bound, _ = cluster.schedule(doc)
+            assert not bound  # reserved, waiting on quorum
+
+            view = cluster.inspect()
+            assert "gangs" in view
+            gang = view["gangs"][0]
+            assert gang["name"] == "train"
+            assert (gang["reserved"], gang["minimum"]) == (1, 2)
+            assert not gang["committed"]
+            assert gang["ttlRemaining"] > 0
+            assert gang["members"][0]["pod"] == "w0"
+
+            out = cli.render(view, details=True)
+            assert "PENDING/ACTIVE GANGS:" in out
+            assert "default/train: waiting 1/2" in out
+            assert "w0 -> v5p-" in out
+        finally:
+            cluster.close()
+
+    def test_committed_gang_disappears_after_full_bind(self, api):
+        for i in range(2):
+            api.create_node(make_node(f"v5p-{i}", chips=4, hbm_per_chip=95,
+                                      topology="2x2x1", tpu_type="v5p"))
+        cluster = Cluster(api)
+        try:
+            ann = {const.ANN_POD_GROUP: "t2", const.ANN_POD_GROUP_MIN: "2"}
+            for name in ("w0", "w1"):
+                doc = make_pod(name, chips=4, annotations=ann)
+                api.create_pod(doc)
+                cluster.schedule(doc)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                view = cluster.inspect()
+                gangs = view.get("gangs", [])
+                if all(api.get_pod("default", n).node_name
+                       for n in ("w0", "w1")):
+                    break
+                time.sleep(0.05)
+            # committed group shows as committed (or is already retired)
+            for g in view.get("gangs", []):
+                assert g["committed"] or g["reserved"] < g["minimum"]
+        finally:
+            cluster.close()
+
+
+class TestHTTPS:
+    def test_extender_serves_tls(self, api, tmp_path):
+        from tpushare.cmd.main import build_stack
+        from tpushare.routes.server import (
+            ExtenderHTTPServer, enable_tls, serve_forever)
+
+        cert = tmp_path / "tls.crt"
+        key = tmp_path / "tls.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", "/CN=127.0.0.1"],
+            check=True, capture_output=True)
+
+        api.create_node(make_node("v5e-0"))
+        controller, pred, binder, inspect = build_stack(api)
+        controller.start(workers=2)
+        server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect)
+        enable_tls(server, str(cert), str(key))
+        serve_forever(server)
+        try:
+            ctx = ssl.create_default_context(cafile=str(cert))
+            ctx.check_hostname = False
+            url = f"https://127.0.0.1:{server.server_address[1]}/version"
+            with urllib.request.urlopen(url, context=ctx) as resp:
+                assert json.loads(resp.read())["version"]
+        finally:
+            server.shutdown()
+            controller.stop()

@@ -70,6 +70,20 @@ def render(doc: dict, details: bool = False) -> str:
     lines.append("Allocated/Total TPU HBM (GiB) in Cluster:")
     lines.append(f"{used}/{total} ({pct:.0f}%)")
 
+    gangs = doc.get("gangs", [])
+    if gangs:
+        lines.append("")
+        lines.append("PENDING/ACTIVE GANGS:")
+        for g in gangs:
+            state = ("committed" if g.get("committed")
+                     else f"waiting {g['reserved']}/{g['minimum']}"
+                          + (f", expires in {g['ttlRemaining']}s"
+                             if g.get("ttlRemaining") is not None else ""))
+            lines.append(f"  {g['namespace']}/{g['name']}: {state}")
+            if details:
+                for m in g.get("members", []):
+                    lines.append(f"    {m['pod']} -> {m['node']}")
+
     if details:
         for n in nodes:
             lines.append("")

@@ -53,7 +53,8 @@ def build_stack(client):
     predicate = Predicate(controller.cache)
     binder = Bind(controller.cache, client, gang_planner=gang,
                   pod_lister=controller.hub.get_pod)
-    inspect = Inspect(controller.cache, client.list_nodes)
+    inspect = Inspect(controller.cache, client.list_nodes,
+                      gang_planner=gang)
     return controller, predicate, binder, inspect
 
 
@@ -74,6 +75,11 @@ def main() -> None:
 
     controller.start(workers=workers)
     server = ExtenderHTTPServer(("0.0.0.0", port), predicate, binder, inspect)
+    cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
+    if cert and key:
+        from tpushare.routes.server import enable_tls
+        enable_tls(server, cert, key)
+        log.info("TLS enabled (%s)", cert)
     serve_forever(server)
     log.info("tpushare scheduler extender listening on :%d", port)
 

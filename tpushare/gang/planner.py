@@ -91,6 +91,32 @@ class GangPlanner:
     def stop(self) -> None:
         self._stop.set()
 
+    def snapshot(self) -> list[dict]:
+        """Operator view of in-flight groups (feeds the inspect API):
+        name/namespace, quorum progress, commit state, seconds until the
+        reservation expires, and the members' planned nodes."""
+        with self._table_lock:
+            groups = list(self._groups.items())
+        now = time.monotonic()
+        out = []
+        for (namespace, _name), group in groups:
+            with group.lock:
+                out.append({
+                    "name": group.name,
+                    "namespace": namespace,
+                    "reserved": len(group.reservations),
+                    "minimum": group.minimum,
+                    "committed": group.committed,
+                    "bound": len(group.bound),
+                    "ttlRemaining": (None if group.committed else
+                                     max(round(group.deadline - now, 1), 0)),
+                    "members": [
+                        {"pod": pod.name, "node": node}
+                        for pod, node in group.reservations.values()
+                    ],
+                })
+        return sorted(out, key=lambda g: (g["namespace"], g["name"]))
+
     def _housekeeping_loop(self) -> None:
         while not self._stop.wait(self._interval):
             try:

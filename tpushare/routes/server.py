@@ -174,6 +174,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json({"Error": str(e)}, 500)
 
 
+def enable_tls(server: ExtenderHTTPServer, cert_file: str,
+               key_file: str) -> None:
+    """Serve HTTPS (the extender policy's ``enableHttps: true`` side).
+    Call before ``serve_forever``."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_file, key_file)
+    server.socket = ctx.wrap_socket(server.socket, server_side=True)
+
+
 def serve_forever(server: ExtenderHTTPServer) -> threading.Thread:
     """Run the server on a daemon thread; returns the thread."""
     t = threading.Thread(target=server.serve_forever, name="tpushare-http",

@@ -20,9 +20,11 @@ from tpushare.utils import pod as podutils
 class Inspect:
     name = "tpushare-inspect"
 
-    def __init__(self, cache: SchedulerCache, node_lister=None):
+    def __init__(self, cache: SchedulerCache, node_lister=None,
+                 gang_planner=None):
         self.cache = cache
         self._node_lister = node_lister  # () -> list[Node], for all-nodes view
+        self._gang_planner = gang_planner  # in-flight group visibility
 
     def _build_node(self, info) -> dict:
         """Per-node document (reference inspect.go:33-71)."""
@@ -73,5 +75,10 @@ class Inspect:
                     built = self.cache.get_node_info(node.name)
                     if built is not None:
                         infos[built.name] = built
-        return {"nodes": [self._build_node(i)
-                          for _, i in sorted(infos.items())]}
+        doc = {"nodes": [self._build_node(i)
+                         for _, i in sorted(infos.items())]}
+        if self._gang_planner is not None:
+            gangs = self._gang_planner.snapshot()
+            if gangs:
+                doc["gangs"] = gangs
+        return doc
