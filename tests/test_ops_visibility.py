@@ -102,4 +102,5 @@ class TestHTTPS:
                 assert json.loads(resp.read())["version"]
         finally:
             server.shutdown()
+            binder.gang_planner.stop()
             controller.stop()

@@ -19,12 +19,14 @@ from __future__ import annotations
 import logging
 import os
 import signal
+import sys
 import threading
 
 from tpushare.controller.controller import Controller
 from tpushare.gang.planner import GangPlanner
 from tpushare.k8s.client import ApiClient, ClusterConfig
-from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+from tpushare.routes.server import (ExtenderHTTPServer, enable_tls,
+                                    serve_forever)
 from tpushare.scheduler.bind import Bind
 from tpushare.scheduler.inspect import Inspect
 from tpushare.scheduler.predicate import Predicate
@@ -76,8 +78,12 @@ def main() -> None:
     controller.start(workers=workers)
     server = ExtenderHTTPServer(("0.0.0.0", port), predicate, binder, inspect)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
+    if bool(cert) != bool(key):
+        log.error("TLS misconfigured: exactly one of TLS_CERT_FILE / "
+                  "TLS_KEY_FILE is set; refusing to serve plain HTTP "
+                  "behind an enableHTTPS registration")
+        sys.exit(2)
     if cert and key:
-        from tpushare.routes.server import enable_tls
         enable_tls(server, cert, key)
         log.info("TLS enabled (%s)", cert)
     serve_forever(server)

@@ -182,7 +182,12 @@ def enable_tls(server: ExtenderHTTPServer, cert_file: str,
 
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.load_cert_chain(cert_file, key_file)
-    server.socket = ctx.wrap_socket(server.socket, server_side=True)
+    # Defer the handshake to the per-request handler thread: with the
+    # default handshake-in-accept(), one client that connects and never
+    # speaks TLS would block the single accept loop — and with it every
+    # /filter and /bind call.
+    server.socket = ctx.wrap_socket(server.socket, server_side=True,
+                                    do_handshake_on_connect=False)
 
 
 def serve_forever(server: ExtenderHTTPServer) -> threading.Thread:
