@@ -289,6 +289,195 @@ def test_chip_allocation_without_extender_pod():
     assert alloc.annotations == {}
 
 
+class TestMultiContainer:
+    """kubelet calls Allocate once per CONTAINER: a pod whose request is
+    split across containers must match container-by-container and only
+    commit when fully served."""
+
+    def _pod(self, api, sizes, chip=0, name="mc"):
+        doc = make_pod(name, container_hbm=sizes, node_name="host-a",
+                       annotations={
+                           const.ANN_CHIP_IDX: str(chip),
+                           const.ANN_HBM_POD: str(sum(sizes)),
+                           const.ANN_HBM_CHIP: "16",
+                           const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+                           const.ANN_ASSUME_TIME: "1",
+                       })
+        return api.create_pod(doc)
+
+    def test_two_containers_commit_on_last(self):
+        api = FakeApiServer()
+        plugin = _plugin(api)
+        self._pod(api, [4, 4])
+        a1 = plugin.allocate_hbm(["x"] * 4)
+        # first container served: per-container env, not yet committed
+        assert a1.envs[const.ENV_HBM_POD] == "4"
+        assert a1.envs[const.ENV_XLA_MEM_FRACTION] == "0.225"  # 4/16*0.9
+        assert api.get_pod("default", "mc").annotations[
+            const.ANN_ASSIGNED] == const.ASSIGNED_FALSE
+        a2 = plugin.allocate_hbm(["x"] * 4)
+        assert a2.envs[const.ENV_CHIP_IDX] == a1.envs[const.ENV_CHIP_IDX]
+        assert api.get_pod("default", "mc").annotations[
+            const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+    def test_pod_total_does_not_match_containers(self):
+        api = FakeApiServer()
+        plugin = _plugin(api)
+        self._pod(api, [4, 4])
+        with pytest.raises(AllocateError):
+            plugin.allocate_hbm(["x"] * 8)  # no single container asks for 8
+
+    def test_partial_state_pruned_when_pod_deleted(self):
+        api = FakeApiServer()
+        plugin = _plugin(api)
+        pod = self._pod(api, [4, 4])
+        plugin.allocate_hbm(["x"] * 4)
+        assert plugin._partial.get(pod.uid) == [4]
+        api.delete_pod("default", "mc")
+        with pytest.raises(AllocateError):
+            plugin.allocate_hbm(["x"] * 4)
+        assert pod.uid not in plugin._partial
+
+    def test_unequal_containers_matched_by_size(self):
+        api = FakeApiServer()
+        plugin = _plugin(api)
+        self._pod(api, [2, 6])
+        a = plugin.allocate_hbm(["x"] * 6)
+        assert a.envs[const.ENV_HBM_POD] == "6"
+        a = plugin.allocate_hbm(["x"] * 2)
+        assert a.envs[const.ENV_HBM_POD] == "2"
+        assert api.get_pod("default", "mc").annotations[
+            const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+
+def test_health_flips_unhealthy_when_device_vanishes(tmp_path):
+    """ListAndWatch's poll must withdraw capacity when a chip's device
+    node disappears (driver crash / hot-unplug)."""
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(2):
+        (dev / f"accel{i}").write_text("")
+    inv = disc.devfs_scan(str(dev), chip_type_hint="v5e")
+    api = FakeApiServer()
+    api.create_node(make_node("host-a", chips=2, hbm_per_chip=16))
+    plugin = TPUSharePlugin("host-a", api, inv)
+    assert all(d.health == HEALTHY for d in plugin.chip_devices())
+    (dev / "accel1").unlink()
+    healths = {d.id: d.health for d in plugin.chip_devices()}
+    assert healths["tpushare-chip-00"] == HEALTHY
+    assert healths["tpushare-chip-01"] == UNHEALTHY
+    # HBM GiB devices of the dead chip go unhealthy too
+    hbm = plugin.hbm_devices()
+    assert sum(1 for d in hbm if d.health == UNHEALTHY) == 16
+
+
+def test_multi_container_chip_pod_spans_planned_chips():
+    """A 2-container x 2-chip pod: each container takes its consecutive
+    span of the extender's planned chips; commit on the last."""
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    doc = make_pod("mcchip", node_name="host-a",
+                   annotations={
+                       const.ANN_CHIP_IDX: "0,1,2,3",
+                       const.ANN_HBM_POD: "64",
+                       const.ANN_HBM_CHIP: "16",
+                       const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+                       const.ANN_ASSUME_TIME: "1",
+                   })
+    doc["spec"]["containers"] = [
+        {"name": f"c{i}",
+         "resources": {"limits": {const.CHIP_RESOURCE: "2"}}}
+        for i in range(2)]
+    api.create_pod(doc)
+    a1 = plugin.allocate_chips(["tpushare-chip-00", "tpushare-chip-01"])
+    assert a1.envs[const.ENV_TPU_VISIBLE_CHIPS] == "0,1"
+    assert api.get_pod("default", "mcchip").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_FALSE
+    a2 = plugin.allocate_chips(["tpushare-chip-02", "tpushare-chip-03"])
+    assert a2.envs[const.ENV_TPU_VISIBLE_CHIPS] == "2,3"
+    assert api.get_pod("default", "mcchip").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+
+def test_concurrent_allocates_serialize():
+    """Two parallel Allocate calls for a [4,4] pod must both land (the
+    allocation lock prevents double-matching the same container)."""
+    import threading as th
+
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    api.create_pod(make_pod("mc", container_hbm=[4, 4], node_name="host-a",
+                            annotations={
+                                const.ANN_CHIP_IDX: "0",
+                                const.ANN_HBM_POD: "8",
+                                const.ANN_HBM_CHIP: "16",
+                                const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+                                const.ANN_ASSUME_TIME: "1",
+                            }))
+    results, errors = [], []
+
+    def alloc():
+        try:
+            results.append(plugin.allocate_hbm(["x"] * 4))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [th.Thread(target=alloc) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors and len(results) == 2
+    assert api.get_pod("default", "mc").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+    assert plugin._partial == {}
+
+
+def test_distributed_spec_rejects_out_of_range_rank():
+    from tpushare.runtime import jaxenv
+
+    env = {const.ENV_POD_GROUP: "g", const.ENV_POD_GROUP_SIZE: "4",
+           "JOB_COMPLETION_INDEX": "5"}
+    with pytest.raises(ValueError, match="out of range"):
+        jaxenv.distributed_spec(env)
+
+
+def test_gang_pod_gets_distributed_env():
+    """Gang members receive group identity; jaxenv derives the full
+    jax.distributed bootstrap from it + the indexed-Job convention."""
+    from tpushare.runtime import jaxenv
+
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    pod = make_pod("w-2", chips=4, node_name="host-a",
+                   annotations={
+                       const.ANN_CHIP_IDX: "0,1,2,3",
+                       const.ANN_HBM_POD: "64",
+                       const.ANN_HBM_CHIP: "16",
+                       const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+                       const.ANN_ASSUME_TIME: "1",
+                       const.ANN_POD_GROUP: "train",
+                       const.ANN_POD_GROUP_MIN: "4",
+                   })
+    api.create_pod(pod)
+    alloc = plugin.allocate_chips(
+        [f"tpushare-chip-{i:02d}" for i in range(4)])
+    assert alloc.envs[const.ENV_POD_GROUP] == "train"
+    assert alloc.envs[const.ENV_POD_GROUP_SIZE] == "4"
+
+    env = dict(alloc.envs)
+    env["JOB_COMPLETION_INDEX"] = "2"
+    spec = jaxenv.distributed_spec(env)
+    assert spec is not None
+    assert spec.num_processes == 4 and spec.process_id == 2
+    assert spec.coordinator == "train-0.train:8476"
+    # explicit coordinator wins
+    env[const.ENV_COORDINATOR] = "coord:9999"
+    assert jaxenv.distributed_spec(env).coordinator == "coord:9999"
+    # non-gang pods: no spec
+    assert jaxenv.distributed_spec({"JOB_COMPLETION_INDEX": "0"}) is None
+
+
 def test_allocation_grant_round_trips_through_jaxenv():
     """The env the plugin injects is exactly what the workload runtime
     parses (counterpart of samples/docker/run.sh consuming the injected

@@ -28,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import threading
 import time
 
 from tpushare.api.objects import Pod
@@ -76,6 +77,16 @@ class TPUSharePlugin:
         self.client = client
         self.inventory = inventory
         self.headroom = headroom
+        #: uid -> container grant sizes served so far (HBM GiB or chip
+        #: counts, per resource). kubelet calls Allocate once per
+        #: CONTAINER, so a multi-container pod is matched container by
+        #: container and committed only when its full request is served.
+        self._partial: dict[str, list[int]] = {}
+        self._partial_chips: dict[str, list[int]] = {}
+        #: Serializes match->record->commit: concurrent Allocate RPCs
+        #: (the gRPC servicer runs on a thread pool) must not both match
+        #: the same pending container.
+        self._alloc_lock = threading.Lock()
 
     # ------------------------------------------------------------------ #
     # Advertisement (reference: ListAndWatch reporting gpu-mem totals)
@@ -100,8 +111,8 @@ class TPUSharePlugin:
 
     @staticmethod
     def _chip_health(device_path: str) -> str:
-        if not device_path or not device_path.startswith("/dev"):
-            return HEALTHY  # fake/synthetic inventory
+        if not device_path or device_path.startswith("/fake"):
+            return HEALTHY  # synthetic inventory (tests)
         return HEALTHY if os.path.exists(device_path) else UNHEALTHY
 
     def annotate_node(self) -> None:
@@ -126,37 +137,61 @@ class TPUSharePlugin:
     # ------------------------------------------------------------------ #
 
     def allocate_hbm(self, device_ids: list[str]) -> ContainerAllocation:
-        """kubelet granted ``len(device_ids)`` GiB; find whose they are."""
+        """kubelet granted ``len(device_ids)`` GiB to ONE container; find
+        whose they are (two-level match: container limit, then pod)."""
         requested_gib = len(device_ids)
-        pod = self._match_pending_pod(requested_gib)
-        if pod is None:
-            raise AllocateError(
-                f"no assumed pod on {self.node_name} requests "
-                f"{requested_gib} GiB HBM")
-        chip_ids = podutils.get_chip_ids_from_annotation(pod)
-        self._commit_assigned(pod)
-        return self._build_allocation(pod, chip_ids)
+        with self._alloc_lock:
+            pod = self._match_pending_pod(requested_gib)
+            if pod is None:
+                raise AllocateError(
+                    f"no assumed pod on {self.node_name} has a container "
+                    f"requesting {requested_gib} GiB HBM")
+            chip_ids = podutils.get_chip_ids_from_annotation(pod)
+            served = self._partial.get(pod.uid, []) + [requested_gib]
+            total = podutils.get_hbm_from_pod_resource(pod)
+            if sum(served) >= total:
+                # Last container served: second phase of the commit.
+                self._commit_assigned(pod)
+                self._partial.pop(pod.uid, None)
+            else:
+                self._partial[pod.uid] = served
+            return self._build_allocation(pod, chip_ids,
+                                          granted_gib=requested_gib)
 
     def allocate_chips(self, device_ids: list[str]) -> ContainerAllocation:
         """Whole-chip allocations carry real chip indices in the IDs."""
-        chip_ids = sorted(
+        req_ids = sorted(
             int(d.rsplit("-", 1)[1]) for d in device_ids
             if d.startswith("tpushare-chip-"))
-        if not chip_ids:
+        if not req_ids:
             raise AllocateError(f"unrecognized chip device ids: {device_ids}")
-        pod = self._match_pending_pod(len(chip_ids), chips=True)
-        if pod is not None:
-            # Prefer the extender's placement over kubelet's arbitrary pick.
-            planned = podutils.get_chip_ids_from_annotation(pod)
-            if planned:
-                chip_ids = planned
-            self._commit_assigned(pod)
-            return self._build_allocation(pod, chip_ids, whole_chips=True)
+        with self._alloc_lock:
+            pod = self._match_pending_pod(len(req_ids), chips=True)
+            if pod is not None:
+                # Prefer the extender's placement over kubelet's pick; a
+                # multi-container pod's containers take consecutive spans
+                # of the planned chip list (container k's span starts
+                # after the chips earlier Allocates consumed).
+                planned = podutils.get_chip_ids_from_annotation(pod)
+                chip_ids = req_ids
+                served = self._partial_chips.get(pod.uid, [])
+                if planned:
+                    offset = sum(served)
+                    span = planned[offset:offset + len(req_ids)]
+                    chip_ids = span if len(span) == len(req_ids) else planned
+                served = served + [len(req_ids)]
+                if sum(served) >= podutils.get_chips_from_pod_resource(pod):
+                    self._commit_assigned(pod)
+                    self._partial_chips.pop(pod.uid, None)
+                else:
+                    self._partial_chips[pod.uid] = served
+                return self._build_allocation(pod, chip_ids,
+                                              whole_chips=True)
         # Chip-only pods may bypass the extender (no HBM request): still
         # hand out the devices kubelet picked.
-        envs = self._chip_envs(chip_ids)
+        envs = self._chip_envs(req_ids)
         return ContainerAllocation(
-            envs=envs, devices=self._device_nodes(chip_ids), annotations={})
+            envs=envs, devices=self._device_nodes(req_ids), annotations={})
 
     # -- matching ------------------------------------------------------- #
 
@@ -167,9 +202,11 @@ class TPUSharePlugin:
         Allocate carries no pod identity, so request size + FIFO order is
         the join key)."""
         candidates = []
+        live_uids = set()
         for pod in self.client.list_pods(node_name=self.node_name):
             if pod.node_name != self.node_name:
                 continue
+            live_uids.add(pod.uid)
             if podutils.is_complete_pod(pod):
                 continue
             if not podutils.is_assumed(pod) or podutils.is_assigned(pod):
@@ -179,15 +216,41 @@ class TPUSharePlugin:
             # came through different kubelet resources.
             if chips != podutils.is_tpu_chip_pod(pod):
                 continue
-            want = (podutils.get_chips_from_pod_resource(pod) if chips
-                    else podutils.get_hbm_from_pod_annotation(pod))
-            if want != requested:
+            # kubelet allocates per container: match if some container
+            # limit not yet served equals the request. Single-container
+            # pods reduce to the reference's whole-request match.
+            resource = (const.CHIP_RESOURCE if chips
+                        else const.HBM_RESOURCE)
+            limits = [l for l in pod.iter_resource_limits(resource)
+                      if l > 0]
+            if requested not in self._unserved_limits(pod, limits, chips):
                 continue
             candidates.append((podutils.get_assume_time(pod), pod.key(), pod))
+        self._prune_partials(live_uids)
         if not candidates:
             return None
         candidates.sort(key=lambda t: (t[0], t[1]))
         return candidates[0][2]
+
+    def _unserved_limits(self, pod: Pod, limits: list[int],
+                         chips: bool = False) -> list[int]:
+        """Container limits not yet covered by earlier Allocate calls for
+        this pod (multiset difference: each served grant consumes one
+        matching container limit)."""
+        table = self._partial_chips if chips else self._partial
+        remaining = list(limits)
+        for grant in table.get(pod.uid, []):
+            if grant in remaining:
+                remaining.remove(grant)
+        return remaining
+
+    def _prune_partials(self, live_uids: set[str]) -> None:
+        """Drop partial-allocation state for pods that vanished (deleted
+        between container allocations)."""
+        for table in (self._partial, self._partial_chips):
+            for uid in list(table):
+                if uid not in live_uids:
+                    del table[uid]
 
     # -- commit --------------------------------------------------------- #
 
@@ -225,8 +288,13 @@ class TPUSharePlugin:
         }
 
     def _build_allocation(self, pod: Pod, chip_ids: list[int],
-                          whole_chips: bool = False) -> ContainerAllocation:
-        hbm_pod = podutils.get_hbm_from_pod_annotation(pod)
+                          whole_chips: bool = False,
+                          granted_gib: int | None = None,
+                          ) -> ContainerAllocation:
+        # Env is per CONTAINER: a multi-container pod's containers each
+        # premap only their own slice of the pod's grant.
+        hbm_pod = (granted_gib if granted_gib is not None
+                   else podutils.get_hbm_from_pod_annotation(pod))
         chip = self.inventory.chip(chip_ids[0]) if chip_ids else None
         hbm_chip = chip.hbm_gib if chip else 0
         envs = {
@@ -235,6 +303,12 @@ class TPUSharePlugin:
             const.ENV_HBM_CHIP: str(hbm_chip),
         }
         envs.update(self._chip_envs(chip_ids))
+        group, minimum = podutils.get_pod_group(pod)
+        if group:
+            # Gang members learn their group identity so the workload can
+            # bootstrap jax.distributed (runtime/jaxenv.init_distributed).
+            envs[const.ENV_POD_GROUP] = group
+            envs[const.ENV_POD_GROUP_SIZE] = str(minimum)
         if not whole_chips and 0 < hbm_pod < hbm_chip:
             from tpushare.runtime import jaxenv
             headroom = (self.headroom if self.headroom is not None

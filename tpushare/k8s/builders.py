@@ -14,19 +14,28 @@ from tpushare.utils import const
 def make_pod(name: str, hbm: int = 0, chips: int = 0,
              namespace: str = "default", node_name: str = "",
              annotations: dict | None = None, phase: str = "Pending",
-             uid: str | None = None) -> dict:
-    limits = {}
-    if hbm:
-        limits[const.HBM_RESOURCE] = str(hbm)
-    if chips:
-        limits[const.CHIP_RESOURCE] = str(chips)
+             uid: str | None = None,
+             container_hbm: list[int] | None = None) -> dict:
+    """``container_hbm`` builds a multi-container pod (one container per
+    entry); otherwise a single container carries the whole request."""
+    if container_hbm is not None:
+        containers = [
+            {"name": f"c{i}",
+             "resources": {"limits": {const.HBM_RESOURCE: str(h)}}}
+            for i, h in enumerate(container_hbm)]
+    else:
+        limits = {}
+        if hbm:
+            limits[const.HBM_RESOURCE] = str(hbm)
+        if chips:
+            limits[const.CHIP_RESOURCE] = str(chips)
+        containers = [{"name": "main", "resources": {"limits": limits}}]
     doc: dict = {
         "apiVersion": "v1",
         "kind": "Pod",
         "metadata": {"name": name, "namespace": namespace,
                      "annotations": dict(annotations or {})},
-        "spec": {"containers": [{"name": "main",
-                                 "resources": {"limits": limits}}]},
+        "spec": {"containers": containers},
         "status": {"phase": phase},
     }
     if uid:

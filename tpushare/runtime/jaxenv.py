@@ -61,6 +61,70 @@ def read_grant(environ=None) -> ShareGrant | None:
     return ShareGrant(chip_ids, hbm_pod, hbm_chip)
 
 
+@dataclasses.dataclass(frozen=True)
+class DistributedSpec:
+    """What a gang member needs for ``jax.distributed.initialize``."""
+
+    coordinator: str
+    num_processes: int
+    process_id: int
+
+
+def distributed_spec(environ=None) -> DistributedSpec | None:
+    """Derive the multi-host bootstrap from injected + standard k8s env.
+
+    The device plugin injects the gang's name/size
+    (``TPUSHARE_POD_GROUP``, ``TPUSHARE_POD_GROUP_SIZE``); the worker
+    index comes from ``JOB_COMPLETION_INDEX`` (k8s indexed Job — the
+    idiomatic way to run a gang) or ``TPU_WORKER_ID`` (GKE TPU
+    multi-host); the coordinator address from ``TPUSHARE_COORDINATOR``
+    or the indexed-Job convention ``<group>-0.<group>:8476``.
+    Returns None when not in a gang (single-process job).
+    """
+    env = os.environ if environ is None else environ
+    group = env.get(const.ENV_POD_GROUP, "")
+    try:
+        num = int(env.get(const.ENV_POD_GROUP_SIZE, "0"))
+    except ValueError:
+        return None
+    if not group or num <= 1:
+        return None
+    raw_id = env.get("JOB_COMPLETION_INDEX", env.get("TPU_WORKER_ID"))
+    if raw_id is None:
+        return None
+    try:
+        pid = int(raw_id)
+    except ValueError:
+        return None
+    if not 0 <= pid < num:
+        # A worker outside the declared group size must fail loudly:
+        # silently running non-distributed (or handing jax an
+        # out-of-range rank) hangs the whole gang at the init barrier.
+        raise ValueError(
+            f"worker index {pid} out of range for pod group {group!r} of "
+            f"size {num}; the gang's pod-group-min must equal the Job's "
+            f"completion count")
+    coordinator = env.get(const.ENV_COORDINATOR,
+                          f"{group}-0.{group}:8476")
+    return DistributedSpec(coordinator, num, pid)
+
+
+def init_distributed(environ=None) -> DistributedSpec | None:
+    """Call ``jax.distributed.initialize`` for gang members; no-op (None)
+    for single-process jobs. Call after :func:`configure`, before any
+    jax computation."""
+    spec = distributed_spec(environ)
+    if spec is None:
+        return None
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=spec.coordinator,
+        num_processes=spec.num_processes,
+        process_id=spec.process_id)
+    return spec
+
+
 def configure(environ=None, headroom: float = DEFAULT_HEADROOM) -> ShareGrant | None:
     """Apply the grant to this process's env (before jax import).
 

@@ -102,6 +102,17 @@ ENV_TPU_CHIPS_PER_PROCESS_BOUNDS = "TPU_CHIPS_PER_PROCESS_BOUNDS"
 ENV_TPU_PROCESS_BOUNDS = "TPU_PROCESS_BOUNDS"
 ENV_XLA_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
 
+#: Gang metadata injected so a member can bootstrap jax.distributed:
+#: its group's name and size (worker index and coordinator address come
+#: from standard k8s mechanisms — JOB_COMPLETION_INDEX on indexed Jobs /
+#: a headless service — read by tpushare.runtime.jaxenv).
+ENV_POD_GROUP = "TPUSHARE_POD_GROUP"
+ENV_POD_GROUP_SIZE = "TPUSHARE_POD_GROUP_SIZE"
+
+#: Coordinator address ("host:port") for jax.distributed.initialize;
+#: usually the group's rank-0 headless-service DNS name.
+ENV_COORDINATOR = "TPUSHARE_COORDINATOR"
+
 #: Value used for ANN_ASSIGNED.
 ASSIGNED_FALSE = "false"
 ASSIGNED_TRUE = "true"
