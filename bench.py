@@ -48,6 +48,10 @@ class ExtenderClient:
 
 
 def main() -> None:
+    import logging
+    # Expected-path warnings (gang members held pending quorum) must not
+    # pollute the one-line JSON contract.
+    logging.disable(logging.WARNING)
     from tpushare.cmd.main import build_stack
     from tpushare.k8s.builders import make_node, make_pod
     from tpushare.k8s.fake import FakeApiServer
@@ -98,6 +102,8 @@ def main() -> None:
     server.shutdown()
     controller.stop()
 
+    gang_ms, gang_hosts = bench_gang()
+
     latencies.sort()
     p50 = statistics.median(latencies)
     p99 = latencies[int(len(latencies) * 0.99) - 1]
@@ -110,7 +116,64 @@ def main() -> None:
         "p99_filter_bind_ms": round(p99, 3),
         "pods_bound": bound,
         "nodes": NODES,
+        "gang_hosts": gang_hosts,
+        "gang_commit_ms": round(gang_ms, 1),
     }))
+
+
+def bench_gang(hosts: int = 16) -> tuple[float, int]:
+    """BASELINE config #5: schedule a whole-slice gang (one 4-chip worker
+    per v5p host) and time from first member seen to ALL members bound —
+    the end-to-end all-or-nothing commit latency."""
+    from tpushare.cmd.main import build_stack
+    from tpushare.k8s.builders import make_node, make_pod
+    from tpushare.k8s.fake import FakeApiServer
+    from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+    from tpushare.utils import const
+
+    api = FakeApiServer()
+    for i in range(hosts):
+        api.create_node(make_node(f"gang-{i:02d}", chips=CHIPS,
+                                  hbm_per_chip=CHIP_HBM,
+                                  topology="2x2x1", tpu_type="v5p"))
+    controller, pred, binder, inspect = build_stack(api)
+    controller.start(workers=4)
+    server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect)
+    serve_forever(server)
+    host, port = server.server_address[:2]
+    client = ExtenderClient(host, port)
+    names = [f"gang-{i:02d}" for i in range(hosts)]
+    ann = {const.ANN_POD_GROUP: "slice",
+           const.ANN_POD_GROUP_MIN: str(hosts)}
+
+    t0 = time.perf_counter()
+    for i in range(hosts):
+        pod = api.create_pod(make_pod(f"w-{i:02d}", chips=CHIPS,
+                                      annotations=ann))
+        status, result = client.post("/tpushare-scheduler/filter",
+                                     {"Pod": pod.raw, "NodeNames": names})
+        assert status == 200, result
+        candidates = result["NodeNames"]
+        assert candidates, result["FailedNodes"]
+        client.post("/tpushare-scheduler/bind", {
+            "PodName": pod.name, "PodNamespace": pod.namespace,
+            "PodUID": pod.uid, "Node": candidates[0]})
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if all(api.get_pod("default", f"w-{i:02d}").node_name
+               for i in range(hosts)):
+            break
+        time.sleep(0.002)
+    dt = (time.perf_counter() - t0) * 1000.0
+    placed = {api.get_pod("default", f"w-{i:02d}").node_name
+              for i in range(hosts)}
+    assert len(placed) == hosts, f"gang spread over {len(placed)} hosts"
+    client.close()
+    server.shutdown()
+    binder.gang_planner.stop()
+    controller.stop()
+    return dt, hosts
 
 
 if __name__ == "__main__":
