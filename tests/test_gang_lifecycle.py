@@ -141,6 +141,42 @@ class TestTopologyMismatch:
         assert podutils.get_chip_ids_from_annotation(placed) != []
 
 
+class TestHBMSliceGang:
+    def test_hbm_slice_gang_commits(self, api):
+        """Gang members can be HBM slices, not just whole chips (a
+        multi-host sharded inference deployment): same reserve/commit
+        protocol, same ledger accounting."""
+        cache = make_cluster(api, hosts=2)
+        planner = GangPlanner(cache, api, ttl=5)
+        pods = []
+        for i in range(2):
+            pod = api.create_pod(make_pod(f"shard-{i}", hbm=44,
+                                          annotations=ANN))
+            pods.append(pod)
+        with pytest.raises(GangPending):
+            planner.bind_member(pods[0], "host-0")
+        # reserved against the ledger even before quorum
+        assert cache.get_node_info("host-0").get_available_hbm()[0] == 51
+        planner.bind_member(pods[1], "host-1")
+        for i in range(2):
+            stored = api.get_pod("default", f"shard-{i}")
+            assert stored.node_name == f"host-{i}"
+            assert podutils.get_hbm_from_pod_annotation(stored) == 44
+
+    def test_colocated_gang_members_share_node(self, api):
+        """Two gang members that both fit one node may land together —
+        quorum is about the GROUP, not node spread."""
+        cache = make_cluster(api, hosts=1)
+        planner = GangPlanner(cache, api, ttl=5)
+        p0 = api.create_pod(make_pod("a", hbm=40, annotations=ANN))
+        p1 = api.create_pod(make_pod("b", hbm=40, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        planner.bind_member(p1, "host-0")
+        assert api.get_pod("default", "a").node_name == "host-0"
+        assert api.get_pod("default", "b").node_name == "host-0"
+
+
 class TestRelistResync:
     def test_relist_synthesizes_missed_delete(self, api, v5e_node):
         """A pod deleted while the watch was down is reconciled when the
