@@ -87,6 +87,24 @@ def test_gradients_match_reference():
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_gradients_multi_tile_online_stats():
+    """Backward across several KV tiles uses the saved lse correctly
+    (the dq/dkv passes rebuild p from it tile by tile)."""
+    q, k, v = _qkv(jax.random.PRNGKey(10), b=1, l=384, h=2, d=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(FA.flash_attention(q, k, v, True) ** 3)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(M.causal_attention(q, k, v) ** 3)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
 def test_supported_predicate():
     q, k, v = _qkv(jax.random.PRNGKey(5), l=256)
     assert FA.supported(q, k, v) == FA.HAVE_PALLAS

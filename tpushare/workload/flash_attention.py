@@ -199,6 +199,162 @@ def _flash_call(q, k, v, q_offset=None, kv_offset=None,
 
 
 # --------------------------------------------------------------------------
+# Backward kernels (flash backward: dq pass + dk/dv pass)
+#
+# Saved from forward: q, k, v, out, lse. delta = rowsum(do * out) is
+# computed in XLA (elementwise). Both passes rebuild each tile's
+# probabilities p = exp(s - lse) from the saved statistics instead of
+# storing the [L, L] matrix — backward HBM stays O(L·D) like forward.
+# --------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, blk_q: int, blk_k: int,
+                   scale: float):
+    """Grid (bh, q tiles, kv tiles; kv innermost): accumulate one Q
+    tile's dq over its visible KV tiles.
+
+    ds = p * (do·vᵀ - delta);  dq = scale · ds·k
+    """
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(kj * blk_k <= qi * blk_q + blk_q - 1)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][0:1, :].T                       # [blk_q, 1]
+        delta = delta_ref[0][0:1, :].T                   # [blk_q, 1]
+
+        s = jnp.dot(q * scale, k_blk.T,
+                    preferred_element_type=jnp.float32)
+        q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 0)
+        kv_pos = kj * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 1)
+        mask = q_pos >= kv_pos
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)       # [blk_q, blk_k]
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        acc_ref[:] += scale * jnp.dot(
+            ds, k_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, blk_q: int,
+                    blk_k: int, scale: float):
+    """Grid (bh, kv tiles, q tiles; q innermost): accumulate one KV
+    tile's dk/dv over the Q tiles that can see it.
+
+    dv = pᵀ·do;  dk = scale · dsᵀ·q
+    """
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(qi * blk_q + blk_q - 1 >= kj * blk_k)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][0:1, :].T
+        delta = delta_ref[0][0:1, :].T
+
+        s = jnp.dot(q * scale, k_blk.T,
+                    preferred_element_type=jnp.float32)
+        q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 0)
+        kv_pos = kj * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 1)
+        mask = q_pos >= kv_pos
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_acc[:] += scale * jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _flash_bwd_call(q, k, v, out, lse, do, interpret: bool = False):
+    """[BH, L, D] residuals + cotangent -> (dq, dk, dv)."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    blk_q = _tile(lq)
+    blk_k = _tile(lk)
+    scale = 1.0 / math.sqrt(d)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                              # [BH, L]
+    # (8, 128)-tiled carriers for the per-row statistics.
+    lse8 = jnp.broadcast_to(lse[:, None, :], (bh, 8, lq))
+    delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, lq))
+
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    qspec = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM)
+    row_q = pl.BlockSpec((1, 8, blk_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
+                          scale=scale),
+        grid=(bh, lq // blk_q, lk // blk_k),
+        in_specs=[qspec, kspec, kspec, qspec, row_q, row_q],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        interpret=interpret, **kwargs,
+    )(q, k, v, do, lse8, delta8)
+
+    # dkv pass: roles of the q/kv grid axes swap.
+    qspec2 = pl.BlockSpec((1, blk_q, d), lambda b, j, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kspec2 = pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0),
+                          memory_space=pltpu.VMEM)
+    row_q2 = pl.BlockSpec((1, 8, blk_q), lambda b, j, i: (b, 0, i),
+                          memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, blk_q=blk_q, blk_k=blk_k,
+                          scale=scale),
+        grid=(bh, lk // blk_k, lq // blk_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, row_q2, row_q2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
+                        pltpu.VMEM((blk_k, d), jnp.float32)],
+        interpret=interpret, **kwargs,
+    )(q, k, v, do, lse8, delta8)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
 # Public entry: custom-vjp wrapper over [B, L, H, D]
 # --------------------------------------------------------------------------
 
@@ -214,19 +370,30 @@ def supported(q, k, v) -> bool:
     return kernel_eligible(q.shape[1])
 
 
+def _kernel_ok(q, k, v, interpret: bool) -> bool:
+    """Trace-time static decision shared by fwd and bwd: no Pallas,
+    kill-switch env set, shapes the kernel cannot tile, or a non-TPU
+    backend without interpreter mode all take the XLA fallback."""
+    return supported(q, k, v) and (interpret
+                                   or jax.default_backend() == "tpu")
+
+
+def _to_bh(x):
+    b, l, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+
+
+def _from_bh(x, b, h):
+    bh, l, d = x.shape
+    return x.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+
+
 def _forward(q, k, v, interpret: bool):
-    b, lq, h, d = q.shape
-    if not supported(q, k, v) or \
-            (not interpret and jax.default_backend() != "tpu"):
-        # No Pallas, kill-switch env set, shapes the kernel cannot tile,
-        # or a non-TPU backend without interpreter mode: the documented
-        # XLA fallback (everything here is static at trace time, so this
-        # is a Python branch).
+    if not _kernel_ok(q, k, v, interpret):
         return _xla_reference(q, k, v)
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    out, _lse = _flash_call(to_bh(q), to_bh(k), to_bh(v),
+    out, _lse = _flash_call(_to_bh(q), _to_bh(k), _to_bh(v),
                             interpret=interpret)
-    return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    return _from_bh(out, q.shape[0], q.shape[2])
 
 
 def _xla_block_with_lse(q, k, v, q_offset, kv_offset):
@@ -329,13 +496,24 @@ def flash_attention(q, k, v, interpret: bool = False):
 
 
 def _fwd(q, k, v, interpret):
-    return _forward(q, k, v, interpret), (q, k, v)
+    if not _kernel_ok(q, k, v, interpret):
+        return _xla_reference(q, k, v), (q, k, v, None, None)
+    out_bh, lse = _flash_call(_to_bh(q), _to_bh(k), _to_bh(v),
+                              interpret=interpret)
+    out = _from_bh(out_bh, q.shape[0], q.shape[2])
+    return out, (q, k, v, out_bh, lse[:, 0, :])
 
 
 def _bwd(interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(_xla_reference, q, k, v)
-    return vjp(g)
+    q, k, v, out_bh, lse = res
+    if not _kernel_ok(q, k, v, interpret):
+        _, vjp = jax.vjp(_xla_reference, q, k, v)
+        return vjp(g)
+    b, _, h, _ = q.shape
+    dq, dk, dv = _flash_bwd_call(
+        _to_bh(q), _to_bh(k), _to_bh(v), out_bh, lse, _to_bh(g),
+        interpret=interpret)
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
 
 
 flash_attention.defvjp(_fwd, _bwd)
