@@ -37,6 +37,10 @@ class SchedulerCache:
         self._pod_lister = pod_lister
         self._nodes: dict[str, NodeInfo] = {}
         self._known_pods: dict[str, Pod] = {}  # uid -> annotated pod
+        #: name -> deletion epoch; bumped on every eviction so a lookup
+        #: that fetched the node doc before the delete cannot re-insert
+        #: a zombie ledger afterwards.
+        self._node_epochs: dict[str, int] = {}
         self._lock = threading.RLock()
 
     # ------------------------------------------------------------------ #
@@ -63,11 +67,30 @@ class SchedulerCache:
         covering the reference's non-sharing→sharing upgrade and the
         capacity-change case it missed.
         """
-        node = self._node_getter(name)
-        if node is None:
+        with self._lock:
+            epoch = self._node_epochs.get(name, 0)
+        try:
+            node = self._node_getter(name)
+        except Exception:
+            # Transient apiserver trouble is NOT deletion: serve the
+            # cached ledger rather than destroying live reservations.
+            log.warning("node getter errored for %s; serving cached view",
+                        name, exc_info=True)
             with self._lock:
                 return self._nodes.get(name)
+        if node is None:
+            # Apiserver no longer knows the node: evict the stale ledger
+            # so a deleted node's chips stop haunting inspect/metrics
+            # (the reference kept serving the cached NodeInfo forever —
+            # same cache/apiserver-divergence family as cache.go:130-162).
+            self.remove_node(name)
+            return None
         with self._lock:
+            if self._node_epochs.get(name, 0) != epoch:
+                # The node was deleted while we held its (pre-delete) doc;
+                # do not resurrect the ledger. Caller retries and sees the
+                # apiserver's current truth.
+                return self._nodes.get(name)
             info = self._nodes.get(name)
             if (info is not None and node.resource_version
                     and info.node.resource_version == node.resource_version):
@@ -91,6 +114,24 @@ class SchedulerCache:
     def get_node_infos(self) -> list[NodeInfo]:
         with self._lock:
             return list(self._nodes.values())
+
+    def remove_node(self, name: str) -> bool:
+        """Drop a deleted node's ledger (no reference counterpart — the
+        reference's cache only ever grew, SURVEY.md §2 defect family).
+
+        Known pods that were placed on the node stay in ``_known_pods``:
+        their annotations in the apiserver are still the durable truth,
+        the pod-lifecycle path removes them when the node controller
+        deletes them, and if the node re-registers ``get_node_info``
+        rebuilds its ledger from exactly those pods.
+        """
+        with self._lock:
+            removed = self._nodes.pop(name, None)
+            self._node_epochs[name] = self._node_epochs.get(name, 0) + 1
+        if removed is not None:
+            log.info("node %s deleted; dropped its ledger (%d chips)",
+                     name, removed.chip_count)
+        return removed is not None
 
     # ------------------------------------------------------------------ #
     # Pod lifecycle (reference cache.go:89-127)

@@ -46,17 +46,20 @@ class Controller:
             on_delete=self._on_pod_delete,
             filter_fn=self._is_relevant_pod,
         )
+        self.hub.add_node_handler(on_delete=self._on_node_delete)
 
     # -- listers wired into the cache ----------------------------------- #
 
     def _get_node(self, name: str):
+        """Returns None only for a *confirmed* missing node (both clients
+        map 404 to None themselves); a transient apiserver error
+        propagates, and the cache then serves its cached ledger instead
+        of evicting a live node's reservations."""
         node = self.hub.get_node(name)
         if node is not None:
             return node
-        try:  # informer may not have seen the node yet
-            return self.client.get_node(name)
-        except ApiError:
-            return None
+        # Informer may not have seen the node yet.
+        return self.client.get_node(name)
 
     def _list_pods(self):
         pods = self.hub.pods.list()
@@ -89,6 +92,13 @@ class Controller:
         with self._removed_lock:
             self._removed[pod.key()] = pod
         self.queue.add(pod.key())
+
+    def _on_node_delete(self, node) -> None:
+        """Node object deleted from the apiserver: drop its ledger so its
+        chips stop counting toward inspect/metrics. Handled inline (not
+        via the workqueue) — removal is idempotent and needs no apiserver
+        round-trip, so there is nothing to rate-limit or retry."""
+        self.cache.remove_node(node.name)
 
     # -- reconcile (reference syncPod, controller.go:174-205) ------------ #
 

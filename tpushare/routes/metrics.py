@@ -7,9 +7,16 @@ here are the source of the bench harness's latency numbers.
 
 from __future__ import annotations
 
+import threading
+
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
 
 REGISTRY = CollectorRegistry()
+
+# Scrapes run on ThreadingHTTPServer threads; the clear()+repopulate in
+# observe_cache must not interleave with another scrape's render() or
+# that scrape would see missing/partial node series.
+_SCRAPE_LOCK = threading.RLock()
 
 _BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
@@ -47,12 +54,27 @@ HBM_USED = Gauge(
 
 
 def render() -> bytes:
-    return generate_latest(REGISTRY)
+    with _SCRAPE_LOCK:
+        return generate_latest(REGISTRY)
 
 
 def observe_cache(cache) -> None:
-    """Refresh per-node utilization gauges from the ledger."""
-    for info in cache.get_node_infos():
-        HBM_TOTAL.labels(node=info.name).set(info.total_hbm)
-        used = sum(c.get_used_hbm() for c in info.chips.values())
-        HBM_USED.labels(node=info.name).set(used)
+    """Refresh per-node utilization gauges from the ledger.
+
+    Rebuilt from scratch each scrape so a deleted node's label series
+    disappears instead of freezing at its last value (gauges only know
+    the nodes the ledger currently knows)."""
+    with _SCRAPE_LOCK:
+        HBM_TOTAL.clear()
+        HBM_USED.clear()
+        for info in cache.get_node_infos():
+            HBM_TOTAL.labels(node=info.name).set(info.total_hbm)
+            used = sum(c.get_used_hbm() for c in info.chips.values())
+            HBM_USED.labels(node=info.name).set(used)
+
+
+def scrape(cache) -> bytes:
+    """Atomic observe+render for the /metrics handler."""
+    with _SCRAPE_LOCK:
+        observe_cache(cache)
+        return render()

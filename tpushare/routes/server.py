@@ -107,9 +107,9 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/healthz":
                 self._send_text(b"ok")
             elif path == "/metrics":
-                # Refresh per-node utilization gauges on scrape.
-                metrics.observe_cache(self.server.inspect.cache)
-                self._send_text(metrics.render(), ctype="text/plain; version=0.0.4")
+                # Atomic refresh+render of per-node utilization gauges.
+                self._send_text(metrics.scrape(self.server.inspect.cache),
+                                ctype="text/plain; version=0.0.4")
             elif path in ("/debug/threads", "/debug/pprof/goroutine"):
                 self._send_text(pprof.thread_dump().encode())
             elif path == "/debug/pprof":
