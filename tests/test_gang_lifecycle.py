@@ -57,9 +57,10 @@ class TestCommitFailures:
             planner.bind_member(p0, "host-0")
 
         p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
-        # quorum: commit runs; w0's bind fails transiently -> surfaced
-        with pytest.raises(ApiError):
-            planner.bind_member(p1, "host-1")
+        # quorum: commit runs; w0's bind fails transiently. w1's OWN
+        # binding succeeded, so w1's bind call reports success — the
+        # peer's failure is retried by housekeeping, not charged to w1.
+        planner.bind_member(p1, "host-1")
         assert api.get_pod("default", "w1").node_name == "host-1"
         assert api.get_pod("default", "w0").node_name == ""
         assert planner.stats()["default/train"]["committed"]
@@ -99,15 +100,15 @@ class TestCommitFailures:
         with pytest.raises(GangPending):
             planner.bind_member(p0, "host-0")
         p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
-        with pytest.raises(ApiError):
-            planner.bind_member(p1, "host-1")
+        planner.bind_member(p1, "host-1")  # w1's own bind is fine
         time.sleep(0.02)
         assert planner.expire_stale() == 0  # committed: not rolled back
         planner.retry_unbound()
         assert api.get_pod("default", "w0").node_name == "host-0"
 
     def test_housekeeping_thread_expires(self, api):
-        cache = make_cluster(api, hosts=1)
+        # 2 hosts so min=2 is feasible; the 2nd member never arrives.
+        cache = make_cluster(api, hosts=2)
         planner = GangPlanner(cache, api, ttl=0.05,
                               housekeeping_interval=0.02)
         planner.start()
@@ -200,3 +201,136 @@ class TestRelistResync:
         assert "newcomer" in added
         assert hub.get_pod("default", "ghost") is None
         assert hub.get_pod("default", "newcomer") is not None
+
+
+class TestQuorumFeasibility:
+    """An infeasible gang is rejected before reserving anything
+    (VERDICT round-1 weakness 6: no more TTL-long HBM squatting)."""
+
+    def test_infeasible_gang_never_reserves(self, api):
+        from tpushare.cache.nodeinfo import AllocationError
+
+        cache = make_cluster(api, hosts=2)  # 2 hosts can fit 2 members
+        planner = GangPlanner(cache, api, ttl=60)
+        ann = {const.ANN_POD_GROUP: "big", const.ANN_POD_GROUP_MIN: "4"}
+        pod = api.create_pod(make_pod("w0", chips=4, annotations=ann))
+        with pytest.raises(AllocationError) as ei:
+            planner.bind_member(pod, "host-0")
+        assert "infeasible" in str(ei.value)
+        assert not isinstance(ei.value, GangPending)
+        # Nothing reserved: ledger untouched, group table empty,
+        # annotations never written.
+        assert len(cache.get_node_info("host-0").get_free_chips()) == 4
+        assert len(cache.get_node_info("host-1").get_free_chips()) == 4
+        assert planner.stats() == {}
+        assert not podutils.is_assumed(api.get_pod("default", "w0"))
+
+    def test_feasibility_counts_hbm_slices_per_chip(self, api):
+        """HBM gangs: one chip can host several slices, so quorum
+        feasibility must count slices, not chips."""
+        from tpushare.cache.nodeinfo import AllocationError
+
+        cache = make_cluster(api, hosts=1)  # 4 chips x 95 GiB
+        planner = GangPlanner(cache, api, ttl=60)
+        # 8 x 44-GiB slices fit one host (2 per chip): min=8 feasible.
+        ann = {const.ANN_POD_GROUP: "s", const.ANN_POD_GROUP_MIN: "8"}
+        p = api.create_pod(make_pod("s0", hbm=44, annotations=ann))
+        with pytest.raises(GangPending):
+            planner.bind_member(p, "host-0")
+        # min=9 cannot fit: rejected without reserving.
+        ann9 = {const.ANN_POD_GROUP: "t", const.ANN_POD_GROUP_MIN: "9"}
+        p9 = api.create_pod(make_pod("t0", hbm=44, annotations=ann9))
+        with pytest.raises(AllocationError) as ei:
+            planner.bind_member(p9, "host-0")
+        assert "infeasible" in str(ei.value)
+
+    def test_reserved_members_count_toward_quorum(self, api):
+        """A half-reserved feasible gang stays accepted as capacity
+        tightens: already-reserved members are satisfied demand."""
+        cache = make_cluster(api, hosts=2)
+        planner = GangPlanner(cache, api, ttl=60)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")  # 1/2 reserved
+        # Remaining capacity fits exactly the one outstanding member.
+        p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
+        planner.bind_member(p1, "host-1")  # commits
+        assert api.get_pod("default", "w0").node_name == "host-0"
+        assert api.get_pod("default", "w1").node_name == "host-1"
+
+
+class TestHonestCommit:
+    def test_own_bind_failure_is_still_raised(self, api):
+        """The commit only reports failure to the member whose OWN
+        binding failed — and that one does still fail loudly."""
+        cache = make_cluster(api)
+        client = FlakyBindClient(api, fail_names={"w1"})
+        planner = GangPlanner(cache, client, ttl=60)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
+        with pytest.raises(ApiError):
+            planner.bind_member(p1, "host-1")  # w1's own POST failed
+        assert api.get_pod("default", "w0").node_name == "host-0"
+        # w1 recovers via housekeeping like any unbound member.
+        assert planner.retry_unbound() == 1
+        assert api.get_pod("default", "w1").node_name == "host-1"
+
+    def test_commit_emits_gang_committed_events(self, api):
+        from tpushare.k8s import events as ev
+
+        cache = make_cluster(api)
+        planner = GangPlanner(cache, api, ttl=60)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
+        planner.bind_member(p1, "host-1")
+        reasons = [e["reason"] for _ns, e in api.events]
+        assert reasons.count(ev.REASON_GANG_COMMITTED) == 2
+
+
+class TestDeletedMember:
+    def test_deleted_member_reservation_dropped_group_forgotten(self, api):
+        """A committed member deleted before its binding lands must not
+        leak the group: the 404 drops its reservation, frees the ledger,
+        and lets the group complete."""
+        cache = make_cluster(api)
+        client = FlakyBindClient(api, fail_names={"w0"})
+        planner = GangPlanner(cache, client, ttl=60)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
+        planner.bind_member(p1, "host-1")  # commits; w0 unbound
+
+        api.delete_pod("default", "w0")  # user deletes the straggler
+        planner.retry_unbound()
+        assert planner.stats() == {}  # group forgotten, not leaked
+        assert len(cache.get_node_info("host-0").get_free_chips()) == 4
+
+
+class TestHeterogeneousGang:
+    def test_mixed_request_gang_converges(self, api):
+        """Members with different shapes: a member the clone-bound
+        rejects passes once a peer reserves (needed shrinks)."""
+        from tpushare.cache.nodeinfo import AllocationError
+
+        api.create_node(make_node("hetero", chips=4, hbm_per_chip=95,
+                                  topology="2x2x1", tpu_type="v5p"))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60)
+        ann = {const.ANN_POD_GROUP: "mix", const.ANN_POD_GROUP_MIN: "2"}
+        big = api.create_pod(make_pod("big", chips=3, annotations=ann))
+        small = api.create_pod(make_pod("small", hbm=44, annotations=ann))
+        # Clone-bound for 'big' says 4//3 = 1 < 2: rejected this round.
+        with pytest.raises(AllocationError):
+            planner.bind_member(big, "hetero")
+        # 'small' passes (8 slices fit), reserves.
+        with pytest.raises(GangPending):
+            planner.bind_member(small, "hetero")
+        # Scheduler retry of 'big': needed=1, 3 free chips fit it.
+        planner.bind_member(big, "hetero")  # quorum -> commit
+        assert api.get_pod("default", "big").node_name == "hetero"
+        assert api.get_pod("default", "small").node_name == "hetero"

@@ -119,8 +119,9 @@ class TestEvents:
         from tpushare.cache.cache import SchedulerCache
         from tpushare.gang.planner import GangPending, GangPlanner
 
-        api.create_node(make_node("v5p-0", chips=4, hbm_per_chip=95,
-                                  topology="2x2x1", tpu_type="v5p"))
+        for i in range(2):  # quorum feasible; 2nd member just never shows
+            api.create_node(make_node(f"v5p-{i}", chips=4, hbm_per_chip=95,
+                                      topology="2x2x1", tpu_type="v5p"))
         cache = SchedulerCache(api.get_node, api.list_pods)
         planner = GangPlanner(cache, api, ttl=0.05)
         ann = {const.ANN_POD_GROUP: "g", const.ANN_POD_GROUP_MIN: "2"}

@@ -83,6 +83,11 @@ class SchedulerCache:
             # so a deleted node's chips stop haunting inspect/metrics
             # (the reference kept serving the cached NodeInfo forever —
             # same cache/apiserver-divergence family as cache.go:130-162).
+            # Epoch-guarded: if the node flapped and another thread
+            # already rebuilt a fresh ledger, do not destroy it.
+            with self._lock:
+                if self._node_epochs.get(name, 0) != epoch:
+                    return self._nodes.get(name)
             self.remove_node(name)
             return None
         with self._lock:

@@ -120,6 +120,21 @@ class NodeInfo:
                 )
             ]
 
+    def count_fits(self, pod: Pod) -> int:
+        """Upper bound on how many copies of ``pod``'s request this node
+        could host right now. Feeds the gang quorum-feasibility pre-check
+        (an over-estimate is fine there — placement compactness is still
+        enforced per member at allocate time)."""
+        with self._lock:
+            req_chips = podutils.get_chips_from_pod_resource(pod)
+            if req_chips > 0:
+                return len(self.get_free_chips()) // req_chips
+            req_hbm = podutils.get_hbm_from_pod_resource(pod)
+            if req_hbm <= 0:
+                return 0
+            return sum(v // req_hbm
+                       for v in self.get_available_hbm().values())
+
     # ------------------------------------------------------------------ #
     # Admission (reference Assume, nodeinfo.go:113-137)
     # ------------------------------------------------------------------ #

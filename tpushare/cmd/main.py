@@ -50,7 +50,10 @@ def build_stack(client):
     """Wire controller + handlers over one shared cache; returns
     (controller, predicate, bind, inspect)."""
     controller = Controller(client)
-    gang = GangPlanner(controller.cache, client)
+    # Quorum pre-checks enumerate nodes from the informer store — no
+    # apiserver LIST on the bind path.
+    gang = GangPlanner(controller.cache, client,
+                       node_lister=controller.hub.nodes.list)
     gang.start()  # housekeeping tick: gang expiry + bind retries
     predicate = Predicate(controller.cache)
     binder = Bind(controller.cache, client, gang_planner=gang,

@@ -25,6 +25,7 @@ REASON_BOUND = "TPUShareBound"
 REASON_BIND_FAILED = "TPUShareBindFailed"
 REASON_GANG_PENDING = "TPUShareGangPending"
 REASON_GANG_EXPIRED = "TPUShareGangExpired"
+REASON_GANG_COMMITTED = "TPUShareGangCommitted"
 
 
 def record(client, pod: Pod, reason: str, message: str,
