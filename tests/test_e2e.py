@@ -25,10 +25,10 @@ class Cluster:
 
     def __init__(self, api: FakeApiServer):
         self.api = api
-        self.controller, pred, binder, inspect = build_stack(api)
+        self.controller, pred, prio, binder, inspect = build_stack(api)
         self.controller.start(workers=2)
         self.server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder,
-                                         inspect)
+                                         inspect, prioritize=prio)
         serve_forever(self.server)
         self.base = f"http://127.0.0.1:{self.server.server_address[1]}"
 

@@ -14,12 +14,13 @@ from tpushare.routes.server import ExtenderHTTPServer, serve_forever
 from tpushare.scheduler.bind import Bind
 from tpushare.scheduler.inspect import Inspect
 from tpushare.scheduler.predicate import Predicate
+from tpushare.scheduler.prioritize import Prioritize
 from tpushare.utils import const
 
 
 def build_stack(api: FakeApiServer):
     cache = SchedulerCache(api.get_node, api.list_pods)
-    return (cache, Predicate(cache), Bind(cache, api),
+    return (cache, Predicate(cache), Prioritize(cache), Bind(cache, api),
             Inspect(cache, api.list_nodes))
 
 
@@ -27,7 +28,7 @@ class TestPredicateHandler:
     def test_filter_node_names_form(self, api, v5e_node):
         api.create_node(make_node("cpu-only", chips=0, hbm_per_chip=0,
                                   topology="1"))
-        _, pred, _, _ = build_stack(api)
+        _, pred, _, _, _ = build_stack(api)
         args = ExtenderArgs.from_json({
             "Pod": make_pod("p", hbm=8),
             "NodeNames": ["v5e-node-0", "cpu-only", "ghost"],
@@ -39,7 +40,7 @@ class TestPredicateHandler:
     def test_filter_full_nodes_form(self, api, v5e_node):
         """nodeCacheCapable:false sends full Node objects — the form the
         reference nil-derefed on (defect 8)."""
-        _, pred, _, _ = build_stack(api)
+        _, pred, _, _, _ = build_stack(api)
         args = ExtenderArgs.from_json({
             "Pod": make_pod("p", hbm=8),
             "Nodes": {"items": [v5e_node.raw]},
@@ -49,7 +50,7 @@ class TestPredicateHandler:
         assert [n.name for n in result.nodes] == ["v5e-node-0"]
 
     def test_non_tpu_pod_passes_through(self, api, v5e_node):
-        _, pred, _, _ = build_stack(api)
+        _, pred, _, _, _ = build_stack(api)
         args = ExtenderArgs.from_json({
             "Pod": make_pod("plain"), "NodeNames": ["v5e-node-0", "other"]})
         result = pred.handle(args)
@@ -59,7 +60,7 @@ class TestPredicateHandler:
 
 class TestBindHandler:
     def test_bind_success(self, api, v5e_node):
-        cache, _, binder, _ = build_stack(api)
+        cache, _, _, binder, _ = build_stack(api)
         api.create_pod(make_pod("p", hbm=8, uid="u1"))
         result = binder.handle(ExtenderBindingArgs(
             pod_name="p", pod_namespace="default", pod_uid="u1",
@@ -70,7 +71,7 @@ class TestBindHandler:
         assert cache.known_pod(stored.uid)
 
     def test_bind_no_fit(self, api, v5e_node):
-        _, _, binder, _ = build_stack(api)
+        _, _, _, binder, _ = build_stack(api)
         api.create_pod(make_pod("p", hbm=99, uid="u1"))
         result = binder.handle(ExtenderBindingArgs(
             pod_name="p", pod_namespace="default", pod_uid="u1",
@@ -78,14 +79,14 @@ class TestBindHandler:
         assert "no chip" in result.error
 
     def test_bind_unknown_pod(self, api, v5e_node):
-        _, _, binder, _ = build_stack(api)
+        _, _, _, binder, _ = build_stack(api)
         result = binder.handle(ExtenderBindingArgs(
             pod_name="ghost", pod_namespace="default", pod_uid="x",
             node="v5e-node-0"))
         assert "not found" in result.error
 
     def test_bind_unknown_node(self, api):
-        _, _, binder, _ = build_stack(api)
+        _, _, _, binder, _ = build_stack(api)
         api.create_pod(make_pod("p", hbm=8, uid="u1"))
         result = binder.handle(ExtenderBindingArgs(
             pod_name="p", pod_namespace="default", pod_uid="u1",
@@ -95,7 +96,7 @@ class TestBindHandler:
 
 class TestInspectHandler:
     def test_inspect_packing(self, api, v5e_node):
-        cache, _, binder, inspect = build_stack(api)
+        cache, _, _, binder, inspect = build_stack(api)
         for i, hbm in enumerate([8, 8, 12]):
             api.create_pod(make_pod(f"p{i}", hbm=hbm, uid=f"u{i}"))
             binder.handle(ExtenderBindingArgs(
@@ -113,14 +114,15 @@ class TestInspectHandler:
         assert node["chips"][1]["usedHBM"] == 12
 
     def test_inspect_unknown_node(self, api):
-        _, _, _, inspect = build_stack(api)
+        _, _, _, _, inspect = build_stack(api)
         assert "error" in inspect.handle("ghost")
 
 
 @pytest.fixture
 def http_stack(api, v5e_node):
-    _, pred, binder, inspect = build_stack(api)
-    server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect)
+    _, pred, prio, binder, inspect = build_stack(api)
+    server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect,
+                                prioritize=prio)
     serve_forever(server)
     port = server.server_address[1]
     yield api, f"http://127.0.0.1:{port}"

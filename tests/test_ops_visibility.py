@@ -89,9 +89,10 @@ class TestHTTPS:
             check=True, capture_output=True)
 
         api.create_node(make_node("v5e-0"))
-        controller, pred, binder, inspect = build_stack(api)
+        controller, pred, prio, binder, inspect = build_stack(api)
         controller.start(workers=2)
-        server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect)
+        server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect,
+                                    prioritize=prio)
         enable_tls(server, str(cert), str(key))
         serve_forever(server)
         try:

@@ -1,0 +1,126 @@
+"""Prioritize-verb tests: cross-node tightest-fit scoring, ICI
+compactness, gang consolidation, and the HTTP wire form (a bare
+HostPriorityList JSON array, scores 0-10)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tpushare.api.extender import ExtenderArgs
+from tpushare.cache.cache import SchedulerCache
+from tpushare.gang.planner import GangPending, GangPlanner
+from tpushare.scheduler.prioritize import Prioritize
+from tpushare.utils import const
+
+
+def scores(prio, pod, names):
+    from tpushare.api.objects import Pod
+    if isinstance(pod, dict):
+        pod = Pod(pod)
+    args = ExtenderArgs(pod=pod, node_names=list(names))
+    return {e.host: e.score for e in prio.handle(args)}
+
+
+class TestTightestFitAcrossNodes:
+    def test_partial_chip_beats_pristine_node(self, api):
+        """The node whose tightest chip leaves least waste wins — a
+        half-used chip beats cracking open a pristine node."""
+        api.create_node(make_node("partial", chips=4, hbm_per_chip=16))
+        api.create_node(make_node("pristine", chips=4, hbm_per_chip=16))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        # Occupy 8 GiB on partial's chip 0 -> 8 GiB hole.
+        seed = api.create_pod(make_pod("seed", hbm=8))
+        cache.get_node_info("partial").allocate(api, seed)
+
+        pod = make_pod("p", hbm=8)
+        s = scores(Prioritize(cache), pod, ["partial", "pristine"])
+        assert s["partial"] == 10  # exact fit into the 8 GiB hole
+        assert s["pristine"] < s["partial"]
+
+    def test_no_fit_scores_zero(self, api):
+        api.create_node(make_node("small", chips=2, hbm_per_chip=8))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        s = scores(Prioritize(cache), make_pod("p", hbm=12), ["small"])
+        assert s["small"] == 0
+
+    def test_unknown_node_scores_zero(self, api, v5e_node):
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        s = scores(Prioritize(cache), make_pod("p", hbm=8),
+                   ["v5e-node-0", "ghost"])
+        assert s["ghost"] == 0
+        assert s["v5e-node-0"] > 0
+
+    def test_non_tpu_pod_neutral(self, api, v5e_node):
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        s = scores(Prioritize(cache), make_pod("plain"), ["v5e-node-0"])
+        assert s == {"v5e-node-0": 0}
+
+
+class TestChipPodScoring:
+    def test_exact_chip_fit_beats_leftovers(self, api):
+        """A node left with zero free chips is a perfect pack; nodes
+        with chips left over score lower, preserving big blocks."""
+        api.create_node(make_node("two", chips=2, hbm_per_chip=16,
+                                  topology="2"))
+        api.create_node(make_node("four", chips=4, hbm_per_chip=16))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        s = scores(Prioritize(cache), make_pod("p", chips=2),
+                   ["two", "four"])
+        assert s["two"] > s["four"] > 0
+
+    def test_insufficient_chips_scores_zero(self, api, v5e_node):
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        s = scores(Prioritize(cache), make_pod("p", chips=8),
+                   ["v5e-node-0"])
+        assert s["v5e-node-0"] == 0
+
+
+class TestGangConsolidation:
+    def test_gang_member_prefers_peer_node(self, api):
+        """An HBM gang member gets a consolidation bonus on nodes that
+        already host a reserved peer (fewer hosts -> fewer DCN hops)."""
+        for name in ("a", "b"):
+            api.create_node(make_node(name, chips=4, hbm_per_chip=95,
+                                      topology="2x2x1", tpu_type="v5p"))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60)
+        ann = {const.ANN_POD_GROUP: "g", const.ANN_POD_GROUP_MIN: "2"}
+        p0 = api.create_pod(make_pod("m0", hbm=20, annotations=ann))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "a")
+
+        prio = Prioritize(cache, gang_planner=planner)
+        p1 = make_pod("m1", hbm=20, annotations=ann)
+        s = scores(prio, p1, ["a", "b"])
+        # Both nodes offer the same tightest chip EXCEPT a's chip 0
+        # already lost 20 GiB to m0 (tighter) + the gang bonus.
+        assert s["a"] > s["b"]
+
+
+class TestPrioritizeWire:
+    def test_http_returns_bare_array(self, api, v5e_node):
+        from tests.test_handlers import build_stack
+        from tpushare.routes.server import (ExtenderHTTPServer,
+                                            serve_forever)
+
+        _, pred, prio, binder, inspect = build_stack(api)
+        server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder,
+                                    inspect, prioritize=prio)
+        serve_forever(server)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            pod = make_pod("p", hbm=8)
+            req = urllib.request.Request(
+                f"{base}/tpushare-scheduler/prioritize",
+                json.dumps({"Pod": pod,
+                            "NodeNames": ["v5e-node-0"]}).encode(),
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as r:
+                doc = json.loads(r.read())
+            assert isinstance(doc, list)  # HostPriorityList: bare array
+            assert doc[0]["Host"] == "v5e-node-0"
+            assert 0 <= doc[0]["Score"] <= 10
+        finally:
+            server.shutdown()

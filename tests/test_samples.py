@@ -52,6 +52,7 @@ def test_config_files_parse():
         policy = json.load(f)
     ext = policy["extenders"][0]
     assert ext["filterVerb"] == "filter" and ext["bindVerb"] == "bind"
+    assert ext["prioritizeVerb"] == "prioritize" and ext["weight"] >= 1
     assert ext["nodeCacheCapable"] is True and ext["ignorable"] is False
     managed = {m["name"] for m in ext["managedResources"]}
     assert managed == {const.HBM_RESOURCE, const.CHIP_RESOURCE}
@@ -67,6 +68,7 @@ def test_config_files_parse():
     sched = yaml.safe_load(
         open(os.path.join(REPO, "config", "kube-scheduler-config.yaml")))
     assert sched["extenders"][0]["nodeCacheCapable"] is True
+    assert sched["extenders"][0]["prioritizeVerb"] == "prioritize"
 
 
 def test_samples_binpack_and_rejection(api):

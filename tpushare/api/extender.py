@@ -68,6 +68,25 @@ class ExtenderFilterResult:
 
 
 @dataclass
+class HostPriority:
+    """One entry of the prioritize response (counterpart of the vendored
+    ``schedulerapi.HostPriority``: Host + Score 0-10; the scheduler
+    multiplies Score by the extender's registered weight)."""
+
+    host: str
+    score: int
+
+    def to_json(self) -> dict:
+        return {"Host": self.host, "Score": self.score}
+
+
+def host_priority_list_to_json(entries: list[HostPriority]) -> list[dict]:
+    """The prioritize verb's wire response is a bare JSON array
+    (``schedulerapi.HostPriorityList``), not an object."""
+    return [e.to_json() for e in entries]
+
+
+@dataclass
 class ExtenderBindingArgs:
     """Arguments of ``POST .../bind``."""
 

@@ -30,6 +30,7 @@ from tpushare.routes.server import (ExtenderHTTPServer, enable_tls,
 from tpushare.scheduler.bind import Bind
 from tpushare.scheduler.inspect import Inspect
 from tpushare.scheduler.predicate import Predicate
+from tpushare.scheduler.prioritize import Prioritize
 
 log = logging.getLogger(__name__)
 
@@ -48,7 +49,7 @@ def setup_signals(stop_event: threading.Event) -> None:
 
 def build_stack(client):
     """Wire controller + handlers over one shared cache; returns
-    (controller, predicate, bind, inspect)."""
+    (controller, predicate, prioritize, bind, inspect)."""
     controller = Controller(client)
     # Quorum pre-checks enumerate nodes from the informer store — no
     # apiserver LIST on the bind path.
@@ -56,11 +57,12 @@ def build_stack(client):
                        node_lister=controller.hub.nodes.list)
     gang.start()  # housekeeping tick: gang expiry + bind retries
     predicate = Predicate(controller.cache)
+    prioritize = Prioritize(controller.cache, gang_planner=gang)
     binder = Bind(controller.cache, client, gang_planner=gang,
                   pod_lister=controller.hub.get_pod)
     inspect = Inspect(controller.cache, client.list_nodes,
                       gang_planner=gang)
-    return controller, predicate, binder, inspect
+    return controller, predicate, prioritize, binder, inspect
 
 
 def main() -> None:
@@ -73,13 +75,14 @@ def main() -> None:
     workers = int(os.environ.get("WORKERS", "4"))
 
     client = ApiClient(ClusterConfig.auto())
-    controller, predicate, binder, inspect = build_stack(client)
+    controller, predicate, prioritize, binder, inspect = build_stack(client)
 
     stop = threading.Event()
     setup_signals(stop)
 
     controller.start(workers=workers)
-    server = ExtenderHTTPServer(("0.0.0.0", port), predicate, binder, inspect)
+    server = ExtenderHTTPServer(("0.0.0.0", port), predicate, binder, inspect,
+                                prioritize=prioritize)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
     if bool(cert) != bool(key):
         log.error("TLS misconfigured: exactly one of TLS_CERT_FILE / "

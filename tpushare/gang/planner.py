@@ -182,6 +182,18 @@ class GangPlanner:
             f"cluster currently fits {copies + len(group.reservations)} "
             f"member(s); rejecting without reserving")
 
+    def member_nodes(self, pod: Pod) -> set[str]:
+        """Nodes currently hosting reserved members of ``pod``'s group
+        (feeds the prioritizer's gang-consolidation bonus)."""
+        group_name, _ = podutils.get_pod_group(pod)
+        key = (pod.namespace, group_name)
+        with self._table_lock:
+            group = self._groups.get(key)
+        if group is None:
+            return set()
+        with group.lock:
+            return {node for _, node in group.reservations.values()}
+
     def bind_member(self, pod: Pod, node_name: str) -> None:
         """Reserve-or-commit one gang member; raises GangPending below
         quorum and AllocationError/ApiError on real failures."""

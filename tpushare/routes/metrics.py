@@ -28,6 +28,11 @@ FILTER_LATENCY = Histogram(
     "Latency of extender filter requests",
     registry=REGISTRY, buckets=_BUCKETS,
 )
+PRIORITIZE_LATENCY = Histogram(
+    "tpushare_prioritize_latency_seconds",
+    "Latency of extender prioritize requests",
+    registry=REGISTRY, buckets=_BUCKETS,
+)
 BIND_LATENCY = Histogram(
     "tpushare_bind_latency_seconds",
     "Latency of extender bind requests",

@@ -4,6 +4,8 @@ Counterpart of the reference's ``pkg/routes/routes.go`` (+ ``pprof.go``).
 Routes:
 
 * ``POST {prefix}/filter``  — predicate (reference routes.go:58-99)
+* ``POST {prefix}/prioritize`` — node scoring (no reference counterpart:
+  it registered no prioritizeVerb and let the default scheduler spread)
 * ``POST {prefix}/bind``    — bind; HTTP 500 on error (routes.go:101-148)
 * ``GET  {prefix}/inspect[/<node>]`` — utilization dump (routes.go:39-56)
 * ``GET  /version``         — version string (routes.go:150-156)
@@ -30,7 +32,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import tpushare
-from tpushare.api.extender import ExtenderArgs, ExtenderBindingArgs
+from tpushare.api.extender import (ExtenderArgs, ExtenderBindingArgs,
+                                   host_priority_list_to_json)
 from tpushare.routes import metrics, pprof
 
 log = logging.getLogger(__name__)
@@ -43,10 +46,11 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
     allow_reuse_address = True
 
     def __init__(self, addr, predicate, binder, inspect,
-                 prefix: str = DEFAULT_PREFIX):
+                 prefix: str = DEFAULT_PREFIX, prioritize=None):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
+        self.prioritize = prioritize
         self.prefix = prefix
         super().__init__(addr, _Handler)
 
@@ -155,6 +159,19 @@ class _Handler(BaseHTTPRequestHandler):
                 with metrics.FILTER_LATENCY.time():
                     result = self.server.predicate.handle(ExtenderArgs.from_json(doc))
                 self._send_json(result.to_json())
+            elif path == f"{prefix}/prioritize":
+                doc = self._read_json()
+                if doc is None:
+                    return
+                if self.server.prioritize is None:
+                    self._send_json({"Error": "prioritize not configured"},
+                                    404)
+                    return
+                with metrics.PRIORITIZE_LATENCY.time():
+                    entries = self.server.prioritize.handle(
+                        ExtenderArgs.from_json(doc))
+                # HostPriorityList is a bare JSON array on the wire.
+                self._send_json(host_priority_list_to_json(entries))
             elif path == f"{prefix}/bind":
                 doc = self._read_json()
                 if doc is None:
