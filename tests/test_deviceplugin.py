@@ -494,3 +494,134 @@ def test_allocation_grant_round_trips_through_jaxenv():
     assert grant.chip_ids == (3,)
     assert grant.hbm_pod_gib == 12 and grant.hbm_chip_gib == 16
     assert 0.0 < grant.mem_fraction < 1.0
+
+
+# --------------------------------------------------------------------------
+# Batch Allocate atomicity (advisor findings: no side effects on failure)
+# --------------------------------------------------------------------------
+
+
+class FailingCommitApi:
+    """Proxies the fake apiserver but fails pod updates N times (the
+    assigned=true flip losing its optimistic-lock retries)."""
+
+    def __init__(self, api, failures=99):
+        self._api = api
+        self.failures = failures
+
+    def __getattr__(self, name):
+        return getattr(self._api, name)
+
+    def update_pod(self, pod):
+        if self.failures > 0:
+            self.failures -= 1
+            from tpushare.k8s.errors import ConflictError
+            raise ConflictError(reason="synthetic conflict")
+        return self._api.update_pod(pod)
+
+
+class TestBatchAtomicity:
+    def _two_container_pod(self, api):
+        pod = _assumed_pod("mc", 12, [0], 1)
+        pod["spec"]["containers"] = [
+            {"name": "a", "resources": {"limits": {const.HBM_RESOURCE: "8"}}},
+            {"name": "b", "resources": {"limits": {const.HBM_RESOURCE: "4"}}},
+        ]
+        return api.create_pod(pod)
+
+    def test_failed_commit_leaves_no_partial_state(self):
+        api = FakeApiServer()
+        failing = FailingCommitApi(api)
+        api.create_node(make_node("host-a"))
+        inv = disc.fake_inventory(chips=4, hbm_gib=16, tpu_type="v5e")
+        plugin = TPUSharePlugin("host-a", failing, inv)
+        self._two_container_pod(api)
+
+        from tpushare.k8s.errors import ConflictError
+        with pytest.raises(ConflictError):
+            plugin.allocate_hbm_batch([["x"] * 8, ["x"] * 4])
+        # RPC failed atomically: no partial records survive, so kubelet's
+        # whole-pod readmission rematches both containers cleanly.
+        assert plugin._partial == {}
+        failing.failures = 0
+        allocs = plugin.allocate_hbm_batch([["x"] * 8, ["x"] * 4])
+        assert len(allocs) == 2
+        assert api.get_pod("default", "mc").annotations[
+            const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+    def test_unmatchable_second_container_applies_nothing(self):
+        """Container 1 matches, container 2 doesn't: the whole batch
+        raises and container 1's record is NOT retained."""
+        api = FakeApiServer()
+        plugin = _plugin(api)
+        self._two_container_pod(api)
+        with pytest.raises(AllocateError):
+            plugin.allocate_hbm_batch([["x"] * 8, ["x"] * 5])  # 5 != 4
+        assert plugin._partial == {}
+        # assigned was never flipped
+        assert api.get_pod("default", "mc").annotations[
+            const.ANN_ASSIGNED] == const.ASSIGNED_FALSE
+
+
+# --------------------------------------------------------------------------
+# GetPreferredAllocation consults the extender's plan (VERDICT item 8)
+# --------------------------------------------------------------------------
+
+
+class TestPreferredIds:
+    def test_chip_preference_follows_planned_annotation(self):
+        api = FakeApiServer()
+        plugin = _plugin(api)
+        pod = make_pod("w", chips=2, node_name="host-a", annotations={
+            const.ANN_CHIP_IDX: "2,3",   # the ledger's ICI-compact pick
+            const.ANN_HBM_POD: "32",
+            const.ANN_HBM_CHIP: "16",
+            const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+            const.ANN_ASSUME_TIME: "1",
+        })
+        api.create_pod(pod)
+        available = [f"tpushare-chip-{i:02d}" for i in range(4)]
+        ids = plugin.preferred_ids(const.CHIP_RESOURCE, available, 2)
+        assert ids == ["tpushare-chip-02", "tpushare-chip-03"]
+
+    def test_hbm_preference_lands_on_planned_chip(self):
+        api = FakeApiServer()
+        plugin = _plugin(api)
+        api.create_pod(_assumed_pod("w", 8, [3], 1))
+        available = [HBM_DEV_FMT.format(chip=c, gib=g)
+                     for c in range(4) for g in range(16)]
+        ids = plugin.preferred_ids(const.HBM_RESOURCE, available, 8)
+        assert len(ids) == 8
+        assert all(i.startswith("tpushare-hbm-03-") for i in ids)
+
+    def test_no_pending_pod_returns_empty(self):
+        plugin = _plugin(FakeApiServer())
+        assert plugin.preferred_ids(
+            const.CHIP_RESOURCE, ["tpushare-chip-00"], 1) == []
+
+def test_per_container_retry_completes_commit():
+    """kubelet's other mode: one Allocate RPC per container. A commit
+    failure on the LAST container must preserve the earlier containers'
+    grant records, so retrying just that container still reaches the
+    assigned=true commit (review regression)."""
+    api = FakeApiServer()
+    failing = FailingCommitApi(api, failures=0)
+    api.create_node(make_node("host-a"))
+    inv = disc.fake_inventory(chips=4, hbm_gib=16, tpu_type="v5e")
+    plugin = TPUSharePlugin("host-a", failing, inv)
+    TestBatchAtomicity()._two_container_pod(api)
+
+    plugin.allocate_hbm_batch([["x"] * 8])      # container a: fine
+    assert list(plugin._partial.values()) == [[8]]
+
+    from tpushare.k8s.errors import ConflictError
+    failing.failures = 99
+    with pytest.raises(ConflictError):
+        plugin.allocate_hbm_batch([["x"] * 4])  # container b: commit dies
+    assert list(plugin._partial.values()) == [[8]]  # a's record survives
+
+    failing.failures = 0
+    plugin.allocate_hbm_batch([["x"] * 4])      # kubelet retries b
+    assert plugin._partial == {}
+    assert api.get_pod("default", "mc").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_TRUE

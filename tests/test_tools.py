@@ -113,3 +113,30 @@ class TestInspectCLI:
 
         assert cli.main(["--endpoint", "http://127.0.0.1:1"]) == 1
         assert "cannot reach" in capsys.readouterr().err
+
+
+def test_debug_routes_can_be_disabled(api):
+    """DEBUG_ROUTES=0 (advisor finding: unauthenticated profiling shares
+    the webhook NodePort) turns every /debug/* path into a 404 while the
+    scheduling and observability routes keep working."""
+    from tests.test_handlers import build_stack
+    from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+
+    api.create_node(make_node("v5e-0", chips=2, hbm_per_chip=16))
+    _, pred, prio, binder, inspect = build_stack(api)
+    server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect,
+                                prioritize=prio, debug_routes=False)
+    serve_forever(server)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    try:
+        for path in ("/debug/pprof", "/debug/pprof/profile",
+                     "/debug/pprof/heap", "/debug/threads"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}{path}")
+            assert ei.value.code == 404
+        with urllib.request.urlopen(f"{base}/healthz") as r:
+            assert r.status == 200
+        with urllib.request.urlopen(f"{base}/metrics") as r:
+            assert r.status == 200
+    finally:
+        server.shutdown()

@@ -12,6 +12,8 @@ Environment (reference cmd/main.go:23,92-98):
   ``THREADNESS`` was dead code, SURVEY.md §2 defect 1)
 * ``LOG_LEVEL``  — debug/info/warning (the reference's manifest set this
   but the code never read it, SURVEY.md §2 C16)
+* ``DEBUG_ROUTES`` — set 0/false to disable the /debug/pprof suite
+  (it shares the webhook NodePort and the profiler taxes the hot path)
 """
 
 from __future__ import annotations
@@ -81,8 +83,11 @@ def main() -> None:
     setup_signals(stop)
 
     controller.start(workers=workers)
+    debug_routes = os.environ.get("DEBUG_ROUTES", "1").lower() not in (
+        "0", "false", "no")
     server = ExtenderHTTPServer(("0.0.0.0", port), predicate, binder, inspect,
-                                prioritize=prioritize)
+                                prioritize=prioritize,
+                                debug_routes=debug_routes)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
     if bool(cert) != bool(key):
         log.error("TLS misconfigured: exactly one of TLS_CERT_FILE / "

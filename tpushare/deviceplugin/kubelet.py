@@ -184,13 +184,17 @@ class DevicePluginServicer:
                 return
 
     def GetPreferredAllocation(self, request, context):
-        """Prefer IDs that co-locate on the fewest chips (the bin-pack
-        spirit of the extender, applied to kubelet's device pick)."""
+        """Prefer the IDs the extender's ledger already planned for the
+        next pending pod (its chip-idx annotation), falling back to
+        sorted order — so kubelet's pick and the ledger's ICI-compact
+        placement agree instead of diverging on ties."""
         resp = pb.PreferredAllocationResponse()
         for creq in request.container_requests:
-            ids = sorted(creq.available_deviceIDs)
+            available = list(creq.available_deviceIDs)
             keep = list(creq.must_include_deviceIDs)
-            for cid in ids:
+            preferred = self.plugin.preferred_ids(
+                self.resource, available, creq.allocation_size)
+            for cid in preferred + sorted(available):
                 if len(keep) >= creq.allocation_size:
                     break
                 if cid not in keep:
@@ -199,19 +203,24 @@ class DevicePluginServicer:
         return resp
 
     def Allocate(self, request, context):
+        requests = [list(creq.devicesIDs)
+                    for creq in request.container_requests]
+        try:
+            # Batch semantics: every container is matched before any pod
+            # state mutates, so a failure aborts the RPC with NO side
+            # effects — kubelet treats the whole RPC atomically and so
+            # do we (advisor finding on mid-loop aborts).
+            if self.resource == const.HBM_RESOURCE:
+                allocs = self.plugin.allocate_hbm_batch(requests)
+            else:
+                allocs = self.plugin.allocate_chips_batch(requests)
+        except (AllocateError, ApiError) as exc:
+            # ApiError covers the commit racing a pod deletion
+            # (NotFoundError) or losing its optimistic-lock retries
+            # (ConflictError): fail the RPC cleanly, kubelet retries.
+            context.abort(grpc.StatusCode.INTERNAL, str(exc))
         resp = pb.AllocateResponse()
-        for creq in request.container_requests:
-            ids = list(creq.devicesIDs)
-            try:
-                if self.resource == const.HBM_RESOURCE:
-                    alloc = self.plugin.allocate_hbm(ids)
-                else:
-                    alloc = self.plugin.allocate_chips(ids)
-            except (AllocateError, ApiError) as exc:
-                # ApiError covers the commit racing a pod deletion
-                # (NotFoundError) or losing its optimistic-lock retries
-                # (ConflictError): fail the RPC cleanly, kubelet retries.
-                context.abort(grpc.StatusCode.INTERNAL, str(exc))
+        for alloc in allocs:
             resp.container_responses.append(_to_pb_allocation(alloc))
         return resp
 

@@ -137,75 +137,167 @@ class TPUSharePlugin:
     # ------------------------------------------------------------------ #
 
     def allocate_hbm(self, device_ids: list[str]) -> ContainerAllocation:
-        """kubelet granted ``len(device_ids)`` GiB to ONE container; find
-        whose they are (two-level match: container limit, then pod)."""
-        requested_gib = len(device_ids)
-        with self._alloc_lock:
-            pod = self._match_pending_pod(requested_gib)
-            if pod is None:
-                raise AllocateError(
-                    f"no assumed pod on {self.node_name} has a container "
-                    f"requesting {requested_gib} GiB HBM")
-            chip_ids = podutils.get_chip_ids_from_annotation(pod)
-            served = self._partial.get(pod.uid, []) + [requested_gib]
-            total = podutils.get_hbm_from_pod_resource(pod)
-            if sum(served) >= total:
-                # Last container served: second phase of the commit.
-                self._commit_assigned(pod)
-                self._partial.pop(pod.uid, None)
-            else:
-                self._partial[pod.uid] = served
-            return self._build_allocation(pod, chip_ids,
-                                          granted_gib=requested_gib)
+        """Single-container convenience over :meth:`allocate_hbm_batch`."""
+        return self.allocate_hbm_batch([device_ids])[0]
 
     def allocate_chips(self, device_ids: list[str]) -> ContainerAllocation:
-        """Whole-chip allocations carry real chip indices in the IDs."""
-        req_ids = sorted(
-            int(d.rsplit("-", 1)[1]) for d in device_ids
-            if d.startswith("tpushare-chip-"))
-        if not req_ids:
-            raise AllocateError(f"unrecognized chip device ids: {device_ids}")
+        return self.allocate_chips_batch([device_ids])[0]
+
+    def allocate_hbm_batch(
+            self, requests: list[list[str]]) -> list[ContainerAllocation]:
+        """One Allocate RPC: kubelet granted each container in
+        ``requests`` its GiB set; find whose they are (two-level match:
+        container limit, then pod).
+
+        All containers are matched against a STAGED copy of the
+        partial-grant state before any pod-state mutation happens
+        (advisor findings: a mid-loop failure must not leave earlier
+        containers' records — or a committed assigned=true — behind
+        while kubelet treats the whole RPC as failed)."""
         with self._alloc_lock:
-            pod = self._match_pending_pod(len(req_ids), chips=True)
-            if pod is not None:
+            return self._allocate_batch(requests, chips=False)
+
+    def allocate_chips_batch(
+            self, requests: list[list[str]]) -> list[ContainerAllocation]:
+        with self._alloc_lock:
+            return self._allocate_batch(requests, chips=True)
+
+    def _allocate_batch(self, requests: list[list[str]],
+                        chips: bool) -> list[ContainerAllocation]:
+        table = self._partial_chips if chips else self._partial
+        staged = {uid: list(v) for uid, v in table.items()}
+        allocations: list[ContainerAllocation] = []
+        to_commit: dict[str, Pod] = {}
+        # One apiserver LIST for the whole batch (not one per container).
+        pods = self._list_node_pods()
+
+        for device_ids in requests:
+            if chips:
+                req_ids = sorted(
+                    int(d.rsplit("-", 1)[1]) for d in device_ids
+                    if d.startswith("tpushare-chip-"))
+                if not req_ids:
+                    raise AllocateError(
+                        f"unrecognized chip device ids: {device_ids}")
+                requested = len(req_ids)
+            else:
+                req_ids = []
+                requested = len(device_ids)
+
+            pod = self._match_pending_pod(requested, chips=chips,
+                                          partial=staged, pods=pods)
+            if pod is None:
+                if chips:
+                    # Chip-only pods may bypass the extender (no HBM
+                    # request): still hand out the devices kubelet picked.
+                    allocations.append(ContainerAllocation(
+                        envs=self._chip_envs(req_ids),
+                        devices=self._device_nodes(req_ids),
+                        annotations={}))
+                    continue
+                raise AllocateError(
+                    f"no assumed pod on {self.node_name} has a container "
+                    f"requesting {requested} GiB HBM")
+
+            served = staged.get(pod.uid, [])
+            if chips:
                 # Prefer the extender's placement over kubelet's pick; a
                 # multi-container pod's containers take consecutive spans
                 # of the planned chip list (container k's span starts
-                # after the chips earlier Allocates consumed).
+                # after the chips earlier containers consumed).
                 planned = podutils.get_chip_ids_from_annotation(pod)
-                chip_ids = req_ids
-                served = self._partial_chips.get(pod.uid, [])
-                if planned:
-                    offset = sum(served)
-                    span = planned[offset:offset + len(req_ids)]
-                    chip_ids = span if len(span) == len(req_ids) else planned
-                served = served + [len(req_ids)]
-                if sum(served) >= podutils.get_chips_from_pod_resource(pod):
-                    self._commit_assigned(pod)
-                    self._partial_chips.pop(pod.uid, None)
-                else:
-                    self._partial_chips[pod.uid] = served
-                return self._build_allocation(pod, chip_ids,
-                                              whole_chips=True)
-        # Chip-only pods may bypass the extender (no HBM request): still
-        # hand out the devices kubelet picked.
-        envs = self._chip_envs(req_ids)
-        return ContainerAllocation(
-            envs=envs, devices=self._device_nodes(req_ids), annotations={})
+                chip_ids = (self._planned_span(planned, served, requested)
+                            or req_ids)
+                total = podutils.get_chips_from_pod_resource(pod)
+                alloc = self._build_allocation(pod, chip_ids,
+                                               whole_chips=True)
+            else:
+                chip_ids = podutils.get_chip_ids_from_annotation(pod)
+                total = podutils.get_hbm_from_pod_resource(pod)
+                alloc = self._build_allocation(pod, chip_ids,
+                                               granted_gib=requested)
+            staged[pod.uid] = served + [requested]
+            if sum(staged[pod.uid]) >= total:
+                to_commit[pod.uid] = pod
+            allocations.append(alloc)
+
+        # Every container matched: NOW mutate, commits first. If the
+        # assigned flip fails the RPC aborts with the table UNTOUCHED —
+        # records from earlier successful RPCs survive, so a kubelet
+        # retry (same container or whole-pod readmission under a fresh
+        # uid) re-matches and re-attempts the commit; entries of pods
+        # that get deleted instead are dropped by _prune_partials.
+        for pod in to_commit.values():
+            self._commit_assigned(pod)
+        for uid in to_commit:
+            staged.pop(uid, None)
+        table.clear()
+        table.update(staged)
+        return allocations
+
+    @staticmethod
+    def _planned_span(planned: list[int], served: list[int],
+                      n: int) -> list[int]:
+        """Container k's consecutive span of the extender's planned chip
+        list — the single rule both Allocate and preferred_ids follow so
+        kubelet's preference and the eventual grant agree."""
+        if not planned:
+            return []
+        offset = sum(served)
+        span = planned[offset:offset + n]
+        return span if len(span) == n else planned
+
+    def _list_node_pods(self) -> list[Pod]:
+        return [p for p in self.client.list_pods(node_name=self.node_name)
+                if p.node_name == self.node_name]
+
+    def preferred_ids(self, resource: str, available: list[str],
+                      size: int) -> list[str]:
+        """Device IDs kubelet should prefer for its next allocation of
+        ``size``, so its pick matches the ledger's planned placement
+        (reference designs.md:92-104 join-key protocol, strengthened:
+        the extender's chip-idx annotation, not sorted order, drives the
+        choice).
+
+        * chip resource — the pending pod's planned chip list (next
+          unserved span for multi-container pods) mapped to device IDs;
+        * HBM resource — the GiB devices living on the planned chip(s),
+          so co-tenants land on the chips the ledger packed them onto.
+        """
+        chips = resource == const.CHIP_RESOURCE
+        avail = set(available)
+        with self._alloc_lock:
+            pod = self._match_pending_pod(size, chips=chips)
+            if pod is None:
+                return []
+            planned = podutils.get_chip_ids_from_annotation(pod)
+            if not planned:
+                return []
+            if chips:
+                span = self._planned_span(
+                    planned, self._partial_chips.get(pod.uid, []), size)
+                ids = [CHIP_DEV_FMT.format(chip=c) for c in span]
+            else:
+                prefixes = tuple(f"tpushare-hbm-{c:02d}-" for c in planned)
+                ids = [d for d in sorted(avail)
+                       if d.startswith(prefixes)][:size]
+        return [i for i in ids if i in avail]
 
     # -- matching ------------------------------------------------------- #
 
-    def _match_pending_pod(self, requested: int,
-                           chips: bool = False) -> Pod | None:
+    def _match_pending_pod(self, requested: int, chips: bool = False,
+                           partial: dict[str, list[int]] | None = None,
+                           pods: list[Pod] | None = None) -> Pod | None:
         """Assumed-but-unassigned pods on this node with a matching
         request, earliest assume-time first (designs.md:92-104: kubelet's
         Allocate carries no pod identity, so request size + FIFO order is
-        the join key)."""
+        the join key). ``partial`` overlays the staged served-grant view
+        of an in-flight batch; ``pods`` reuses a batch's LIST snapshot."""
         candidates = []
         live_uids = set()
-        for pod in self.client.list_pods(node_name=self.node_name):
-            if pod.node_name != self.node_name:
-                continue
+        if pods is None:
+            pods = self._list_node_pods()
+        for pod in pods:
             live_uids.add(pod.uid)
             if podutils.is_complete_pod(pod):
                 continue
@@ -223,7 +315,8 @@ class TPUSharePlugin:
                         else const.HBM_RESOURCE)
             limits = [l for l in pod.iter_resource_limits(resource)
                       if l > 0]
-            if requested not in self._unserved_limits(pod, limits, chips):
+            if requested not in self._unserved_limits(pod, limits, chips,
+                                                      partial):
                 continue
             candidates.append((podutils.get_assume_time(pod), pod.key(), pod))
         self._prune_partials(live_uids)
@@ -233,13 +326,16 @@ class TPUSharePlugin:
         return candidates[0][2]
 
     def _unserved_limits(self, pod: Pod, limits: list[int],
-                         chips: bool = False) -> list[int]:
+                         chips: bool = False,
+                         partial: dict[str, list[int]] | None = None,
+                         ) -> list[int]:
         """Container limits not yet covered by earlier Allocate calls for
         this pod (multiset difference: each served grant consumes one
         matching container limit)."""
-        table = self._partial_chips if chips else self._partial
+        if partial is None:
+            partial = self._partial_chips if chips else self._partial
         remaining = list(limits)
-        for grant in table.get(pod.uid, []):
+        for grant in partial.get(pod.uid, []):
             if grant in remaining:
                 remaining.remove(grant)
         return remaining

@@ -21,6 +21,7 @@ import ssl
 import threading
 import urllib.error
 import urllib.request
+from urllib.parse import quote
 
 from tpushare.api.objects import Node, Pod
 from tpushare.k8s.errors import ApiError, ConflictError, NotFoundError
@@ -177,7 +178,10 @@ class ApiClient:
         pods: list[Pod] = []
         cont = ""
         while True:
-            path = base + (f"&continue={cont}" if cont else "")
+            # quote(): today's apiserver continue tokens happen to be
+            # URL-safe base64, but that is their encoding choice, not a
+            # contract this client should lean on.
+            path = base + (f"&continue={quote(cont)}" if cont else "")
             doc = self._request("GET", path)
             pods.extend(Pod(item) for item in doc.get("items", []))
             cont = doc.get("metadata", {}).get("continue", "")

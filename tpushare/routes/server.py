@@ -46,12 +46,17 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
     allow_reuse_address = True
 
     def __init__(self, addr, predicate, binder, inspect,
-                 prefix: str = DEFAULT_PREFIX, prioritize=None):
+                 prefix: str = DEFAULT_PREFIX, prioritize=None,
+                 debug_routes: bool = True):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
         self.prioritize = prioritize
         self.prefix = prefix
+        #: /debug/* shares the NodePort with the scheduling webhook; the
+        #: CPU profiler and tracemalloc tax the hot path, so operators
+        #: can switch the routes off (DEBUG_ROUTES=0 in the manifest).
+        self.debug_routes = debug_routes
         super().__init__(addr, _Handler)
 
 
@@ -114,6 +119,8 @@ class _Handler(BaseHTTPRequestHandler):
                 # Atomic refresh+render of per-node utilization gauges.
                 self._send_text(metrics.scrape(self.server.inspect.cache),
                                 ctype="text/plain; version=0.0.4")
+            elif path.startswith("/debug/") and not self.server.debug_routes:
+                self._send_json({"Error": "debug routes disabled"}, 404)
             elif path in ("/debug/threads", "/debug/pprof/goroutine"):
                 self._send_text(pprof.thread_dump().encode())
             elif path == "/debug/pprof":
