@@ -625,3 +625,75 @@ def test_per_container_retry_completes_commit():
     assert plugin._partial == {}
     assert api.get_pod("default", "mc").annotations[
         const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+
+def test_batch_never_commits_two_pods():
+    """A batch whose containers could match two different pods must pin
+    to the first pod (kubelet sends one pod per Allocate RPC) — a
+    sequential two-pod commit could strand pod A assigned=true when pod
+    B's flip fails (review finding)."""
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    api.create_pod(_assumed_pod("pa", 8, [0], 1))
+    api.create_pod(_assumed_pod("pb", 8, [1], 2))
+    with pytest.raises(AllocateError):
+        # Container 1 matches pa; container 2 is pinned to pa, whose
+        # only 8-GiB limit is spoken for -> the whole batch aborts.
+        plugin.allocate_hbm_batch([["x"] * 8, ["x"] * 8])
+    # No side effects on either pod.
+    assert api.get_pod("default", "pa").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_FALSE
+    assert api.get_pod("default", "pb").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_FALSE
+    assert plugin._partial == {}
+    # Served one at a time (kubelet's real cadence), both succeed.
+    plugin.allocate_hbm_batch([["x"] * 8])
+    plugin.allocate_hbm_batch([["x"] * 8])
+    assert api.get_pod("default", "pa").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+    assert api.get_pod("default", "pb").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+
+def test_allocate_does_not_resurrect_pruned_partials():
+    """_prune_partials runs during matching; the batch write-back must
+    not restore entries it deleted (review finding)."""
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    pod = TestBatchAtomicity()._two_container_pod(api)
+    plugin.allocate_hbm_batch([["x"] * 8])   # container a served
+    assert plugin._partial == {pod.uid: [8]}
+
+    api.delete_pod("default", "mc")          # pod dies mid-allocation
+    api.create_pod(_assumed_pod("other", 4, [1], 5))
+    plugin.allocate_hbm_batch([["x"] * 4])   # another pod's allocate
+    # The dead pod's record was pruned and STAYS pruned.
+    assert pod.uid not in plugin._partial
+    assert plugin._partial == {}
+
+
+def test_preferred_ids_batch_advances_span_per_container():
+    """Containers of one pod in one GetPreferredAllocation RPC get
+    consecutive planned spans, not N copies of span 1 (review finding)."""
+    api = FakeApiServer()
+    plugin = _plugin(api)
+    pod = make_pod("w", chips=4, node_name="host-a", annotations={
+        const.ANN_CHIP_IDX: "0,1,2,3",
+        const.ANN_HBM_POD: "64",
+        const.ANN_HBM_CHIP: "16",
+        const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+        const.ANN_ASSUME_TIME: "1",
+    })
+    pod["spec"]["containers"] = [
+        {"name": "a", "resources": {"limits": {const.CHIP_RESOURCE: "2"}}},
+        {"name": "b", "resources": {"limits": {const.CHIP_RESOURCE: "2"}}},
+    ]
+    api.create_pod(pod)
+    all_ids = [f"tpushare-chip-{i:02d}" for i in range(4)]
+    first, second = plugin.preferred_ids_batch(
+        const.CHIP_RESOURCE,
+        [(all_ids, 2), (["tpushare-chip-02", "tpushare-chip-03"], 2)])
+    assert first == ["tpushare-chip-00", "tpushare-chip-01"]
+    assert second == ["tpushare-chip-02", "tpushare-chip-03"]
+    # Preference is speculative: nothing persisted.
+    assert plugin._partial_chips == {}

@@ -189,12 +189,14 @@ class DevicePluginServicer:
         sorted order — so kubelet's pick and the ledger's ICI-compact
         placement agree instead of diverging on ties."""
         resp = pb.PreferredAllocationResponse()
-        for creq in request.container_requests:
-            available = list(creq.available_deviceIDs)
+        batch = [(list(creq.available_deviceIDs), creq.allocation_size)
+                 for creq in request.container_requests]
+        preferred_per = self.plugin.preferred_ids_batch(self.resource,
+                                                        batch)
+        for creq, preferred in zip(request.container_requests,
+                                   preferred_per):
             keep = list(creq.must_include_deviceIDs)
-            preferred = self.plugin.preferred_ids(
-                self.resource, available, creq.allocation_size)
-            for cid in preferred + sorted(available):
+            for cid in preferred + sorted(creq.available_deviceIDs):
                 if len(keep) >= creq.allocation_size:
                     break
                 if cid not in keep:

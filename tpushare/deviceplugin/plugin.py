@@ -168,6 +168,14 @@ class TPUSharePlugin:
         staged = {uid: list(v) for uid, v in table.items()}
         allocations: list[ContainerAllocation] = []
         to_commit: dict[str, Pod] = {}
+        touched: set[str] = set()
+        # kubelet sends one pod's containers per Allocate RPC, so once a
+        # container matches a pod, the rest of the batch is pinned to it
+        # — a batch can then commit at most ONE pod, which is what makes
+        # abort-on-failure truly side-effect-free (a sequential
+        # multi-pod commit could strand pod A assigned=true when pod
+        # B's flip fails).
+        batch_pod: Pod | None = None
         # One apiserver LIST for the whole batch (not one per container).
         pods = self._list_node_pods()
 
@@ -184,8 +192,9 @@ class TPUSharePlugin:
                 req_ids = []
                 requested = len(device_ids)
 
-            pod = self._match_pending_pod(requested, chips=chips,
-                                          partial=staged, pods=pods)
+            pod = self._match_pending_pod(
+                requested, chips=chips, partial=staged,
+                pods=[batch_pod] if batch_pod is not None else pods)
             if pod is None:
                 if chips:
                     # Chip-only pods may bypass the extender (no HBM
@@ -199,6 +208,8 @@ class TPUSharePlugin:
                     f"no assumed pod on {self.node_name} has a container "
                     f"requesting {requested} GiB HBM")
 
+            batch_pod = pod
+            touched.add(pod.uid)
             served = staged.get(pod.uid, [])
             if chips:
                 # Prefer the extender's placement over kubelet's pick; a
@@ -229,10 +240,14 @@ class TPUSharePlugin:
         # that get deleted instead are dropped by _prune_partials.
         for pod in to_commit.values():
             self._commit_assigned(pod)
-        for uid in to_commit:
-            staged.pop(uid, None)
-        table.clear()
-        table.update(staged)
+        # Write back ONLY this batch's entries: untouched uids keep the
+        # live table's (post-prune) state — clear()+update(staged) would
+        # resurrect entries _prune_partials deleted during matching.
+        for uid in touched:
+            if uid in to_commit or not staged.get(uid):
+                table.pop(uid, None)
+            else:
+                table[uid] = staged[uid]
         return allocations
 
     @staticmethod
@@ -253,35 +268,58 @@ class TPUSharePlugin:
 
     def preferred_ids(self, resource: str, available: list[str],
                       size: int) -> list[str]:
-        """Device IDs kubelet should prefer for its next allocation of
-        ``size``, so its pick matches the ledger's planned placement
-        (reference designs.md:92-104 join-key protocol, strengthened:
-        the extender's chip-idx annotation, not sorted order, drives the
+        """Single-request convenience over :meth:`preferred_ids_batch`."""
+        return self.preferred_ids_batch(resource, [(available, size)])[0]
+
+    def preferred_ids_batch(
+            self, resource: str,
+            requests: list[tuple[list[str], int]]) -> list[list[str]]:
+        """Device IDs kubelet should prefer for each container request,
+        so its pick matches the ledger's planned placement (reference
+        designs.md:92-104 join-key protocol, strengthened: the
+        extender's chip-idx annotation, not sorted order, drives the
         choice).
 
         * chip resource — the pending pod's planned chip list (next
           unserved span for multi-container pods) mapped to device IDs;
         * HBM resource — the GiB devices living on the planned chip(s),
           so co-tenants land on the chips the ledger packed them onto.
+
+        A GetPreferredAllocation RPC carries all of a pod's containers,
+        so matching runs against a LOCAL overlay of the served-grant
+        state: container 2 sees container 1's speculative span and gets
+        the NEXT one, instead of recomputing span 1 and silently falling
+        back to sorted order. Nothing persists — only Allocate commits.
         """
         chips = resource == const.CHIP_RESOURCE
-        avail = set(available)
+        out: list[list[str]] = []
         with self._alloc_lock:
-            pod = self._match_pending_pod(size, chips=chips)
-            if pod is None:
-                return []
-            planned = podutils.get_chip_ids_from_annotation(pod)
-            if not planned:
-                return []
-            if chips:
-                span = self._planned_span(
-                    planned, self._partial_chips.get(pod.uid, []), size)
-                ids = [CHIP_DEV_FMT.format(chip=c) for c in span]
-            else:
-                prefixes = tuple(f"tpushare-hbm-{c:02d}-" for c in planned)
-                ids = [d for d in sorted(avail)
-                       if d.startswith(prefixes)][:size]
-        return [i for i in ids if i in avail]
+            base = self._partial_chips if chips else self._partial
+            overlay = {uid: list(v) for uid, v in base.items()}
+            pods = self._list_node_pods()
+            for available, size in requests:
+                avail = set(available)
+                pod = self._match_pending_pod(size, chips=chips,
+                                              partial=overlay, pods=pods)
+                if pod is None:
+                    out.append([])
+                    continue
+                planned = podutils.get_chip_ids_from_annotation(pod)
+                if not planned:
+                    out.append([])
+                    continue
+                if chips:
+                    span = self._planned_span(
+                        planned, overlay.get(pod.uid, []), size)
+                    ids = [CHIP_DEV_FMT.format(chip=c) for c in span]
+                else:
+                    prefixes = tuple(f"tpushare-hbm-{c:02d}-"
+                                     for c in planned)
+                    ids = [d for d in sorted(avail)
+                           if d.startswith(prefixes)][:size]
+                overlay[pod.uid] = overlay.get(pod.uid, []) + [size]
+                out.append([i for i in ids if i in avail])
+        return out
 
     # -- matching ------------------------------------------------------- #
 
