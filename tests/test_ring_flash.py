@@ -71,8 +71,9 @@ def test_merge_reconstructs_full_attention():
 
 
 def test_block_gradients_flow():
-    """flash_block_with_lse is differentiable (custom VJP recomputes via
-    the XLA twin), including traced integer offsets."""
+    """flash_block_with_lse is differentiable — the custom VJP runs the
+    fused Pallas backward kernels (here in interpreter mode), including
+    the lse cotangent fold and traced integer offsets."""
     q, k, v = _qkv(jax.random.PRNGKey(7), b=1, l=128, h=2, d=64)
 
     def loss(q, k, v):

@@ -21,11 +21,14 @@ Design notes (per the TPU kernel playbook):
   ``broadcasted_iota`` (1-D iota does not exist on TPU).
 * matmuls request ``preferred_element_type=jnp.float32`` so bf16 inputs
   accumulate in fp32 on the MXU.
-* the kernel is forward-only; gradients flow through a ``custom_vjp``
-  whose backward recomputes attention with the XLA path at the same
-  primal point (exact same math, so grads are exact). Training keeps the
-  forward's memory win via remat; a fused backward kernel is the natural
-  next step.
+* gradients flow through a ``custom_vjp`` backed by fused Pallas
+  backward kernels (dq pass + dk/dv pass) that rebuild each tile's
+  probabilities from the saved (out, lse) statistics — backward HBM is
+  O(L·D) like forward. The kernels are offset-aware, so the SAME
+  backward serves plain self-attention and each ring-attention step
+  (round-1's ring backward recomputed through XLA and materialized the
+  [L/sp, L/sp] block score matrix; that gap is closed). The lse
+  cotangent from ring merges folds into delta (see ``_flash_bwd_call``).
 
 Falls back to the XLA einsum path (:func:`model.causal_attention`) when
 shapes are not tile-aligned or Pallas is unavailable; on CPU the kernel
@@ -207,23 +210,31 @@ def _flash_call(q, k, v, q_offset=None, kv_offset=None,
 # storing the [L, L] matrix — backward HBM stays O(L·D) like forward.
 # --------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, acc_ref, *, blk_q: int, blk_k: int,
+def _bwd_dq_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, acc_ref, *, blk_q: int, blk_k: int,
                    scale: float):
     """Grid (bh, q tiles, kv tiles; kv innermost): accumulate one Q
     tile's dq over its visible KV tiles.
 
     ds = p * (do·vᵀ - delta);  dq = scale · ds·k
+
+    ``qo_ref``/``ko_ref`` are the same SMEM global-position offsets the
+    forward takes, so the kernel serves both plain self-attention
+    (offsets 0/0) and a ring-attention step — the mask and the
+    tile-skip compare GLOBAL positions. ``delta_ref`` already folds in
+    the lse cotangent (see ``_flash_bwd_call``).
     """
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     n_kv = pl.num_programs(2)
+    q_off = qo_ref[0, 0]
+    kv_off = ko_ref[0, 0]
 
     @pl.when(kj == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when(kj * blk_k <= qi * blk_q + blk_q - 1)
+    @pl.when(kv_off + kj * blk_k <= q_off + qi * blk_q + blk_q - 1)
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         k_blk = k_ref[0].astype(jnp.float32)
@@ -234,12 +245,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jnp.dot(q * scale, k_blk.T,
                     preferred_element_type=jnp.float32)
-        q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+        q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(
             jnp.int32, (blk_q, blk_k), 0)
-        kv_pos = kj * blk_k + jax.lax.broadcasted_iota(
+        kv_pos = kv_off + kj * blk_k + jax.lax.broadcasted_iota(
             jnp.int32, (blk_q, blk_k), 1)
         mask = q_pos >= kv_pos
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)       # [blk_q, blk_k]
+        # s - lse could overflow exp() on fully-masked rows (lse is the
+        # finite NEG_INF sentinel there); clamp — masked rows only ever
+        # select the 0 branch anyway.
+        p = jnp.where(mask, jnp.exp(jnp.minimum(s - lse, 30.0)), 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         acc_ref[:] += scale * jnp.dot(
@@ -250,24 +264,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, blk_q: int,
-                    blk_k: int, scale: float):
+def _bwd_dkv_kernel(qo_ref, ko_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    blk_q: int, blk_k: int, scale: float):
     """Grid (bh, kv tiles, q tiles; q innermost): accumulate one KV
     tile's dk/dv over the Q tiles that can see it.
 
-    dv = pᵀ·do;  dk = scale · dsᵀ·q
+    dv = pᵀ·do;  dk = scale · dsᵀ·q   (offset-aware like the dq pass)
     """
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     n_q = pl.num_programs(2)
+    q_off = qo_ref[0, 0]
+    kv_off = ko_ref[0, 0]
 
     @pl.when(qi == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    @pl.when(qi * blk_q + blk_q - 1 >= kj * blk_k)
+    @pl.when(q_off + qi * blk_q + blk_q - 1 >= kv_off + kj * blk_k)
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         k_blk = k_ref[0].astype(jnp.float32)
@@ -278,12 +294,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jnp.dot(q * scale, k_blk.T,
                     preferred_element_type=jnp.float32)
-        q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+        q_pos = q_off + qi * blk_q + jax.lax.broadcasted_iota(
             jnp.int32, (blk_q, blk_k), 0)
-        kv_pos = kj * blk_k + jax.lax.broadcasted_iota(
+        kv_pos = kv_off + kj * blk_k + jax.lax.broadcasted_iota(
             jnp.int32, (blk_q, blk_k), 1)
         mask = q_pos >= kv_pos
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        p = jnp.where(mask, jnp.exp(jnp.minimum(s - lse, 30.0)), 0.0)
         dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -297,8 +313,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _flash_bwd_call(q, k, v, out, lse, do, interpret: bool = False):
-    """[BH, L, D] residuals + cotangent -> (dq, dk, dv)."""
+def _flash_bwd_call(q, k, v, out, lse, do, dlse=None, q_offset=None,
+                    kv_offset=None, interpret: bool = False):
+    """[BH, L, D] residuals + cotangents -> (dq, dk, dv).
+
+    ``dlse`` is the cotangent of the lse output (nonzero whenever the
+    caller differentiates through a ring merge). The whole lse
+    contribution folds into delta: with p = exp(s - lse),
+    ∂lse/∂s = p, so ds = p·(do·vᵀ - delta + dlse) — i.e. the kernels
+    run unchanged on delta' = rowsum(do*out) - dlse.
+
+    Offsets are the forward's global-position scalars, making this the
+    backward of ONE ring step without materializing [Lq, Lkv].
+    """
     bh, lq, d = q.shape
     lk = k.shape[1]
     blk_q = _tile(lq)
@@ -306,15 +333,23 @@ def _flash_bwd_call(q, k, v, out, lse, do, interpret: bool = False):
     scale = 1.0 / math.sqrt(d)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)                              # [BH, L]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     # (8, 128)-tiled carriers for the per-row statistics.
     lse8 = jnp.broadcast_to(lse[:, None, :], (bh, 8, lq))
     delta8 = jnp.broadcast_to(delta[:, None, :], (bh, 8, lq))
+    q_off = jnp.asarray(0 if q_offset is None else q_offset,
+                        jnp.int32).reshape(1, 1)
+    kv_off = jnp.asarray(0 if kv_offset is None else kv_offset,
+                         jnp.int32).reshape(1, 1)
 
     kwargs = {}
     if not interpret:
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"))
 
+    smem = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
+                        memory_space=pltpu.SMEM)
     qspec = pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0),
@@ -325,14 +360,16 @@ def _flash_bwd_call(q, k, v, out, lse, do, interpret: bool = False):
         functools.partial(_bwd_dq_kernel, blk_q=blk_q, blk_k=blk_k,
                           scale=scale),
         grid=(bh, lq // blk_q, lk // blk_k),
-        in_specs=[qspec, kspec, kspec, qspec, row_q, row_q],
+        in_specs=[smem, smem, qspec, kspec, kspec, qspec, row_q, row_q],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
         interpret=interpret, **kwargs,
-    )(q, k, v, do, lse8, delta8)
+    )(q_off, kv_off, q, k, v, do, lse8, delta8)
 
     # dkv pass: roles of the q/kv grid axes swap.
+    smem2 = pl.BlockSpec((1, 1), lambda b, j, i: (0, 0),
+                         memory_space=pltpu.SMEM)
     qspec2 = pl.BlockSpec((1, blk_q, d), lambda b, j, i: (b, i, 0),
                           memory_space=pltpu.VMEM)
     kspec2 = pl.BlockSpec((1, blk_k, d), lambda b, j, i: (b, j, 0),
@@ -343,14 +380,15 @@ def _flash_bwd_call(q, k, v, out, lse, do, interpret: bool = False):
         functools.partial(_bwd_dkv_kernel, blk_q=blk_q, blk_k=blk_k,
                           scale=scale),
         grid=(bh, lk // blk_k, lq // blk_q),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, row_q2, row_q2],
+        in_specs=[smem2, smem2, qspec2, kspec2, kspec2, qspec2,
+                  row_q2, row_q2],
         out_specs=[kspec2, kspec2],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
                         pltpu.VMEM((blk_k, d), jnp.float32)],
         interpret=interpret, **kwargs,
-    )(q, k, v, do, lse8, delta8)
+    )(q_off, kv_off, q, k, v, do, lse8, delta8)
     return dq, dk, dv
 
 
@@ -428,43 +466,77 @@ def flash_block_with_lse(q, k, v, q_offset=0, kv_offset=0,
     materializing cross-block score matrices. Offsets are traced scalars
     (they come from ``jax.lax.axis_index`` inside shard_map).
 
-    Differentiable: the backward pass recomputes this block through the
-    XLA twin at the same primal point, so the whole ring composition
-    (scan + ppermute + merges) has exact gradients.
+    Differentiable: the backward runs the fused Pallas dq/dkv kernels
+    on the saved (out, lse) residuals — O(L·D) HBM, no [Lq, Lkv]
+    score matrix — folding the lse cotangent from downstream ring
+    merges into delta. Off the kernel path (unaligned shapes, no TPU)
+    it recomputes through the XLA twin instead.
     """
     return _block_forward(q, k, v, q_offset, kv_offset, interpret)
 
 
+def _block_kernel_ok(q, k, interpret) -> bool:
+    """Trace-time static gate shared by the block fwd and bwd."""
+    return (kernel_eligible(q.shape[1]) and _tile(k.shape[1]) != 0
+            and (interpret or jax.default_backend() == "tpu"))
+
+
+def _block_forward_raw(q, k, v, q_offset, kv_offset, interpret):
+    """Kernel invocation returning both layouts: the model-facing
+    ([B, L, H, D] out, [B, L, H] lse) and the [BH, ...] forms the
+    Pallas backward consumes as residuals."""
+    b, lq, h, _ = q.shape
+    out_bh, lse_raw = _flash_call(_to_bh(q), _to_bh(k), _to_bh(v),
+                                  q_offset=q_offset, kv_offset=kv_offset,
+                                  interpret=interpret)
+    lse_bh = lse_raw[:, 0, :]                            # [BH, L]
+    out = _from_bh(out_bh, b, h)
+    lse = lse_bh.reshape(b, h, lq).transpose(0, 2, 1)
+    return out, lse, out_bh, lse_bh
+
+
 def _block_forward(q, k, v, q_offset, kv_offset, interpret):
-    b, lq, h, d = q.shape
-    use_kernel = (kernel_eligible(lq) and _tile(k.shape[1]) != 0
-                  and (interpret or jax.default_backend() == "tpu"))
-    if not use_kernel:
+    if not _block_kernel_ok(q, k, interpret):
         return _xla_block_with_lse(q, k, v, q_offset, kv_offset)
-    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    out, lse = _flash_call(to_bh(q), to_bh(k), to_bh(v),
-                           q_offset=q_offset, kv_offset=kv_offset,
-                           interpret=interpret)
-    out = out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
-    lse = lse[:, 0, :].reshape(b, h, lq).transpose(0, 2, 1)
+    out, lse, _, _ = _block_forward_raw(q, k, v, q_offset, kv_offset,
+                                        interpret)
     return out, lse
 
 
 def _block_fwd(q, k, v, q_offset, kv_offset, interpret):
-    return (_block_forward(q, k, v, q_offset, kv_offset, interpret),
-            (q, k, v, q_offset, kv_offset))
+    if not _block_kernel_ok(q, k, interpret):
+        out, lse = _xla_block_with_lse(q, k, v, q_offset, kv_offset)
+        return (out, lse), (q, k, v, None, None, q_offset, kv_offset)
+    out, lse, out_bh, lse_bh = _block_forward_raw(
+        q, k, v, q_offset, kv_offset, interpret)
+    return (out, lse), (q, k, v, out_bh, lse_bh, q_offset, kv_offset)
 
 
 def _block_bwd(interpret, res, cots):
     import numpy as np
 
-    q, k, v, q_offset, kv_offset = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_block_with_lse(q_, k_, v_, q_offset,
-                                               kv_offset), q, k, v)
-    dq, dk, dv = vjp(cots)
+    q, k, v, out_bh, lse_bh, q_offset, kv_offset = res
     float0 = lambda x: np.zeros(np.shape(x), jax.dtypes.float0)
-    return dq, dk, dv, float0(q_offset), float0(kv_offset)
+    if out_bh is None:
+        # XLA twin both ways: recompute-and-differentiate.
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _xla_block_with_lse(q_, k_, v_, q_offset,
+                                                   kv_offset), q, k, v)
+        dq, dk, dv = vjp(cots)
+        return dq, dk, dv, float0(q_offset), float0(kv_offset)
+    # Pallas backward: rebuilds per-tile probabilities from the saved
+    # (out, lse) statistics — backward HBM stays O(L·D), closing the
+    # round-1 gap where ring training recomputed through XLA and
+    # materialized [Lq, Lkv] per block.
+    do, dlse = cots
+    b, lq, h, _ = q.shape
+    dlse_bh = dlse.transpose(0, 2, 1).reshape(b * h, lq)
+    dq, dk, dv = _flash_bwd_call(
+        _to_bh(q), _to_bh(k), _to_bh(v), out_bh, lse_bh, _to_bh(do),
+        dlse=dlse_bh, q_offset=q_offset, kv_offset=kv_offset,
+        interpret=interpret)
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h),
+            float0(q_offset), float0(kv_offset))
 
 
 flash_block_with_lse.defvjp(_block_fwd, _block_bwd)
