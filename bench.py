@@ -259,6 +259,11 @@ def bench_gang(hosts: int = 16) -> tuple[float, int]:
 
 def main() -> None:
     import logging
+    import sys
+    global ROUNDS, MEASURE_FROM
+    if "--smoke" in sys.argv:
+        # CI smoke: same stack and wire protocol, fewer churn rounds.
+        ROUNDS, MEASURE_FROM = 6, 3
     # Expected-path warnings (gang members held pending quorum, pods
     # parked while the fleet is saturated) must not pollute the one-line
     # JSON contract.
