@@ -100,7 +100,7 @@ def run_churn(scored: bool, seed: int = 42):
         api.create_node(make_node(f"v5p-{i:02d}", chips=CHIPS,
                                   hbm_per_chip=CHIP_HBM,
                                   topology="2x2x1", tpu_type="v5p"))
-    controller, pred, prio, binder, inspect = build_stack(api)
+    controller, pred, prio, binder, inspect, _ = build_stack(api)
     controller.start(workers=4)
     server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect,
                                 prioritize=prio)
@@ -216,7 +216,7 @@ def bench_gang(hosts: int = 16) -> tuple[float, int]:
         api.create_node(make_node(f"gang-{i:02d}", chips=CHIPS,
                                   hbm_per_chip=CHIP_HBM,
                                   topology="2x2x1", tpu_type="v5p"))
-    controller, pred, prio, binder, inspect = build_stack(api)
+    controller, pred, prio, binder, inspect, _ = build_stack(api)
     controller.start(workers=4)
     server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect,
                                 prioritize=prio)

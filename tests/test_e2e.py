@@ -25,7 +25,7 @@ class Cluster:
 
     def __init__(self, api: FakeApiServer):
         self.api = api
-        self.controller, pred, prio, binder, inspect = build_stack(api)
+        self.controller, pred, prio, binder, inspect, _ = build_stack(api)
         self.controller.start(workers=2)
         self.server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder,
                                          inspect, prioritize=prio)

@@ -89,7 +89,7 @@ class TestHTTPS:
             check=True, capture_output=True)
 
         api.create_node(make_node("v5e-0"))
-        controller, pred, prio, binder, inspect = build_stack(api)
+        controller, pred, prio, binder, inspect, _ = build_stack(api)
         controller.start(workers=2)
         server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect,
                                     prioritize=prio)

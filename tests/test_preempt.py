@@ -1,0 +1,344 @@
+"""Preempt verb: wire protocol + TPU victim-selection policy.
+
+The reference never implemented ``preemptVerb`` (its vendored extender
+types stop at bind, ``types.go:258-302``), so priority classes could not
+evict to free shared-GPU memory. These tests pin the victim-selection
+policy (minimal cost, priority-respecting, gang-averse) and the dual wire
+forms, mirroring the golden-JSON style of ``tests/test_handlers.py``.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+from tests.conftest import make_node, make_pod
+from tpushare.api.extender import (ExtenderPreemptionArgs,
+                                   ExtenderPreemptionResult, Victims)
+from tpushare.api.objects import Pod
+from tpushare.cache.cache import SchedulerCache
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+from tpushare.scheduler.preempt import Preempt
+from tpushare.utils import const
+from tpushare.utils import pod as podutils
+
+
+def _stack(api: FakeApiServer):
+    cache = SchedulerCache(api.get_node, api.list_pods)
+    return cache, Preempt(cache)
+
+
+def _resident(cache, name, node, chip_ids, hbm, priority=0, uid=None,
+              annotations=None):
+    """Record an already-placed pod in the ledger, bypassing bind (tests
+    control exact chip placement)."""
+    pod = Pod(make_pod(name, hbm=hbm if len(chip_ids) == 1 else 0,
+                       chips=0 if len(chip_ids) == 1 else len(chip_ids),
+                       node_name=node, uid=uid or f"uid-{name}",
+                       priority=priority, annotations=annotations))
+    pod = podutils.updated_pod_annotation_spec(pod, chip_ids, hbm, 16)
+    assert cache.add_or_update_pod(pod)
+    return pod
+
+
+def _args(pod_doc, node_to_uids):
+    return ExtenderPreemptionArgs.from_json({
+        "Pod": pod_doc,
+        "NodeNameToMetaVictims": {
+            node: {"Pods": [{"UID": u} for u in uids]}
+            for node, uids in node_to_uids.items()
+        },
+    })
+
+
+class TestWireTypes:
+    def test_meta_victims_form(self):
+        args = ExtenderPreemptionArgs.from_json({
+            "Pod": make_pod("p", hbm=8),
+            "NodeNameToMetaVictims": {
+                "n1": {"Pods": [{"UID": "u1"}, {"UID": "u2"}],
+                       "NumPDBViolations": 1},
+            },
+        })
+        assert args.node_victims["n1"].victim_uids() == ["u1", "u2"]
+        assert args.node_victims["n1"].num_pdb_violations == 1
+
+    def test_full_victims_form(self):
+        """nodeCacheCapable:false sends whole pod objects."""
+        args = ExtenderPreemptionArgs.from_json({
+            "Pod": make_pod("p", hbm=8),
+            "NodeNameToVictims": {
+                "n1": {"Pods": [make_pod("v", hbm=4, uid="u-v")]},
+            },
+        })
+        assert args.node_victims["n1"].victim_uids() == ["u-v"]
+
+    def test_modern_camelcase_form(self):
+        """kube-scheduler >= 1.17 marshals via k8s.io/kube-scheduler/
+        extender/v1, whose json tags are camelCase — the form the
+        KubeSchedulerConfiguration in config/ actually produces."""
+        args = ExtenderPreemptionArgs.from_json({
+            "pod": make_pod("p", hbm=8),
+            "nodeNameToMetaVictims": {
+                "n1": {"pods": [{"uid": "u1"}], "numPDBViolations": 3},
+            },
+        })
+        assert args.pod.name == "p"
+        assert args.node_victims["n1"].victim_uids() == ["u1"]
+        assert args.node_victims["n1"].num_pdb_violations == 3
+
+        args = ExtenderPreemptionArgs.from_json({
+            "pod": make_pod("p", hbm=8),
+            "nodeNameToVictims": {
+                "n1": {"pods": [make_pod("v", hbm=4, uid="u-v")]},
+            },
+        })
+        assert args.node_victims["n1"].victim_uids() == ["u-v"]
+
+    def test_result_is_meta_form(self):
+        result = ExtenderPreemptionResult(
+            node_victims={"n1": ["u1"]}, pdb_violations={"n1": 2})
+        assert result.to_json() == {
+            "NodeNameToMetaVictims": {
+                "n1": {"Pods": [{"UID": "u1"}], "NumPDBViolations": 2},
+            }
+        }
+
+
+class TestVictimSelection:
+    def _saturated_node(self, api):
+        """v5e node (4 x 16 GiB) with: chip0 = two 8-GiB slices,
+        chip1 = one 12-GiB slice, chips 2/3 = whole 16-GiB trainers."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "a", "n1", [0], 8)
+        _resident(cache, "b", "n1", [0], 8)
+        _resident(cache, "c", "n1", [1], 12)
+        _resident(cache, "d", "n1", [2], 16)
+        _resident(cache, "e", "n1", [3], 16)
+        return cache, handler
+
+    def test_cheapest_plan_wins(self, api):
+        """16-GiB preemptor: chip1 frees 16 by evicting ONE 12-GiB pod
+        (4 already free) — cheaper than two slices or a 16-GiB trainer."""
+        _, handler = self._saturated_node(api)
+        result = handler.handle(_args(
+            make_pod("hi", hbm=16, priority=100), {"n1": []}))
+        assert result.node_victims == {"n1": ["uid-c"]}
+
+    def test_priority_respected_and_node_dropped(self, api):
+        """Protected residents are never victims; when nothing legal
+        frees enough, the node disappears from the candidate map."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "sys", "n1", [0], 16, priority=1000)
+        _resident(cache, "lo", "n1", [1], 16, priority=50)
+        _resident(cache, "lo2", "n1", [2], 16, priority=50)
+        _resident(cache, "lo3", "n1", [3], 16, priority=50)
+        result = handler.handle(_args(
+            make_pod("mid", hbm=16, priority=100), {"n1": []}))
+        assert result.node_victims["n1"] in (
+            ["uid-lo"], ["uid-lo2"], ["uid-lo3"])
+
+        result = handler.handle(_args(
+            make_pod("peer", hbm=16, priority=50), {"n1": []}))
+        assert result.node_victims == {}  # equal priority: no victims
+
+    def test_fits_without_eviction(self, api):
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "a", "n1", [0], 8)
+        result = handler.handle(_args(
+            make_pod("p", hbm=8, priority=10), {"n1": []}))
+        assert result.node_victims == {"n1": []}
+
+    def test_chip_preemptor_uses_free_chips_first(self, api):
+        """2-chip preemptor on a node with 2 free chips: no evictions."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "a", "n1", [0], 8)
+        _resident(cache, "b", "n1", [1], 8)
+        result = handler.handle(_args(
+            make_pod("p", chips=2, priority=10), {"n1": []}))
+        assert result.node_victims == {"n1": []}
+
+    def test_chip_preemptor_clears_cheapest_chips(self, api):
+        """3-chip preemptor, 2 free chips: clear the chip with ONE
+        resident, not the one with two; chips pinned by protected pods
+        are not clearable."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "one", "n1", [0], 8, priority=0)
+        _resident(cache, "x", "n1", [1], 4, priority=0)
+        _resident(cache, "y", "n1", [1], 4, priority=0)
+        result = handler.handle(_args(
+            make_pod("p", chips=3, priority=100), {"n1": []}))
+        assert result.node_victims == {"n1": ["uid-one"]}
+
+        # Protect chip0's resident: now chip1 (two victims) is the only
+        # clearable occupied chip.
+        api2 = FakeApiServer()
+        api2.create_node(make_node("n1"))
+        cache2, handler2 = _stack(api2)
+        _resident(cache2, "one", "n1", [0], 8, priority=1000)
+        _resident(cache2, "x", "n1", [1], 4, priority=0)
+        _resident(cache2, "y", "n1", [1], 4, priority=0)
+        result = handler2.handle(_args(
+            make_pod("p", chips=3, priority=100), {"n1": []}))
+        assert sorted(result.node_victims["n1"]) == ["uid-x", "uid-y"]
+
+    def test_shared_victim_beats_per_chip_costing(self, api):
+        """One 2-chip victim clearing BOTH needed chips is cheaper than
+        two lone slices on separate chips — per-chip independent costing
+        would wrongly evict the two slices."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "lone0", "n1", [0], 4, priority=0)
+        _resident(cache, "lone1", "n1", [1], 4, priority=0)
+        _resident(cache, "big", "n1", [2, 3], 32, priority=0)
+        result = handler.handle(_args(
+            make_pod("p", chips=2, priority=100), {"n1": []}))
+        assert result.node_victims == {"n1": ["uid-big"]}
+
+    def test_multichip_victim_named_once(self, api):
+        """A 2-chip resident pins both chips; evicting it is ONE victim
+        in the response, not one per chip."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "big", "n1", [0, 1], 32, priority=0)
+        _resident(cache, "c2", "n1", [2], 16, priority=1000)
+        _resident(cache, "c3", "n1", [3], 16, priority=1000)
+        result = handler.handle(_args(
+            make_pod("p", chips=2, priority=100), {"n1": []}))
+        assert result.node_victims == {"n1": ["uid-big"]}
+
+    def test_scheduler_suggested_victims_preferred(self, api):
+        """Two equal-cost plans: reuse the victim the scheduler already
+        nominated for its own resources (smaller total blast radius)."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "a", "n1", [0], 16)
+        _resident(cache, "b", "n1", [1], 16)
+        _resident(cache, "c", "n1", [2], 16)
+        _resident(cache, "d", "n1", [3], 16)
+        result = handler.handle(_args(
+            make_pod("p", hbm=16, priority=100), {"n1": ["uid-c"]}))
+        assert result.node_victims == {"n1": ["uid-c"]}
+
+    def test_gang_member_avoided_at_equal_cost(self, api):
+        """Evicting one gang member strands the whole gang's
+        reservations; a lone pod of equal cost is the better victim."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "gangm", "n1", [0], 16,
+                  annotations={const.ANN_POD_GROUP: "g1",
+                               const.ANN_POD_GROUP_MIN: "2"})
+        _resident(cache, "lone", "n1", [1], 16)
+        _resident(cache, "c2", "n1", [2], 16, priority=1000)
+        _resident(cache, "c3", "n1", [3], 16, priority=1000)
+        result = handler.handle(_args(
+            make_pod("p", hbm=16, priority=100), {"n1": []}))
+        assert result.node_victims == {"n1": ["uid-lone"]}
+
+    def test_lowest_priority_dominates_victim_count(self, api):
+        """Upstream k8s semantics: two priority-0 slices are evicted
+        before one priority-5 pod, even though that means more victims —
+        highest victim priority is minimized before victim count."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "A", "n1", [0], 4, priority=0)
+        _resident(cache, "B", "n1", [0], 4, priority=0)
+        _resident(cache, "C", "n1", [0], 8, priority=5)
+        _resident(cache, "c1", "n1", [1], 16, priority=1000)
+        _resident(cache, "c2", "n1", [2], 16, priority=1000)
+        _resident(cache, "c3", "n1", [3], 16, priority=1000)
+        result = handler.handle(_args(
+            make_pod("p", hbm=8, priority=100), {"n1": []}))
+        assert sorted(result.node_victims["n1"]) == ["uid-A", "uid-B"]
+
+    def test_union_with_scheduler_nominations(self, api):
+        """The scheduler REPLACES its victim map with this response, so
+        victims it nominated for its own resources (CPU/memory) must
+        survive — even when TPU needs no evictions at all."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "a", "n1", [0], 8)
+        result = handler.handle(_args(
+            make_pod("p", hbm=8, priority=10), {"n1": ["uid-cpu-victim"]}))
+        assert result.node_victims == {"n1": ["uid-cpu-victim"]}
+
+    def test_reprieve_spares_unneeded_victims(self, api):
+        """Greedy picks the lowest-priority pod first, but once a later
+        bigger victim covers the need the small one must be reprieved:
+        evicting B (12 GiB) alone suffices, A (4 GiB) is spared."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "A", "n1", [0], 4, priority=0)
+        _resident(cache, "B", "n1", [0], 12, priority=5)
+        _resident(cache, "c1", "n1", [1], 16, priority=1000)
+        _resident(cache, "c2", "n1", [2], 16, priority=1000)
+        _resident(cache, "c3", "n1", [3], 16, priority=1000)
+        result = handler.handle(_args(
+            make_pod("p", hbm=12, priority=100), {"n1": []}))
+        assert result.node_victims == {"n1": ["uid-B"]}
+
+    def test_non_tpu_pod_passthrough(self, api):
+        """Preemption for non-TPU resources is not ours to veto: echo the
+        scheduler's own victim map."""
+        api.create_node(make_node("n1"))
+        _, handler = _stack(api)
+        result = handler.handle(_args(make_pod("plain"),
+                                      {"n1": ["u1", "u2"], "n2": []}))
+        assert result.node_victims == {"n1": ["u1", "u2"], "n2": []}
+
+    def test_unknown_node_dropped(self, api):
+        _, handler = _stack(api)
+        result = handler.handle(_args(
+            make_pod("p", hbm=8, priority=10), {"ghost": []}))
+        assert result.node_victims == {}
+
+
+class TestPreemptHTTP:
+    def test_route_golden_json(self, api):
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "low", "n1", [0], 16, priority=0)
+        _resident(cache, "l2", "n1", [1], 16, priority=0)
+        _resident(cache, "l3", "n1", [2], 16, priority=0)
+        _resident(cache, "l4", "n1", [3], 16, priority=0)
+        server = ExtenderHTTPServer(
+            ("127.0.0.1", 0), None, None, None, preempt=handler)
+        serve_forever(server)
+        try:
+            host, port = server.server_address[:2]
+            body = json.dumps({
+                "Pod": make_pod("hi", hbm=16, priority=100),
+                "NodeNameToMetaVictims": {
+                    "n1": {"Pods": [{"UID": "uid-l2"}]}},
+            }).encode()
+            req = urllib.request.Request(
+                f"http://{host}:{port}/tpushare-scheduler/preempt",
+                data=body, headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                doc = json.loads(resp.read())
+            assert doc == {"NodeNameToMetaVictims": {
+                "n1": {"Pods": [{"UID": "uid-l2"}],
+                       "NumPDBViolations": 0}}}
+        finally:
+            server.shutdown()
+
+    def test_route_unconfigured_404(self, api):
+        server = ExtenderHTTPServer(("127.0.0.1", 0), None, None, None)
+        serve_forever(server)
+        try:
+            host, port = server.server_address[:2]
+            req = urllib.request.Request(
+                f"http://{host}:{port}/tpushare-scheduler/preempt",
+                data=b"{}", headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError("expected HTTP 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            server.shutdown()

@@ -38,10 +38,10 @@ def main() -> None:
             f"{args.tpu_type}-{i}", chips=args.chips, hbm_per_chip=args.hbm,
             topology=args.topology, tpu_type=args.tpu_type))
 
-    controller, pred, prio, binder, inspect = build_stack(api)
+    controller, pred, prio, binder, inspect, preempt = build_stack(api)
     controller.start(workers=2)
     server = ExtenderHTTPServer(("127.0.0.1", args.port), pred, binder,
-                                inspect, prioritize=prio)
+                                inspect, prioritize=prio, preempt=preempt)
     serve_forever(server)
     print(f"extender listening on http://127.0.0.1:{args.port} with "
           f"{args.nodes} simulated {args.tpu_type} nodes "

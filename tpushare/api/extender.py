@@ -113,3 +113,82 @@ class ExtenderBindingResult:
 
     def to_json(self) -> dict:
         return {"Error": self.error}
+
+
+@dataclass
+class Victims:
+    """One node's proposed eviction set.
+
+    Two wire forms exist (``schedulerapi.Victims`` with full pod objects
+    vs ``MetaVictims`` with bare UIDs); which one the scheduler sends
+    depends on ``nodeCacheCapable`` — exactly the dual-form situation the
+    filter path already handles for NodeNames/Nodes."""
+
+    pods: list[Pod] = field(default_factory=list)
+    uids: list[str] = field(default_factory=list)
+    num_pdb_violations: int = 0
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "Victims":
+        # The legacy Policy-era types marshal capitalized keys (no json
+        # tags); the modern k8s.io/kube-scheduler/extender/v1 types are
+        # camelCase ("pods"/"uid"/"numPDBViolations"). Accept both.
+        pods = [Pod(p) for p in doc.get("Pods", doc.get("pods")) or []
+                if isinstance(p, dict)]
+        # MetaVictims form: Pods is a list of {"UID": "..."} — a full
+        # v1.Pod carries its uid under metadata, never top-level, so a
+        # top-level UID/uid key identifies a MetaPod unambiguously.
+        uids = [p.raw.get("UID", p.raw.get("uid")) for p in pods
+                if "UID" in p.raw or "uid" in p.raw]
+        pods = [p for p in pods if "UID" not in p.raw and "uid" not in p.raw]
+        return cls(pods=pods, uids=uids,
+                   num_pdb_violations=int(
+                       doc.get("NumPDBViolations",
+                               doc.get("numPDBViolations", 0))))
+
+    def victim_uids(self) -> list[str]:
+        return self.uids + [p.uid for p in self.pods if p.uid]
+
+
+@dataclass
+class ExtenderPreemptionArgs:
+    """Arguments of ``POST .../preempt`` (``schedulerapi.
+    ExtenderPreemptionArgs``): the preemptor pod plus the scheduler's
+    per-node candidate victim map, in whichever of the two forms matches
+    the ``nodeCacheCapable`` setting."""
+
+    pod: Pod
+    node_victims: dict[str, Victims] = field(default_factory=dict)
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "ExtenderPreemptionArgs":
+        pod = Pod(doc.get("Pod") or doc.get("pod") or {})
+        raw = (doc.get("NodeNameToMetaVictims")
+               or doc.get("nodeNameToMetaVictims")
+               or doc.get("NodeNameToVictims")
+               or doc.get("nodeNameToVictims") or {})
+        victims = {name: Victims.from_json(v or {})
+                   for name, v in raw.items()}
+        return cls(pod=pod, node_victims=victims)
+
+
+@dataclass
+class ExtenderPreemptionResult:
+    """Result of ``POST .../preempt``: surviving candidate nodes mapped to
+    the victims *this extender's* resources require. Always the
+    MetaVictims (UID) form on the wire — the scheduler resolves UIDs
+    against its own snapshot."""
+
+    node_victims: dict[str, list[str]] = field(default_factory=dict)
+    pdb_violations: dict[str, int] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "NodeNameToMetaVictims": {
+                name: {
+                    "Pods": [{"UID": uid} for uid in uids],
+                    "NumPDBViolations": self.pdb_violations.get(name, 0),
+                }
+                for name, uids in self.node_victims.items()
+            }
+        }

@@ -136,6 +136,16 @@ class Pod(K8sObject):
     def containers(self) -> list[dict]:
         return self.spec.get("containers") or []
 
+    @property
+    def priority(self) -> int:
+        """``spec.priority`` as resolved by the priority admission plugin;
+        0 when unset (the cluster default)."""
+        val = self.spec.get("priority")
+        try:
+            return int(val) if val is not None else 0
+        except (TypeError, ValueError):
+            return 0
+
     def iter_resource_limits(self, resource: str) -> Iterator[int]:
         """Yield the parsed limit of ``resource`` for each container."""
         for c in self.containers:

@@ -68,5 +68,14 @@ class ChipInfo:
         with self._lock:
             return list(self.pods.values())
 
+    def snapshot_contributions(self) -> list[tuple[Pod, int]]:
+        """(pod, GiB pinned on this chip) for every resident pod, as the
+        ledger priced them — the preemption planner's view of what each
+        eviction would free (a multi-chip pod frees this chip's full
+        capacity, an HBM slice frees its granted GiB)."""
+        with self._lock:
+            return [(p, self._contrib.get(uid, 0))
+                    for uid, p in self.pods.items()]
+
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return f"ChipInfo(idx={self.idx}, hbm={self.get_used_hbm()}/{self.total_hbm})"

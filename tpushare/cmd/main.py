@@ -23,6 +23,7 @@ import os
 import signal
 import sys
 import threading
+from typing import NamedTuple
 
 from tpushare.controller.controller import Controller
 from tpushare.gang.planner import GangPlanner
@@ -32,6 +33,7 @@ from tpushare.routes.server import (ExtenderHTTPServer, enable_tls,
 from tpushare.scheduler.bind import Bind
 from tpushare.scheduler.inspect import Inspect
 from tpushare.scheduler.predicate import Predicate
+from tpushare.scheduler.preempt import Preempt
 from tpushare.scheduler.prioritize import Prioritize
 
 log = logging.getLogger(__name__)
@@ -49,9 +51,20 @@ def setup_signals(stop_event: threading.Event) -> None:
     signal.signal(signal.SIGTERM, handler)
 
 
-def build_stack(client):
-    """Wire controller + handlers over one shared cache; returns
-    (controller, predicate, prioritize, bind, inspect)."""
+class Stack(NamedTuple):
+    """The wired handler set over one shared cache (what the reference
+    assembled inline in ``main``, cmd/main.go:104-117)."""
+
+    controller: object
+    predicate: object
+    prioritize: object
+    binder: object
+    inspect: object
+    preempt: object
+
+
+def build_stack(client) -> Stack:
+    """Wire controller + handlers over one shared cache."""
     controller = Controller(client)
     # Quorum pre-checks enumerate nodes from the informer store — no
     # apiserver LIST on the bind path.
@@ -64,7 +77,8 @@ def build_stack(client):
                   pod_lister=controller.hub.get_pod)
     inspect = Inspect(controller.cache, client.list_nodes,
                       gang_planner=gang)
-    return controller, predicate, prioritize, binder, inspect
+    preempt = Preempt(controller.cache)
+    return Stack(controller, predicate, prioritize, binder, inspect, preempt)
 
 
 def main() -> None:
@@ -77,7 +91,8 @@ def main() -> None:
     workers = int(os.environ.get("WORKERS", "4"))
 
     client = ApiClient(ClusterConfig.auto())
-    controller, predicate, prioritize, binder, inspect = build_stack(client)
+    stack = build_stack(client)
+    controller, predicate, prioritize, binder, inspect, preempt = stack
 
     stop = threading.Event()
     setup_signals(stop)
@@ -86,7 +101,7 @@ def main() -> None:
     debug_routes = os.environ.get("DEBUG_ROUTES", "1").lower() not in (
         "0", "false", "no")
     server = ExtenderHTTPServer(("0.0.0.0", port), predicate, binder, inspect,
-                                prioritize=prioritize,
+                                prioritize=prioritize, preempt=preempt,
                                 debug_routes=debug_routes)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
     if bool(cert) != bool(key):

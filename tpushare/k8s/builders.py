@@ -14,7 +14,7 @@ from tpushare.utils import const
 def make_pod(name: str, hbm: int = 0, chips: int = 0,
              namespace: str = "default", node_name: str = "",
              annotations: dict | None = None, phase: str = "Pending",
-             uid: str | None = None,
+             uid: str | None = None, priority: int | None = None,
              container_hbm: list[int] | None = None) -> dict:
     """``container_hbm`` builds a multi-container pod (one container per
     entry); otherwise a single container carries the whole request."""
@@ -42,6 +42,8 @@ def make_pod(name: str, hbm: int = 0, chips: int = 0,
         doc["metadata"]["uid"] = uid
     if node_name:
         doc["spec"]["nodeName"] = node_name
+    if priority is not None:
+        doc["spec"]["priority"] = priority
     return doc
 
 

@@ -33,6 +33,11 @@ PRIORITIZE_LATENCY = Histogram(
     "Latency of extender prioritize requests",
     registry=REGISTRY, buckets=_BUCKETS,
 )
+PREEMPT_LATENCY = Histogram(
+    "tpushare_preempt_latency_seconds",
+    "Latency of extender preempt requests",
+    registry=REGISTRY, buckets=_BUCKETS,
+)
 BIND_LATENCY = Histogram(
     "tpushare_bind_latency_seconds",
     "Latency of extender bind requests",

@@ -33,6 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import tpushare
 from tpushare.api.extender import (ExtenderArgs, ExtenderBindingArgs,
+                                   ExtenderPreemptionArgs,
                                    host_priority_list_to_json)
 from tpushare.routes import metrics, pprof
 
@@ -47,11 +48,12 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, addr, predicate, binder, inspect,
                  prefix: str = DEFAULT_PREFIX, prioritize=None,
-                 debug_routes: bool = True):
+                 preempt=None, debug_routes: bool = True):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
         self.prioritize = prioritize
+        self.preempt = preempt
         self.prefix = prefix
         #: /debug/* shares the NodePort with the scheduling webhook; the
         #: CPU profiler and tracemalloc tax the hot path, so operators
@@ -179,6 +181,17 @@ class _Handler(BaseHTTPRequestHandler):
                         ExtenderArgs.from_json(doc))
                 # HostPriorityList is a bare JSON array on the wire.
                 self._send_json(host_priority_list_to_json(entries))
+            elif path == f"{prefix}/preempt":
+                doc = self._read_json()
+                if doc is None:
+                    return
+                if self.server.preempt is None:
+                    self._send_json({"Error": "preempt not configured"}, 404)
+                    return
+                with metrics.PREEMPT_LATENCY.time():
+                    result = self.server.preempt.handle(
+                        ExtenderPreemptionArgs.from_json(doc))
+                self._send_json(result.to_json())
             elif path == f"{prefix}/bind":
                 doc = self._read_json()
                 if doc is None:

@@ -1,0 +1,258 @@
+"""Preempt handler: minimal-cost victim selection on the chip ledger.
+
+The k8s scheduler-extender protocol has a fourth verb the reference never
+implemented — ``preemptVerb`` (its vendored wire types stop at bind,
+``vendor/k8s.io/kubernetes/pkg/scheduler/api/types.go:258-302``). Without
+it, a high-priority pod that cannot fit is stuck behind the extender's
+extended resources forever: the default preemption logic only understands
+resources the scheduler itself accounts, so it can evict for CPU and
+memory but never to free TPU HBM or whole chips. On a saturated fleet
+(exactly the adversarial-bench regime, where ~100 multi-chip pods sit
+blocked) that turns priority classes into a no-op for TPU jobs.
+
+Protocol (``schedulerapi.ExtenderPreemptionArgs/Result``): when no node
+passes filtering, the scheduler computes a per-node candidate victim set
+from *its* resource view and POSTs it here. This handler re-plans each
+node against the chip ledger and answers with the victims *TPU* resources
+require; nodes where no legal eviction set frees enough capacity are
+dropped from the map. The scheduler intersects, picks a node, and evicts.
+
+Victim-selection policy (TPU-first):
+
+* Only pods with ``spec.priority`` strictly below the preemptor's are
+  evictable — the scheduler enforces this too, but the ledger must not
+  propose victims the scheduler would reject.
+* HBM preemptors need one chip with enough contiguous-after-eviction
+  free HBM: chips are planned independently and the cheapest plan wins.
+  Cost order follows upstream k8s preemption: lowest victim priority
+  dominates (two priority-0 slices die before one priority-5 trainer),
+  then the tie-breaks in ``_plan_cost`` ending with least HBM destroyed
+  (evict one 12-GiB slice from a chip with 4 GiB already free rather
+  than a whole 16-GiB trainer).
+* Whole-chip preemptors need N fully-free chips: per-chip eviction plans
+  are costed the same way and the N cheapest feasible chips are taken,
+  so already-free chips are used before anything is evicted.
+* Victims the scheduler already nominated (for its own resources) are
+  preferred at equal cost — those pods are being evicted anyway, so
+  reusing them keeps the total blast radius minimal.
+* Gang members are avoided at equal cost: evicting one member strands
+  the rest of the gang's reservations until TTL rollback, so a lone pod
+  of the same priority is always the cheaper real-world victim.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpushare.api.extender import (ExtenderPreemptionArgs,
+                                   ExtenderPreemptionResult)
+from tpushare.api.objects import Pod
+from tpushare.cache.cache import SchedulerCache
+from tpushare.cache.nodeinfo import NodeInfo
+from tpushare.utils import pod as podutils
+
+log = logging.getLogger(__name__)
+
+
+class Preempt:
+    name = "tpushare-preempt"
+
+    def __init__(self, cache: SchedulerCache):
+        self.cache = cache
+
+    # ------------------------------------------------------------------ #
+    # Per-chip planning
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _evictable(pod: Pod, preemptor: Pod) -> bool:
+        if podutils.is_complete_pod(pod):
+            return False  # already free; never a victim
+        return pod.priority < preemptor.priority
+
+    @staticmethod
+    def _victim_order(pod: Pod, contrib: int, preferred: set[str]):
+        """Sort key: lowest priority first (same criteria order as
+        ``_plan_cost``); among equals prefer non-gang pods, then pods the
+        scheduler already nominated, then the largest contribution
+        (fewest victims to reach the target)."""
+        return (pod.priority,
+                1 if podutils.is_gang_pod(pod) else 0,
+                0 if pod.uid in preferred else 1,
+                -contrib)
+
+    def _plan_chip_hbm(self, chip, need: int, preemptor: Pod,
+                       preferred: set[str]) -> list[tuple[Pod, int]] | None:
+        """Cheapest victim set on one chip that frees ≥ ``need`` GiB
+        beyond what is already free; None when even evicting every legal
+        victim falls short. ``need <= 0`` means the chip already fits."""
+        if need <= 0:
+            return []
+        candidates = [(p, c) for p, c in chip.snapshot_contributions()
+                      if c > 0 and self._evictable(p, preemptor)]
+        candidates.sort(key=lambda pc: self._victim_order(
+            pc[0], pc[1], preferred))
+        chosen: list[tuple[Pod, int]] = []
+        freed = 0
+        for pod, contrib in candidates:
+            chosen.append((pod, contrib))
+            freed += contrib
+            if freed >= need:
+                break
+        if freed < need:
+            return None
+        # Reprieve pass (k8s preemption does the same): walk the chosen
+        # set from the most-protected victim down and spare anyone whose
+        # contribution is no longer needed — the greedy can overshoot
+        # when a later, bigger victim covers an earlier small one.
+        for entry in sorted(chosen, key=lambda pc: self._victim_order(
+                pc[0], pc[1], preferred), reverse=True):
+            if freed - entry[1] >= need:
+                chosen.remove(entry)
+                freed -= entry[1]
+        return chosen
+
+    # ------------------------------------------------------------------ #
+    # Per-node planning
+    # ------------------------------------------------------------------ #
+
+    def plan_node(self, info: NodeInfo, preemptor: Pod,
+                  preferred: set[str]) -> list[Pod] | None:
+        """Victim pods whose eviction lets ``preemptor`` fit on ``info``;
+        [] when it already fits, None when no legal plan exists."""
+        req_chips = podutils.get_chips_from_pod_resource(preemptor)
+        if req_chips > 0:
+            return self._plan_node_chips(info, req_chips, preemptor,
+                                         preferred)
+        req_hbm = podutils.get_hbm_from_pod_resource(preemptor)
+        if req_hbm <= 0:
+            return None  # not a TPU pod; caller handles pass-through
+        avail = info.get_available_hbm()
+        best: list[tuple[Pod, int]] | None = None
+        for idx, chip in info.chips.items():
+            if chip.total_hbm < req_hbm:
+                continue  # can never fit, even empty
+            plan = self._plan_chip_hbm(chip, req_hbm - avail.get(idx, 0),
+                                       preemptor, preferred)
+            if plan is None:
+                continue
+            if best is None or (self._plan_cost(plan, preferred)
+                                < self._plan_cost(best, preferred)):
+                best = plan
+        return None if best is None else self._dedup([p for p, _ in best])
+
+    def _plan_node_chips(self, info: NodeInfo, req_chips: int,
+                         preemptor: Pod,
+                         preferred: set[str]) -> list[Pod] | None:
+        """The N-chip set whose *distinct-victim union* is cheapest.
+
+        Chips cannot be costed independently: one multi-chip victim can
+        clear several chips at once, so the cheapest pair of chips may
+        share a single victim while per-chip costing would evict two
+        separate pods. Chip counts per host are small (4-8), so the
+        exact search over combinations is affordable; pathological chip
+        counts fall back to greedy marginal-cost selection."""
+        clearable: dict[int, list[tuple[Pod, int]]] = {}
+        for idx, chip in info.chips.items():
+            residents = [(p, c) for p, c in chip.snapshot_contributions()
+                         if not podutils.is_complete_pod(p)]
+            if any(not self._evictable(p, preemptor) for p, _ in residents):
+                continue
+            clearable[idx] = residents
+        if len(clearable) < req_chips:
+            return None
+
+        def union_plan(chip_set) -> list[tuple[Pod, int]]:
+            merged: dict[str, list] = {}
+            for i in chip_set:
+                for p, c in clearable[i]:
+                    if p.uid in merged:
+                        merged[p.uid][1] += c
+                    else:
+                        merged[p.uid] = [p, c]
+            return [(p, c) for p, c in merged.values()]
+
+        import itertools
+        import math
+
+        # comb(16,8)=12870: exact search covers every real host form
+        # factor (up to 16 chips); the greedy is a defensive fallback.
+        if math.comb(len(clearable), req_chips) <= 13000:
+            best = min(
+                (union_plan(combo) for combo in
+                 itertools.combinations(sorted(clearable), req_chips)),
+                key=lambda pl: self._plan_cost(pl, preferred))
+        else:  # pragma: no cover - >16-chip hosts don't exist today
+            chosen: list[int] = []
+            while len(chosen) < req_chips:
+                held = {p.uid for p, _ in union_plan(chosen)}
+                nxt = min(
+                    (i for i in sorted(clearable) if i not in chosen),
+                    key=lambda i: self._plan_cost(
+                        [(p, c) for p, c in clearable[i]
+                         if p.uid not in held], preferred))
+                chosen.append(nxt)
+            best = union_plan(chosen)
+        return self._dedup([p for p, _ in best])
+
+    @staticmethod
+    def _plan_cost(plan: list[tuple[Pod, int]],
+                   preferred: set[str]) -> tuple[int, int, int, int, int]:
+        """Compare eviction plans across chips. Criteria order follows
+        upstream k8s preemption (``pickOneNodeForPreemption``): the
+        highest victim priority is minimized FIRST — disruption lands on
+        the lowest-priority workloads even when that means more victims
+        (two priority-0 slices die before one priority-5 trainer). Then
+        fewest gang members stranded, then fewest victims *beyond* what
+        the scheduler already nominated, then fewest victims, then the
+        least HBM destroyed."""
+        return (max((p.priority for p, _ in plan), default=-1),
+                sum(1 for p, _ in plan if podutils.is_gang_pod(p)),
+                sum(1 for p, _ in plan if p.uid not in preferred),
+                len(plan),
+                sum(c for _, c in plan))
+
+    @staticmethod
+    def _dedup(pods: list[Pod]) -> list[Pod]:
+        """A multi-chip victim shows up once per chip it pins; the
+        eviction set names it once."""
+        seen: set[str] = set()
+        out = []
+        for p in pods:
+            if p.uid not in seen:
+                seen.add(p.uid)
+                out.append(p)
+        return out
+
+    # ------------------------------------------------------------------ #
+
+    def handle(self, args: ExtenderPreemptionArgs) -> ExtenderPreemptionResult:
+        pod = args.pod
+        result = ExtenderPreemptionResult()
+        if not (podutils.is_tpu_sharing_pod(pod)
+                or podutils.is_tpu_chip_pod(pod)):
+            # Not ours: echo the scheduler's own victim map untouched so
+            # preemption for non-TPU resources proceeds normally.
+            for name, victims in args.node_victims.items():
+                result.node_victims[name] = victims.victim_uids()
+                result.pdb_violations[name] = victims.num_pdb_violations
+            return result
+
+        for name, victims in args.node_victims.items():
+            info = self.cache.get_node_info(name)
+            if info is None:
+                continue  # node vanished; drop it from the candidates
+            nominated = victims.victim_uids()
+            plan = self.plan_node(info, pod, set(nominated))
+            if plan is None:
+                continue  # no legal eviction set frees enough TPU capacity
+            # UNION with the scheduler's own nominations: the scheduler
+            # replaces its victim map with this response, so dropping a
+            # CPU/memory victim it needs would livelock the preemptor.
+            ours = [p.uid for p in plan]
+            result.node_victims[name] = ours + [
+                u for u in nominated if u not in set(ours)]
+            result.pdb_violations[name] = victims.num_pdb_violations
+        log.debug("preempt pod %s: %s", pod.key(),
+                  {n: len(v) for n, v in result.node_victims.items()})
+        return result
