@@ -25,10 +25,12 @@ class Cluster:
 
     def __init__(self, api: FakeApiServer):
         self.api = api
-        self.controller, pred, prio, binder, inspect, _ = build_stack(api)
+        self.controller, pred, prio, binder, inspect, preempt = \
+            build_stack(api)
         self.controller.start(workers=2)
         self.server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder,
-                                         inspect, prioritize=prio)
+                                         inspect, prioritize=prio,
+                                         preempt=preempt)
         serve_forever(self.server)
         self.base = f"http://127.0.0.1:{self.server.server_address[1]}"
 
@@ -194,6 +196,46 @@ class TestGangScheduling:
         assert len(info.get_free_chips()) == 4  # freed
         stored = api.get_pod("default", "worker-0")
         assert not podutils.is_assumed(stored)  # annotations stripped
+
+
+class TestPreemptionLoop:
+    def test_priority_pod_preempts_and_schedules(self, api, cluster):
+        """The full preemption round-trip a kube-scheduler drives: filter
+        fails everywhere → preempt names victims → victims evicted →
+        controller frees their HBM → the priority pod schedules."""
+        api.create_node(make_node("v5e-0"))  # 4 chips x 16 GiB
+        for i in range(4):
+            api.create_pod(make_pod(f"low-{i}", hbm=16, priority=0))
+            bound, where = cluster.schedule(
+                make_pod(f"low-{i}", hbm=16, priority=0))
+            assert bound, where
+
+        urgent = make_pod("urgent", hbm=16, priority=1000)
+        api.create_pod(urgent)
+        bound, detail = cluster.schedule(urgent)
+        assert not bound and "v5e-0" in detail  # saturated
+
+        pod = api.get_pod("default", "urgent")
+        status, result = cluster._post("/tpushare-scheduler/preempt", {
+            "Pod": pod.raw,
+            "NodeNameToMetaVictims": {"v5e-0": {"Pods": []}},
+        })
+        assert status == 200, result
+        victims = result["NodeNameToMetaVictims"]["v5e-0"]["Pods"]
+        assert len(victims) == 1  # one 16-GiB eviction suffices
+
+        # kube-scheduler's eviction step: delete the named victim.
+        victim_uid = victims[0]["UID"]
+        victim = next(p for p in api.list_pods() if p.uid == victim_uid)
+        api.delete_pod(victim.namespace, victim.name)
+        assert cluster.controller.wait_idle(timeout=5)
+
+        bound, where = cluster.schedule(urgent)
+        assert bound, where
+        assert where == "v5e-0"
+        # the freed chip was reused: still exactly 4 slices resident
+        doc = cluster.inspect("v5e-0")
+        assert doc["nodes"][0]["usedHBM"] == 64
 
 
 class TestCrashRestart:

@@ -240,6 +240,19 @@ class TestVictimSelection:
             make_pod("p", hbm=16, priority=100), {"n1": []}))
         assert result.node_victims == {"n1": ["uid-lone"]}
 
+    def test_victims_priced_at_full_footprint(self, api):
+        """A 2-chip trainer evicted to free ONE chip still destroys both
+        chips' HBM — the tie-break must prefer the lone 16-GiB slice over
+        the 32-GiB trainer even though both free 16 GiB on their chip."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        _resident(cache, "M", "n1", [0, 1], 32, priority=0)
+        _resident(cache, "S", "n1", [2], 16, priority=0)
+        _resident(cache, "hi", "n1", [3], 16, priority=1000)
+        result = handler.handle(_args(
+            make_pod("p", hbm=16, priority=100), {"n1": []}))
+        assert result.node_victims == {"n1": ["uid-S"]}
+
     def test_lowest_priority_dominates_victim_count(self, api):
         """Upstream k8s semantics: two priority-0 slices are evicted
         before one priority-5 pod, even though that means more victims —

@@ -1,0 +1,141 @@
+"""Randomized churn soak: the ledger must never drift or oversubscribe.
+
+A seeded random op stream (mixed-size HBM slices, whole-chip pods,
+completions, deletions, node flaps) drives the real handler stack, and
+every 50 ops the ledger is audited against two independent sources of
+truth:
+
+* re-pricing: each chip's O(1) incremental ``used`` must equal a from-
+  scratch recompute over its resident pods' annotations (the reference
+  recomputed per query, deviceinfo.go:41-54 — our incremental ledger
+  must never diverge from what that recompute would say);
+* rebuild: a brand-new SchedulerCache built only from apiserver state
+  (the crash-restart path, reference cache.go:49-74) must agree chip by
+  chip with the live incrementally-maintained cache.
+
+Plus the safety invariants the whole system exists to enforce: no chip
+over capacity, and a whole-chip grant is never co-resident with anything
+else. Gang pods are excluded — reservations live in the planner, not in
+pod annotations, so the rebuild comparison would be vacuously unequal;
+gang lifecycle has its own suite (tests/test_gang_lifecycle.py).
+"""
+
+import random
+
+from tests.conftest import make_node, make_pod
+from tpushare.api.extender import ExtenderArgs, ExtenderBindingArgs
+from tpushare.cache.cache import SchedulerCache
+from tpushare.cmd.main import build_stack
+from tpushare.utils import pod as podutils
+
+
+def _audit(cache, api):
+    """Assert every ledger invariant; returns chips audited."""
+    fresh = SchedulerCache(api.get_node, api.list_pods)
+    fresh.build()
+    audited = 0
+    for info in cache.get_node_infos():
+        fresh_info = fresh.get_node_info(info.name)
+        for idx, chip in info.chips.items():
+            used = chip.get_used_hbm()
+            assert 0 <= used <= chip.total_hbm, (
+                f"{info.name}/chip{idx} oversubscribed: "
+                f"{used}/{chip.total_hbm}")
+            # Independent re-pricing from the resident pods' annotations.
+            recomputed = 0
+            whole, others = 0, 0
+            for p in chip.snapshot_pods():
+                if podutils.is_complete_pod(p):
+                    continue
+                if len(podutils.get_chip_ids_from_annotation(p)) > 1:
+                    recomputed += chip.total_hbm
+                    whole += 1
+                else:
+                    recomputed += podutils.pod_used_hbm(p)
+                    if podutils.get_chips_from_pod_resource(p) > 0:
+                        whole += 1
+                    else:
+                        others += 1
+            assert used == recomputed, (
+                f"{info.name}/chip{idx} incremental {used} != "
+                f"recomputed {recomputed}")
+            if whole:
+                assert whole == 1 and others == 0, (
+                    f"{info.name}/chip{idx}: whole-chip grant co-resident "
+                    f"with {whole - 1} chips + {others} slices")
+            # Crash-restart rebuild agrees with the live cache.
+            assert fresh_info is not None, f"{info.name} missing on rebuild"
+            assert fresh_info.chips[idx].get_used_hbm() == used, (
+                f"{info.name}/chip{idx} rebuild "
+                f"{fresh_info.chips[idx].get_used_hbm()} != live {used}")
+            audited += 1
+    return audited
+
+
+def test_randomized_churn_soak(api):
+    rng = random.Random(0xC0FFEE)
+    for i in range(6):
+        api.create_node(make_node(f"n{i}", chips=4, hbm_per_chip=16,
+                                  topology="2x2x1"))
+    controller, pred, prio, binder, inspect, _ = build_stack(api)
+    controller.start(workers=4)
+    bound: list[str] = []
+    seq = 0
+    audits = 0
+    def one_op():
+        nonlocal seq
+        op = rng.random()
+        if op < 0.55 or not bound:
+            # -- arrival + one scheduling attempt --------------------- #
+            if rng.random() < 0.7:
+                doc = make_pod(f"p{seq}", hbm=rng.choice([2, 4, 8, 12, 16]))
+            else:
+                doc = make_pod(f"p{seq}", chips=rng.choice([1, 2, 4]))
+            seq += 1
+            pod = api.create_pod(doc)
+            names = [n.name for n in api.list_nodes()]
+            rng.shuffle(names)
+            res = pred.handle(ExtenderArgs.from_json(
+                {"Pod": pod.raw, "NodeNames": names}))
+            cands = res.node_names or []
+            if not cands:
+                api.delete_pod(pod.namespace, pod.name)
+                return
+            ranked = prio.handle(ExtenderArgs.from_json(
+                {"Pod": pod.raw, "NodeNames": cands}))
+            best = max(ranked, key=lambda e: e.score).host
+            r = binder.handle(ExtenderBindingArgs(
+                pod_name=pod.name, pod_namespace=pod.namespace,
+                pod_uid=pod.uid, node=best))
+            if not r.error:
+                bound.append(pod.name)
+        elif op < 0.80:
+            # -- completion frees HBM --------------------------------- #
+            name = bound.pop(rng.randrange(len(bound)))
+            api.update_pod_status("default", name, "Succeeded")
+        elif op < 0.95:
+            # -- deletion frees HBM ----------------------------------- #
+            name = bound.pop(rng.randrange(len(bound)))
+            api.delete_pod("default", name)
+        else:
+            # -- node flap: delete + re-register ---------------------- #
+            node = rng.choice(api.list_nodes())
+            name, raw = node.name, dict(node.raw)
+            api.delete_node(name)
+            assert controller.wait_idle(timeout=10)
+            raw.setdefault("metadata", {}).pop("resourceVersion", None)
+            api.create_node(raw)
+
+    try:
+        for step in range(400):
+            one_op()
+            if step % 50 == 49:
+                assert controller.wait_idle(timeout=10)
+                assert _audit(cache=controller.cache, api=api) > 0
+                audits += 1
+    finally:
+        binder.gang_planner.stop()
+        controller.stop()
+    assert audits >= 8
+    # The stream must have actually exercised the interesting regimes.
+    assert seq > 150 and len(bound) > 0

@@ -165,14 +165,20 @@ class Controller:
             t.join(timeout=2)
 
     def wait_idle(self, timeout: float = 5.0) -> bool:
-        """Test helper: block until the queue drains."""
+        """Test helper: block until the cache has converged on the
+        apiserver state — every delivered watch event dispatched (the
+        informer pipe can hold events the workqueue has not seen yet)
+        AND the workqueue drained. Ordering matters: dispatch enqueues
+        work, so quiesced-then-empty observed in that order is a stable
+        state as long as the caller has stopped mutating the apiserver."""
         import time
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            with self.queue._cond:
-                busy = (len(self.queue._queue) + len(self.queue._delayed)
-                        + len(self.queue._processing))
-            if busy == 0:
-                return True
+            if self.hub.quiesced():
+                with self.queue._cond:
+                    busy = (len(self.queue._queue) + len(self.queue._delayed)
+                            + len(self.queue._processing))
+                if busy == 0:
+                    return True
             time.sleep(0.01)
         return False

@@ -109,24 +109,40 @@ class InformerHub:
         while not self._stop.is_set():
             item = self._watch_queue.get()
             if item is None:
+                self._watch_queue.task_done()  # shutdown sentinel
                 break
-            kind, event_type, raw = item
-            wrapper = _WRAPPERS.get(kind)
-            if wrapper is None:
-                continue
-            store = self.pods if kind == "Pod" else self.nodes
-            if event_type == "RELIST":
-                # Watch stream reconnected: diff the fresh LIST against the
-                # store and synthesize the events missed during the gap.
-                self._handle_relist(kind, store, [wrapper(r) for r in raw])
-                continue
-            obj = wrapper(raw)
-            old = store.get(Store.key_of(obj))
-            if event_type == "DELETED":
-                store.delete(obj)
-            else:
-                store.upsert(obj)
-            self._dispatch(kind, event_type, old, obj)
+            try:
+                kind, event_type, raw = item
+                wrapper = _WRAPPERS.get(kind)
+                if wrapper is None:
+                    continue
+                store = self.pods if kind == "Pod" else self.nodes
+                if event_type == "RELIST":
+                    # Watch stream reconnected: diff the fresh LIST against
+                    # the store and synthesize the events missed in the gap.
+                    self._handle_relist(kind, store,
+                                        [wrapper(r) for r in raw])
+                    continue
+                obj = wrapper(raw)
+                old = store.get(Store.key_of(obj))
+                if event_type == "DELETED":
+                    store.delete(obj)
+                else:
+                    store.upsert(obj)
+                self._dispatch(kind, event_type, old, obj)
+            finally:
+                # task_done AFTER dispatch: quiesced() must mean "every
+                # delivered event's handlers have run", not merely "the
+                # pipe is empty" — handlers enqueue workqueue items that
+                # Controller.wait_idle checks next.
+                self._watch_queue.task_done()
+
+    def quiesced(self) -> bool:
+        """True when every watch event delivered so far has been fully
+        dispatched (put() increments unfinished_tasks; _run marks each
+        done only after its handlers returned)."""
+        q = self._watch_queue
+        return q is None or q.unfinished_tasks == 0
 
     def _handle_relist(self, kind: str, store: Store, objs: list) -> None:
         fresh = {Store.key_of(o): o for o in objs}

@@ -205,12 +205,16 @@ class Preempt:
         (two priority-0 slices die before one priority-5 trainer). Then
         fewest gang members stranded, then fewest victims *beyond* what
         the scheduler already nominated, then fewest victims, then the
-        least HBM destroyed."""
+        least HBM destroyed — each victim priced at its FULL granted
+        footprint, not just its share on the chips under consideration
+        (a 2-chip trainer destroyed to free one chip still costs both
+        chips' HBM)."""
         return (max((p.priority for p, _ in plan), default=-1),
                 sum(1 for p, _ in plan if podutils.is_gang_pod(p)),
                 sum(1 for p, _ in plan if p.uid not in preferred),
                 len(plan),
-                sum(c for _, c in plan))
+                sum(podutils.get_hbm_from_pod_annotation(p) or c
+                    for p, c in plan))
 
     @staticmethod
     def _dedup(pods: list[Pod]) -> list[Pod]:
