@@ -86,29 +86,52 @@ def _draw_shape(rng) -> tuple[str, int]:
     return SIZE_MIX[-1][0], SIZE_MIX[-1][1]
 
 
+
+class _Fleet:
+    """A v5p fleet behind the real HTTP stack (fake apiserver +
+    controller + extender server + keep-alive client) — the setup every
+    bench phase shares, kept in ONE place so stack-wiring changes cannot
+    silently diverge between phases."""
+
+    def __init__(self, prefix: str, nodes: int):
+        from tpushare.cmd.main import build_stack
+        from tpushare.k8s.builders import make_node
+        from tpushare.k8s.fake import FakeApiServer
+        from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+
+        self.api = FakeApiServer()
+        self.names = [f"{prefix}-{i:02d}" for i in range(nodes)]
+        for n in self.names:
+            self.api.create_node(make_node(n, chips=CHIPS,
+                                           hbm_per_chip=CHIP_HBM,
+                                           topology="2x2x1",
+                                           tpu_type="v5p"))
+        self.stack = build_stack(self.api)
+        self.stack.controller.start(workers=4)
+        self.server = ExtenderHTTPServer(
+            ("127.0.0.1", 0), self.stack.predicate, self.stack.binder,
+            self.stack.inspect, prioritize=self.stack.prioritize,
+            preempt=self.stack.preempt)
+        serve_forever(self.server)
+        host, port = self.server.server_address[:2]
+        self.base = f"http://{host}:{port}"
+        self.client = ExtenderClient(host, port)
+
+    def close(self):
+        self.client.close()
+        self.server.shutdown()
+        self.stack.binder.gang_planner.stop()
+        self.stack.controller.stop()
+
 def run_churn(scored: bool, seed: int = 42):
     """One full churn simulation; returns (mean steady-state util %,
     latencies ms, pods bound)."""
-    from tpushare.cmd.main import build_stack
-    from tpushare.k8s.builders import make_node, make_pod
-    from tpushare.k8s.fake import FakeApiServer
-    from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+    from tpushare.k8s.builders import make_pod
 
     rng = random.Random(seed)
-    api = FakeApiServer()
-    for i in range(NODES):
-        api.create_node(make_node(f"v5p-{i:02d}", chips=CHIPS,
-                                  hbm_per_chip=CHIP_HBM,
-                                  topology="2x2x1", tpu_type="v5p"))
-    controller, pred, prio, binder, inspect, _ = build_stack(api)
-    controller.start(workers=4)
-    server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect,
-                                prioritize=prio)
-    serve_forever(server)
-    host, port = server.server_address[:2]
-    base = f"http://{host}:{port}"
-    client = ExtenderClient(host, port)
-    node_names = [f"v5p-{i:02d}" for i in range(NODES)]
+    fleet = _Fleet("v5p", NODES)
+    api, client, base = fleet.api, fleet.client, fleet.base
+    controller, node_names = fleet.stack.controller, fleet.names
 
     backlog: list[dict] = []     # {name, size, ttl, pod}
     live: list[dict] = []        # {name, node, size, expires}
@@ -193,10 +216,7 @@ def run_churn(scored: bool, seed: int = 42):
 
     large_bound = sum(1 for rec in live if rec["kind"] == "chip")
     large_blocked = sum(1 for item in backlog if item["kind"] == "chip")
-    client.close()
-    server.shutdown()
-    binder.gang_planner.stop()
-    controller.stop()
+    fleet.close()
     return (statistics.mean(samples), latencies, bound,
             large_bound, large_blocked)
 
@@ -205,25 +225,11 @@ def bench_gang(hosts: int = 16) -> tuple[float, int]:
     """BASELINE config #5: schedule a whole-slice gang (one 4-chip worker
     per v5p host) and time from first member seen to ALL members bound —
     the end-to-end all-or-nothing commit latency."""
-    from tpushare.cmd.main import build_stack
-    from tpushare.k8s.builders import make_node, make_pod
-    from tpushare.k8s.fake import FakeApiServer
-    from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+    from tpushare.k8s.builders import make_pod
     from tpushare.utils import const
 
-    api = FakeApiServer()
-    for i in range(hosts):
-        api.create_node(make_node(f"gang-{i:02d}", chips=CHIPS,
-                                  hbm_per_chip=CHIP_HBM,
-                                  topology="2x2x1", tpu_type="v5p"))
-    controller, pred, prio, binder, inspect, _ = build_stack(api)
-    controller.start(workers=4)
-    server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect,
-                                prioritize=prio)
-    serve_forever(server)
-    host, port = server.server_address[:2]
-    client = ExtenderClient(host, port)
-    names = [f"gang-{i:02d}" for i in range(hosts)]
+    fleet = _Fleet("gang", hosts)
+    api, client, names = fleet.api, fleet.client, fleet.names
     ann = {const.ANN_POD_GROUP: "slice",
            const.ANN_POD_GROUP_MIN: str(hosts)}
 
@@ -250,11 +256,57 @@ def bench_gang(hosts: int = 16) -> tuple[float, int]:
     placed = {api.get_pod("default", f"w-{i:02d}").node_name
               for i in range(hosts)}
     assert len(placed) == hosts, f"gang spread over {len(placed)} hosts"
-    client.close()
-    server.shutdown()
-    binder.gang_planner.stop()
-    controller.stop()
+    fleet.close()
     return dt, hosts
+
+
+def bench_preempt(nodes: int = 8) -> float:
+    """Time for a priority pod to displace capacity and place on a fully
+    saturated fleet, end to end over the wire: filter (fails everywhere)
+    -> preempt (extender names victims from the chip ledger) -> eviction
+    (what kube-scheduler's preemption does) -> re-filter -> bind. Without
+    the preempt verb this pod waits forever — default preemption cannot
+    free extender-managed resources."""
+    from tpushare.k8s.builders import make_pod
+
+    fleet = _Fleet("pre", nodes)
+    api, client, names = fleet.api, fleet.client, fleet.names
+    for i in range(nodes * CHIPS):   # saturate every chip
+        pod = api.create_pod(make_pod(f"filler-{i:03d}", hbm=CHIP_HBM))
+        _, result = client.post("/tpushare-scheduler/filter",
+                                {"Pod": pod.raw, "NodeNames": names})
+        client.post("/tpushare-scheduler/bind", {
+            "PodName": pod.name, "PodNamespace": "default",
+            "PodUID": pod.uid, "Node": result["NodeNames"][0]})
+
+    urgent = api.create_pod(make_pod("urgent", hbm=CHIP_HBM, priority=1000))
+    t0 = time.perf_counter()
+    status, result = client.post("/tpushare-scheduler/filter",
+                                 {"Pod": urgent.raw, "NodeNames": names})
+    assert status == 200 and not result["NodeNames"], "fleet not saturated"
+    status, plan = client.post("/tpushare-scheduler/preempt", {
+        "Pod": urgent.raw,
+        "NodeNameToMetaVictims": {n: {"Pods": []} for n in names}})
+    assert status == 200, plan
+    node, victims = min(plan["NodeNameToMetaVictims"].items(),
+                        key=lambda kv: len(kv[1]["Pods"]))
+    for v in victims["Pods"]:
+        victim = next(p for p in api.list_pods() if p.uid == v["UID"])
+        api.delete_pod(victim.namespace, victim.name)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        status, result = client.post("/tpushare-scheduler/filter",
+                                     {"Pod": urgent.raw, "NodeNames": [node]})
+        if result["NodeNames"]:
+            break
+        time.sleep(0.001)
+    status, bound = client.post("/tpushare-scheduler/bind", {
+        "PodName": "urgent", "PodNamespace": "default",
+        "PodUID": urgent.uid, "Node": node})
+    dt = (time.perf_counter() - t0) * 1000.0
+    assert status == 200, bound
+    fleet.close()
+    return dt
 
 
 def main() -> None:
@@ -272,6 +324,7 @@ def main() -> None:
     scored_util, latencies, bound, s_large, s_blocked = run_churn(scored=True)
     unscored_util, _, _, u_large, u_blocked = run_churn(scored=False)
     gang_ms, gang_hosts = bench_gang()
+    preempt_ms = bench_preempt()
 
     latencies.sort()
     p50 = statistics.median(latencies)
@@ -293,6 +346,7 @@ def main() -> None:
         "nodes": NODES,
         "gang_hosts": gang_hosts,
         "gang_commit_ms": round(gang_ms, 1),
+        "preempt_place_ms": round(preempt_ms, 1),
     }))
 
 

@@ -30,11 +30,23 @@ from tpushare.utils import pod as podutils
 
 
 def _audit(cache, api):
-    """Assert every ledger invariant; returns chips audited."""
+    """Assert every ledger invariant; returns chips audited.
+
+    Iterates nodes from the APISERVER (not the live cache) so a node
+    dropped by a flap and not yet re-touched by any filter call is still
+    audited — get_node_info() is exactly the lazy re-registration path
+    the flap is meant to exercise. The live cache must also not hold
+    ledgers the apiserver no longer knows."""
     fresh = SchedulerCache(api.get_node, api.list_pods)
     fresh.build()
+    api_names = {n.name for n in api.list_nodes()}
+    live_names = {info.name for info in cache.get_node_infos()}
+    assert live_names <= api_names, (
+        f"zombie ledgers for deleted nodes: {live_names - api_names}")
     audited = 0
-    for info in cache.get_node_infos():
+    for node_name in sorted(api_names):
+        info = cache.get_node_info(node_name)
+        assert info is not None, f"{node_name} unknown to the live cache"
         fresh_info = fresh.get_node_info(info.name)
         for idx, chip in info.chips.items():
             used = chip.get_used_hbm()
