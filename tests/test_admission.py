@@ -1,0 +1,136 @@
+"""Validating admission webhook: fleet-geometry checks + AdmissionReview
+wire protocol (no reference counterpart — its oversize pod just pended,
+``docs/designs/designs.md:36``)."""
+
+import json
+import urllib.request
+
+from tests.conftest import make_node, make_pod
+from tpushare.api.objects import Pod
+from tpushare.cache.cache import SchedulerCache
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+from tpushare.scheduler.admission import Admission
+from tpushare.utils import const
+
+
+def _admission(api: FakeApiServer) -> Admission:
+    cache = SchedulerCache(api.get_node, api.list_pods)
+    return Admission(cache, node_lister=api.list_nodes)
+
+
+class TestValidate:
+    def test_non_tpu_pod_allowed(self, api, v5e_node):
+        ok, _ = _admission(api).validate(Pod(make_pod("p")))
+        assert ok
+
+    def test_fitting_requests_allowed(self, api, v5e_node):
+        adm = _admission(api)
+        assert adm.validate(Pod(make_pod("p", hbm=16)))[0]
+        assert adm.validate(Pod(make_pod("p", chips=4)))[0]
+
+    def test_oversize_hbm_rejected_with_fleet_limits(self, api, v5e_node):
+        """The samples/4.yaml foot-gun: fits no chip, caught at CREATE."""
+        ok, reason = _admission(api).validate(Pod(make_pod("p", hbm=17)))
+        assert not ok
+        assert "17" in reason and "16" in reason  # request + fleet limit
+
+    def test_aggregate_hbm_must_fit_a_chip(self, api, v5e_node):
+        """The allocator places a pod's SUMMED HBM on one chip (containers
+        share that chip's grant), so two 9-GiB containers (18 total) can
+        never schedule on 16-GiB chips even though each fits alone."""
+        adm = _admission(api)
+        assert adm.validate(Pod(make_pod("p", container_hbm=[8, 8])))[0]
+        ok, reason = adm.validate(Pod(make_pod("p", container_hbm=[9, 9])))
+        assert not ok and "18" in reason and "single chip" in reason
+        ok, reason = adm.validate(Pod(make_pod("p", container_hbm=[17])))
+        assert not ok
+
+    def test_oversize_chip_count_rejected(self, api, v5e_node):
+        ok, reason = _admission(api).validate(Pod(make_pod("p", chips=5)))
+        assert not ok
+        assert "gang" in reason  # points at the multi-host alternative
+
+    def test_both_resources_rejected(self, api, v5e_node):
+        ok, reason = _admission(api).validate(
+            Pod(make_pod("p", hbm=8, chips=1)))
+        assert not ok and "mutually exclusive" in reason
+
+    def test_malformed_gang_rejected(self, api, v5e_node):
+        adm = _admission(api)
+        for ann in ({const.ANN_POD_GROUP: "g"},                      # no min
+                    {const.ANN_POD_GROUP: "g",
+                     const.ANN_POD_GROUP_MIN: "zero"},               # NaN
+                    {const.ANN_POD_GROUP: "g",
+                     const.ANN_POD_GROUP_MIN: "0"},                  # < 1
+                    {const.ANN_POD_GROUP: ""}):                      # empty
+            ok, reason = adm.validate(
+                Pod(make_pod("p", hbm=8, annotations=ann)))
+            assert not ok, ann
+
+        ok, _ = adm.validate(Pod(make_pod(
+            "p", hbm=8, annotations={const.ANN_POD_GROUP: "g",
+                                     const.ANN_POD_GROUP_MIN: "2"})))
+        assert ok
+
+    def test_unknown_fleet_fails_open(self, api):
+        """No TPU nodes known: allow (failurePolicy Ignore semantics —
+        this webhook must never block a cluster that is scaling up)."""
+        ok, _ = _admission(api).validate(Pod(make_pod("p", hbm=10_000)))
+        assert ok
+
+    def test_transient_capacity_not_rejected(self, api, v5e_node):
+        """A full fleet is the scheduler/preemptor's problem, not
+        admission's: geometry fits => allowed even when 0 GiB is free."""
+        adm = _admission(api)
+        cache = adm.cache
+        from tpushare.utils import pod as podutils
+        for i in range(4):
+            pod = Pod(make_pod(f"f{i}", hbm=16, node_name="v5e-node-0",
+                               uid=f"u{i}"))
+            pod = podutils.updated_pod_annotation_spec(pod, [i], 16, 16)
+            cache.add_or_update_pod(pod)
+        assert adm.validate(Pod(make_pod("p", hbm=16)))[0]
+
+
+class TestAdmissionReviewWire:
+    def _review(self, pod_doc):
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {"uid": "rev-1", "object": pod_doc},
+        }
+
+    def test_http_reject_golden(self, api, v5e_node):
+        server = ExtenderHTTPServer(("127.0.0.1", 0), None, None, None,
+                                    admission=_admission(api))
+        serve_forever(server)
+        try:
+            host, port = server.server_address[:2]
+            req = urllib.request.Request(
+                f"http://{host}:{port}/tpushare-scheduler/validate",
+                data=json.dumps(self._review(make_pod("p", hbm=99))).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                doc = json.loads(resp.read())
+            assert doc["kind"] == "AdmissionReview"
+            assert doc["response"]["uid"] == "rev-1"
+            assert doc["response"]["allowed"] is False
+            assert doc["response"]["status"]["code"] == 422
+
+            req = urllib.request.Request(
+                f"http://{host}:{port}/tpushare-scheduler/validate",
+                data=json.dumps(self._review(make_pod("p", hbm=8))).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                doc = json.loads(resp.read())
+            assert doc["response"] == {"uid": "rev-1", "allowed": True}
+        finally:
+            server.shutdown()
+
+    def test_malformed_review_fails_open(self, api, v5e_node):
+        adm = _admission(api)
+        out = adm.handle({"request": None})
+        assert out["response"]["allowed"] is True
+        out = adm.handle({})
+        assert out["response"]["allowed"] is True

@@ -233,7 +233,10 @@ class TestFullStackOverWire:
 
         server.seed_node(make_node("v5e-0", chips=2, hbm_per_chip=16))
         c = client_for(server)
-        controller, pred, prio, binder, inspect, _ = build_stack(c)
+        stack = build_stack(c)
+        controller, pred, prio, binder, inspect = (
+            stack.controller, stack.predicate, stack.prioritize,
+            stack.binder, stack.inspect)
         controller.start(workers=2)
         try:
             pod = c.create_pod(make_pod("w", hbm=8))

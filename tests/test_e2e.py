@@ -25,12 +25,14 @@ class Cluster:
 
     def __init__(self, api: FakeApiServer):
         self.api = api
-        self.controller, pred, prio, binder, inspect, preempt = \
-            build_stack(api)
+        stack = build_stack(api)
+        self.controller = stack.controller
         self.controller.start(workers=2)
-        self.server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder,
-                                         inspect, prioritize=prio,
-                                         preempt=preempt)
+        self.server = ExtenderHTTPServer(("127.0.0.1", 0), stack.predicate,
+                                         stack.binder, stack.inspect,
+                                         prioritize=stack.prioritize,
+                                         preempt=stack.preempt,
+                                         admission=stack.admission)
         serve_forever(self.server)
         self.base = f"http://127.0.0.1:{self.server.server_address[1]}"
 

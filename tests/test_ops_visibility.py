@@ -89,7 +89,10 @@ class TestHTTPS:
             check=True, capture_output=True)
 
         api.create_node(make_node("v5e-0"))
-        controller, pred, prio, binder, inspect, _ = build_stack(api)
+        stack = build_stack(api)
+        controller, pred, prio, binder, inspect = (
+            stack.controller, stack.predicate, stack.prioritize,
+            stack.binder, stack.inspect)
         controller.start(workers=2)
         server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect,
                                     prioritize=prio)

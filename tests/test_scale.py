@@ -28,7 +28,10 @@ def test_filter_latency_flat_as_cluster_fills():
     for i in range(nodes):
         api.create_node(make_node(f"n-{i:03d}", chips=4, hbm_per_chip=95,
                                   topology="2x2x1", tpu_type="v5p"))
-    controller, pred, prio, binder, inspect, _ = build_stack(api)
+    stack = build_stack(api)
+    controller, pred, prio, binder, inspect = (
+        stack.controller, stack.predicate, stack.prioritize,
+        stack.binder, stack.inspect)
     controller.start(workers=2)
     names = [f"n-{i:03d}" for i in range(nodes)]
     try:
@@ -69,7 +72,10 @@ def test_ledger_incremental_matches_recompute():
 
     api = FakeApiServer()
     api.create_node(make_node("n", chips=4, hbm_per_chip=16))
-    controller, pred, prio, binder, inspect, _ = build_stack(api)
+    stack = build_stack(api)
+    controller, pred, prio, binder, inspect = (
+        stack.controller, stack.predicate, stack.prioritize,
+        stack.binder, stack.inspect)
     controller.start(workers=2)
     try:
         info = controller.cache.get_node_info("n")

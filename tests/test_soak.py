@@ -89,7 +89,10 @@ def test_randomized_churn_soak(api):
     for i in range(6):
         api.create_node(make_node(f"n{i}", chips=4, hbm_per_chip=16,
                                   topology="2x2x1"))
-    controller, pred, prio, binder, inspect, _ = build_stack(api)
+    stack = build_stack(api)
+    controller, pred, prio, binder, inspect = (
+        stack.controller, stack.predicate, stack.prioritize,
+        stack.binder, stack.inspect)
     controller.start(workers=4)
     bound: list[str] = []
     seq = 0

@@ -38,10 +38,14 @@ def main() -> None:
             f"{args.tpu_type}-{i}", chips=args.chips, hbm_per_chip=args.hbm,
             topology=args.topology, tpu_type=args.tpu_type))
 
-    controller, pred, prio, binder, inspect, preempt = build_stack(api)
+    stack = build_stack(api)
+    controller = stack.controller
     controller.start(workers=2)
-    server = ExtenderHTTPServer(("127.0.0.1", args.port), pred, binder,
-                                inspect, prioritize=prio, preempt=preempt)
+    server = ExtenderHTTPServer(("127.0.0.1", args.port), stack.predicate,
+                                stack.binder, stack.inspect,
+                                prioritize=stack.prioritize,
+                                preempt=stack.preempt,
+                                admission=stack.admission)
     serve_forever(server)
     print(f"extender listening on http://127.0.0.1:{args.port} with "
           f"{args.nodes} simulated {args.tpu_type} nodes "

@@ -30,6 +30,7 @@ from tpushare.gang.planner import GangPlanner
 from tpushare.k8s.client import ApiClient, ClusterConfig
 from tpushare.routes.server import (ExtenderHTTPServer, enable_tls,
                                     serve_forever)
+from tpushare.scheduler.admission import Admission
 from tpushare.scheduler.bind import Bind
 from tpushare.scheduler.inspect import Inspect
 from tpushare.scheduler.predicate import Predicate
@@ -53,7 +54,9 @@ def setup_signals(stop_event: threading.Event) -> None:
 
 class Stack(NamedTuple):
     """The wired handler set over one shared cache (what the reference
-    assembled inline in ``main``, cmd/main.go:104-117)."""
+    assembled inline in ``main``, cmd/main.go:104-117). Access by
+    attribute — positional unpacking breaks every call site when a
+    handler is added."""
 
     controller: object
     predicate: object
@@ -61,6 +64,7 @@ class Stack(NamedTuple):
     binder: object
     inspect: object
     preempt: object
+    admission: object
 
 
 def build_stack(client) -> Stack:
@@ -78,7 +82,10 @@ def build_stack(client) -> Stack:
     inspect = Inspect(controller.cache, client.list_nodes,
                       gang_planner=gang)
     preempt = Preempt(controller.cache)
-    return Stack(controller, predicate, prioritize, binder, inspect, preempt)
+    admission = Admission(controller.cache,
+                          node_lister=controller.hub.nodes.list)
+    return Stack(controller, predicate, prioritize, binder, inspect,
+                 preempt, admission)
 
 
 def main() -> None:
@@ -92,7 +99,7 @@ def main() -> None:
 
     client = ApiClient(ClusterConfig.auto())
     stack = build_stack(client)
-    controller, predicate, prioritize, binder, inspect, preempt = stack
+    controller, binder = stack.controller, stack.binder
 
     stop = threading.Event()
     setup_signals(stop)
@@ -100,8 +107,11 @@ def main() -> None:
     controller.start(workers=workers)
     debug_routes = os.environ.get("DEBUG_ROUTES", "1").lower() not in (
         "0", "false", "no")
-    server = ExtenderHTTPServer(("0.0.0.0", port), predicate, binder, inspect,
-                                prioritize=prioritize, preempt=preempt,
+    server = ExtenderHTTPServer(("0.0.0.0", port), stack.predicate,
+                                stack.binder, stack.inspect,
+                                prioritize=stack.prioritize,
+                                preempt=stack.preempt,
+                                admission=stack.admission,
                                 debug_routes=debug_routes)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
     if bool(cert) != bool(key):

@@ -48,6 +48,11 @@ BIND_ERRORS = Counter(
     "Bind requests that returned an error",
     registry=REGISTRY,
 )
+ADMISSION_REJECTED = Counter(
+    "tpushare_admission_rejected_total",
+    "Pod CREATEs rejected by the validating admission webhook",
+    registry=REGISTRY,
+)
 FILTER_REQUESTS = Counter(
     "tpushare_filter_requests_total",
     "Filter requests served",

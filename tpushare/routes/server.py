@@ -48,12 +48,13 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, addr, predicate, binder, inspect,
                  prefix: str = DEFAULT_PREFIX, prioritize=None,
-                 preempt=None, debug_routes: bool = True):
+                 preempt=None, admission=None, debug_routes: bool = True):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
         self.prioritize = prioritize
         self.preempt = preempt
+        self.admission = admission
         self.prefix = prefix
         #: /debug/* shares the NodePort with the scheduling webhook; the
         #: CPU profiler and tracemalloc tax the hot path, so operators
@@ -192,6 +193,18 @@ class _Handler(BaseHTTPRequestHandler):
                     result = self.server.preempt.handle(
                         ExtenderPreemptionArgs.from_json(doc))
                 self._send_json(result.to_json())
+            elif path == f"{prefix}/validate":
+                doc = self._read_json()
+                if doc is None:
+                    return
+                if self.server.admission is None:
+                    self._send_json({"Error": "admission not configured"},
+                                    404)
+                    return
+                result = self.server.admission.handle(doc)
+                if not result["response"]["allowed"]:
+                    metrics.ADMISSION_REJECTED.inc()
+                self._send_json(result)
             elif path == f"{prefix}/bind":
                 doc = self._read_json()
                 if doc is None:
