@@ -310,6 +310,40 @@ class TestVictimSelection:
             make_pod("p", hbm=8, priority=10), {"ghost": []}))
         assert result.node_victims == {}
 
+    def test_preempt_is_read_only(self, api):
+        """Planning evictions must not touch the ledger: the scheduler
+        may discard the plan (another extender vetoes, the preemptor
+        gets cancelled), so only the actual evictions change state."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)
+        for i in range(4):
+            _resident(cache, f"r{i}", "n1", [i], 16)
+        before = cache.get_node_info("n1").get_available_hbm()
+        handler.handle(_args(
+            make_pod("p", hbm=16, priority=100), {"n1": []}))
+        handler.handle(_args(
+            make_pod("q", chips=2, priority=100), {"n1": []}))
+        assert cache.get_node_info("n1").get_available_hbm() == before
+
+    def test_preempt_scales_to_fleet(self, api):
+        """A 64-node victim map plans in interactive time (the scheduler
+        calls preempt synchronously on its scheduling thread)."""
+        import time as _time
+        cache, handler = _stack(api)
+        for n in range(64):
+            api.create_node(make_node(f"n{n:02d}"))
+            for i in range(4):
+                _resident(cache, f"r{n}-{i}", f"n{n:02d}", [i], 16,
+                          uid=f"uid-{n}-{i}")
+        args = _args(make_pod("p", hbm=16, priority=100),
+                     {f"n{n:02d}": [] for n in range(64)})
+        t0 = _time.perf_counter()
+        result = handler.handle(args)
+        dt = _time.perf_counter() - t0
+        assert len(result.node_victims) == 64
+        assert all(len(v) == 1 for v in result.node_victims.values())
+        assert dt < 1.0, f"preempt over 64 nodes took {dt:.2f}s"
+
 
 class TestPreemptHTTP:
     def test_route_golden_json(self, api):

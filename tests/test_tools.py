@@ -114,6 +114,34 @@ class TestInspectCLI:
         assert cli.main(["--endpoint", "http://127.0.0.1:1"]) == 1
         assert "cannot reach" in capsys.readouterr().err
 
+    def test_whatif_preempt_names_victims(self, api, cluster, capsys):
+        """Operator dry-run: which pods would a priority pod evict?
+        Saturate the node with low-priority slices, then ask."""
+        import kubectl_inspect_tpushare as cli
+
+        for i in range(2):  # the fixture node has 2 chips x 16 GiB
+            api.create_pod(make_pod(f"low-{i}", hbm=16))
+            assert cluster.schedule(make_pod(f"low-{i}", hbm=16))[0]
+        assert cli.main(["--endpoint", cluster.base,
+                         "--whatif-hbm", "16",
+                         "--whatif-priority", "500"]) == 0
+        out = capsys.readouterr().out
+        assert "would evict 1 pod(s): default/low-" in out
+        assert "16 GiB" in out
+
+        # Same ask at priority 0: nothing is evictable.
+        assert cli.main(["--endpoint", cluster.base,
+                         "--whatif-hbm", "16",
+                         "--whatif-priority", "0"]) == 0
+        out = capsys.readouterr().out
+        assert "no node can host it even with preemption" in out
+
+        # The two what-if forms are mutually exclusive, like the real
+        # resources (admission rejects pods carrying both).
+        assert cli.main(["--endpoint", cluster.base, "--whatif-hbm", "8",
+                         "--whatif-chips", "1"]) == 2
+        assert "mutually exclusive" in capsys.readouterr().err
+
 
 def test_debug_routes_can_be_disabled(api):
     """DEBUG_ROUTES=0 (advisor finding: unauthenticated profiling shares

@@ -103,6 +103,70 @@ def render(doc: dict, details: bool = False) -> str:
     return "\n".join(lines)
 
 
+def whatif_preempt(endpoint: str, hbm: int, chips: int, priority: int,
+                   node: str | None) -> str:
+    """Dry-run the preempt verb: which pods would a (hypothetical)
+    priority pod evict, per node? Read-only — the handler only plans."""
+    inspect_doc = fetch(endpoint, node)
+    names = [n["name"] for n in inspect_doc.get("nodes", [])]
+    if not names:
+        return "no TPU-sharing nodes found"
+    limits = {}
+    if chips > 0:
+        limits["tpushare.io/tpu-chip"] = str(chips)
+    else:
+        limits["tpushare.io/tpu-hbm"] = str(hbm)
+    review = {
+        "Pod": {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "whatif", "namespace": "default",
+                         "uid": "whatif"},
+            "spec": {"priority": priority,
+                     "containers": [{"name": "main",
+                                     "resources": {"limits": limits}}]},
+            "status": {"phase": "Pending"},
+        },
+        "NodeNameToMetaVictims": {n: {"Pods": []} for n in names},
+    }
+    req = urllib.request.Request(
+        f"{endpoint}/tpushare-scheduler/preempt",
+        data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        plan = json.loads(resp.read())
+
+    # uid -> pod identity, from the inspect dump
+    by_uid = {}
+    for n in inspect_doc.get("nodes", []):
+        for chip in n.get("chips", []):
+            for pod in chip.get("pods", []):
+                by_uid[pod.get("uid", "")] = (
+                    f"{pod['namespace']}/{pod['name']} "
+                    f"({pod['usedHBM']} GiB)")
+    want = (f"{chips} chip(s)" if chips > 0 else f"{hbm} GiB HBM")
+    lines = [f"What-if: a priority-{priority} pod requesting {want}:"]
+    victims_map = plan.get("NodeNameToMetaVictims", {})
+    if not victims_map:
+        # The preempt response cannot distinguish the two causes, so
+        # name both rather than send the operator chasing the wrong one.
+        lines.append("  no node can host it even with preemption — the "
+                     "request exceeds every node's geometry, or every "
+                     "candidate's victims are protected by equal/higher "
+                     "priority")
+        return "\n".join(lines)
+    for name in sorted(victims_map):
+        uids = [p["UID"] for p in victims_map[name].get("Pods", [])]
+        if not uids:
+            lines.append(f"  {name}: fits now, no eviction needed")
+        else:
+            who = ", ".join(by_uid.get(u, u) for u in uids)
+            lines.append(f"  {name}: would evict {len(uids)} pod(s): {who}")
+    for name in sorted(set(names) - set(victims_map)):
+        lines.append(f"  {name}: cannot help (victims protected or "
+                     "request exceeds its geometry)")
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="kubectl inspect tpushare",
@@ -112,8 +176,27 @@ def main(argv: list[str] | None = None) -> int:
                         help=f"extender base URL (default {DEFAULT_ENDPOINT})")
     parser.add_argument("-d", "--details", action="store_true",
                         help="show per-chip resident pods")
+    parser.add_argument("--whatif-hbm", type=int, metavar="GIB",
+                        help="dry-run preemption for a pod requesting "
+                             "GIB of HBM (pairs with --whatif-priority)")
+    parser.add_argument("--whatif-chips", type=int, metavar="N",
+                        help="dry-run preemption for a pod requesting "
+                             "N whole chips")
+    parser.add_argument("--whatif-priority", type=int, default=1000,
+                        metavar="P", help="priority of the hypothetical "
+                                          "pod (default 1000)")
     args = parser.parse_args(argv)
+    if args.whatif_hbm and args.whatif_chips:
+        print("--whatif-hbm and --whatif-chips are mutually exclusive "
+              "(a pod requests an HBM slice OR whole chips, not both)",
+              file=sys.stderr)
+        return 2
     try:
+        if args.whatif_hbm or args.whatif_chips:
+            print(whatif_preempt(args.endpoint, args.whatif_hbm or 0,
+                                 args.whatif_chips or 0,
+                                 args.whatif_priority, args.node))
+            return 0
         doc = fetch(args.endpoint, args.node)
     except (urllib.error.URLError, OSError) as e:
         print(f"cannot reach tpushare extender at {args.endpoint}: {e}",

@@ -32,6 +32,7 @@ import logging
 from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
 from tpushare.utils import const
+from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
@@ -51,23 +52,27 @@ class Admission:
     # ------------------------------------------------------------------ #
 
     def _fleet_shape(self) -> tuple[int, int, int]:
-        """(largest single chip GiB, most chips on one node, nodes seen)."""
+        """(largest single chip GiB, most chips on one node, nodes seen).
+
+        Reads chip capacities straight off the lister's node documents —
+        NOT through ``cache.get_node_info`` — so a CREATE on a
+        5000-node cluster costs one in-memory list walk, never builds
+        ledgers for non-TPU nodes, and never inflates metrics/inspect
+        with 0-chip entries."""
         max_chip, max_chips, nodes = 0, 0, 0
-        infos = []
         if self.node_lister is not None:
-            for node in self.node_lister():
-                info = self.cache.get_node_info(node.name)
-                if info is not None:
-                    infos.append(info)
+            node_docs = self.node_lister()
+            cap_lists = [nodeutils.get_chip_capacities(n)
+                         for n in node_docs]
         else:
-            infos = self.cache.get_node_infos()
-        for info in infos:
-            if info.chip_count == 0:
+            cap_lists = [[c.total_hbm for c in info.chips.values()]
+                         for info in self.cache.get_node_infos()]
+        for caps in cap_lists:
+            if not caps:
                 continue
             nodes += 1
-            max_chip = max(max_chip,
-                           max(c.total_hbm for c in info.chips.values()))
-            max_chips = max(max_chips, info.chip_count)
+            max_chip = max(max_chip, max(caps))
+            max_chips = max(max_chips, len(caps))
         return max_chip, max_chips, nodes
 
     # ------------------------------------------------------------------ #

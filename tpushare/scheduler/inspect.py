@@ -39,6 +39,9 @@ class Inspect:
                 pods.append({
                     "name": p.name,
                     "namespace": p.namespace,
+                    # uid lets operator tooling (the what-if preempt CLI)
+                    # join inspect output with preempt victim UIDs
+                    "uid": p.uid,
                     "usedHBM": podutils.pod_used_hbm(p),
                     "chipIds": podutils.get_chip_ids_from_annotation(p),
                 })
