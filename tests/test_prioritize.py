@@ -99,6 +99,57 @@ class TestGangConsolidation:
         assert s["a"] > s["b"]
 
 
+class TestSliceAffinity:
+    def test_gang_chip_member_prefers_member_slice(self, api):
+        """A whole-host gang worker scores higher on a host of the slice
+        already holding a reserved member: those hosts share ICI, other
+        slices are a DCN hop away."""
+        for name, sid in (("s1-a", "slice-1"), ("s1-b", "slice-1"),
+                          ("s2-a", "slice-2")):
+            api.create_node(make_node(name, chips=4, hbm_per_chip=95,
+                                      topology="2x2x1", tpu_type="v5p",
+                                      slice_id=sid))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60)
+        ann = {const.ANN_POD_GROUP: "train", const.ANN_POD_GROUP_MIN: "3"}
+        w0 = api.create_pod(make_pod("w0", chips=2, annotations=ann))
+        with pytest.raises(GangPending):
+            planner.bind_member(w0, "s1-a")
+
+        prio = Prioritize(cache, gang_planner=planner)
+        w1 = make_pod("w1", chips=2, annotations=ann)
+        s = scores(prio, w1, ["s1-b", "s2-a"])
+        # Identical free hosts; only the slice of the reserved member
+        # differs.
+        assert s["s1-b"] > s["s2-a"]
+
+        # The motivating case — an exact WHOLE-HOST pack — must still
+        # discriminate: the fit score saturates, so the slice bonus
+        # needs reserved headroom (it must not clamp into a tie).
+        w2 = make_pod("w2", chips=4, annotations=ann)
+        s = scores(prio, w2, ["s1-b", "s2-a"])
+        assert s["s1-b"] > s["s2-a"]
+
+    def test_no_affinity_without_slice_ids(self, api):
+        """Hosts without slice metadata score identically — the bonus
+        never fires on unknown locality."""
+        for name in ("x", "y"):
+            api.create_node(make_node(name, chips=4, hbm_per_chip=95,
+                                      topology="2x2x1", tpu_type="v5p"))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60)
+        ann = {const.ANN_POD_GROUP: "g2", const.ANN_POD_GROUP_MIN: "3"}
+        w0 = api.create_pod(make_pod("w0", chips=2, annotations=ann))
+        with pytest.raises(GangPending):
+            planner.bind_member(w0, "x")
+        prio = Prioritize(cache, gang_planner=planner)
+        s = scores(prio, make_pod("w1", chips=2, annotations=ann),
+                   ["y"])
+        s_plain = scores(Prioritize(cache),
+                         make_pod("w2", chips=2), ["y"])
+        assert s["y"] == s_plain["y"]
+
+
 class TestPrioritizeWire:
     def test_http_returns_bare_array(self, api, v5e_node):
         from tests.test_handlers import build_stack

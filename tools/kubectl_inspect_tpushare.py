@@ -186,13 +186,18 @@ def main(argv: list[str] | None = None) -> int:
                         metavar="P", help="priority of the hypothetical "
                                           "pod (default 1000)")
     args = parser.parse_args(argv)
-    if args.whatif_hbm and args.whatif_chips:
+    whatif = (args.whatif_hbm is not None or args.whatif_chips is not None)
+    if args.whatif_hbm is not None and args.whatif_chips is not None:
         print("--whatif-hbm and --whatif-chips are mutually exclusive "
               "(a pod requests an HBM slice OR whole chips, not both)",
               file=sys.stderr)
         return 2
+    if whatif and (args.whatif_hbm or args.whatif_chips or 0) < 1:
+        print("what-if request must be a positive quantity",
+              file=sys.stderr)
+        return 2
     try:
-        if args.whatif_hbm or args.whatif_chips:
+        if whatif:
             print(whatif_preempt(args.endpoint, args.whatif_hbm or 0,
                                  args.whatif_chips or 0,
                                  args.whatif_priority, args.node))

@@ -130,6 +130,13 @@ class TPUSharePlugin:
             ann[const.ANN_NODE_TOPOLOGY] = self.inventory.topology
         if self.inventory.tpu_type:
             ann[const.ANN_NODE_TPU_TYPE] = self.inventory.tpu_type
+        # Multi-host slice membership: on GKE the node-pool label already
+        # identifies the slice (utils/node.get_slice_id falls back to
+        # it); bare-metal deployments set TPUSHARE_SLICE_ID on the
+        # DaemonSet so gang placement can prefer ICI over DCN.
+        slice_id = os.environ.get("TPUSHARE_SLICE_ID", "")
+        if slice_id:
+            ann[const.ANN_NODE_SLICE] = slice_id
         self.client.update_node(node)
 
     # ------------------------------------------------------------------ #

@@ -49,18 +49,22 @@ def make_pod(name: str, hbm: int = 0, chips: int = 0,
 
 def make_node(name: str, chips: int = 4, hbm_per_chip: int = 16,
               topology: str = "2x2x1", tpu_type: str = "v5e",
-              chip_hbm: list[int] | None = None) -> dict:
+              chip_hbm: list[int] | None = None,
+              slice_id: str = "") -> dict:
     caps = chip_hbm if chip_hbm is not None else [hbm_per_chip] * chips
+    annotations = {
+        const.ANN_NODE_CHIP_HBM: ",".join(str(c) for c in caps),
+        const.ANN_NODE_TOPOLOGY: topology,
+        const.ANN_NODE_TPU_TYPE: tpu_type,
+    }
+    if slice_id:
+        annotations[const.ANN_NODE_SLICE] = slice_id
     return {
         "apiVersion": "v1",
         "kind": "Node",
         "metadata": {
             "name": name,
-            "annotations": {
-                const.ANN_NODE_CHIP_HBM: ",".join(str(c) for c in caps),
-                const.ANN_NODE_TOPOLOGY: topology,
-                const.ANN_NODE_TPU_TYPE: tpu_type,
-            },
+            "annotations": annotations,
         },
         "status": {
             "capacity": {

@@ -22,6 +22,12 @@ the registered weight):
 * Gang HBM members — consolidation bonus for nodes already hosting a
   reserved member of the same group: fewer hosts per gang means fewer
   DCN crossings for the job's collectives.
+* Gang whole-chip members — slice-affinity bonus for hosts whose
+  ``tpushare.io/slice-id`` (or GKE node pool) matches a slice already
+  holding a reserved member: hosts of one multi-host slice are joined
+  by ICI, hosts of different slices only by DCN, so keeping a job's
+  workers on one slice keeps its collectives off the datacenter
+  network.
 """
 
 from __future__ import annotations
@@ -30,6 +36,7 @@ import logging
 
 from tpushare.api.extender import ExtenderArgs, HostPriority
 from tpushare.cache.cache import SchedulerCache
+from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
@@ -62,7 +69,8 @@ class Prioritize:
             score += 1  # consolidate gang slices onto fewer hosts
         return max(0, min(MAX_SCORE, score))
 
-    def _score_chips(self, info, req: int) -> int:
+    def _score_chips(self, info, req: int,
+                     member_slices: frozenset[str] = frozenset()) -> int:
         free = info.get_free_chips()
         if len(free) < req or info.chip_count == 0:
             return 0
@@ -79,24 +87,48 @@ class Prioritize:
                 score += 1
         elif chosen:
             score += 2  # single chip is trivially compact
+        # Cap the fit+compactness component below MAX_SCORE so the slice
+        # bonus always has headroom — an exact whole-host pack must still
+        # score higher on the member's slice than off it (the feature's
+        # motivating case; an uncapped 10+2 would clamp back to a tie).
+        score = min(score, MAX_SCORE - 2)
+        if member_slices:
+            # Slice affinity: hosts of one multi-host slice share ICI;
+            # hosts of different slices only share DCN. Steering the
+            # gang's next worker onto a slice that already hosts a
+            # member keeps the job's collectives off the datacenter
+            # network.
+            sid = nodeutils.get_slice_id(info.node)
+            if sid and sid in member_slices:
+                score += 2
         return max(0, min(MAX_SCORE, score))
 
     # ------------------------------------------------------------------ #
+
+    def _slice_of(self, node_name: str) -> str:
+        info = self.cache.get_node_info(node_name)
+        return nodeutils.get_slice_id(info.node) if info is not None else ""
+
+    def _member_slices(self, gang_nodes: set[str]) -> frozenset[str]:
+        """Slices already holding a reserved member of the gang."""
+        return frozenset(s for s in map(self._slice_of, gang_nodes) if s)
 
     def score_node(self, pod, node_name: str, gang_nodes: set[str]) -> int:
         """Convenience single-node entry (tests); ``handle`` inlines the
         request parse across candidates."""
         req_chips = podutils.get_chips_from_pod_resource(pod)
         req_hbm = podutils.get_hbm_from_pod_resource(pod)
-        return self._score_one(node_name, req_chips, req_hbm, gang_nodes)
+        return self._score_one(node_name, req_chips, req_hbm, gang_nodes,
+                               self._member_slices(gang_nodes))
 
     def _score_one(self, node_name: str, req_chips: int, req_hbm: int,
-                   gang_nodes: set[str]) -> int:
+                   gang_nodes: set[str],
+                   member_slices: frozenset[str] = frozenset()) -> int:
         info = self.cache.get_node_info(node_name)
         if info is None:
             return 0
         if req_chips > 0:
-            return self._score_chips(info, req_chips)
+            return self._score_chips(info, req_chips, member_slices)
         if req_hbm <= 0:
             return 0
         return self._score_hbm(info, req_hbm, gang_nodes)
@@ -114,12 +146,16 @@ class Prioritize:
         req_chips = podutils.get_chips_from_pod_resource(pod)
         req_hbm = podutils.get_hbm_from_pod_resource(pod)
         gang_nodes: set[str] = set()
-        if (self.gang_planner is not None and podutils.is_gang_pod(pod)
-                and req_chips <= 0):
+        member_slices: frozenset[str] = frozenset()
+        if self.gang_planner is not None and podutils.is_gang_pod(pod):
             gang_nodes = self.gang_planner.member_nodes(pod)
+            if req_chips > 0 and gang_nodes:
+                # Whole-host workers of a multi-host job: prefer hosts
+                # on a slice already holding a member (ICI over DCN).
+                member_slices = self._member_slices(gang_nodes)
 
         out = [HostPriority(host=n, score=self._score_one(
-                   n, req_chips, req_hbm, gang_nodes))
+                   n, req_chips, req_hbm, gang_nodes, member_slices))
                for n in names]
         log.debug("prioritize pod %s: %s", pod.key(),
                   {e.host: e.score for e in out})

@@ -70,9 +70,18 @@ ANN_NODE_TOPOLOGY = "tpushare.io/topology"
 #: TPU generation label value, e.g. "v5e", "v5p", "v6e".
 ANN_NODE_TPU_TYPE = "tpushare.io/tpu-type"
 
+#: Identifier of the multi-host slice this host belongs to. Hosts of one
+#: slice share ICI; hosts of different slices only share DCN, so gang
+#: placement prefers keeping a job's workers on one slice.
+ANN_NODE_SLICE = "tpushare.io/slice-id"
+
 # GKE well-known labels used as a discovery fallback by the device plugin.
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+#: All hosts of one GKE multi-host TPU slice live in one node pool, so the
+#: node-pool label is the slice-id fallback when the tpushare annotation
+#: is absent.
+GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
 
 # --------------------------------------------------------------------------
 # Gang scheduling (pod groups spanning a multi-host slice).

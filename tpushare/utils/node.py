@@ -65,6 +65,20 @@ def get_topology(node: Node) -> str:
     return node.labels.get(const.GKE_TPU_TOPOLOGY_LABEL, "")
 
 
+def get_slice_id(node: Node) -> str:
+    """Multi-host slice this host belongs to; empty when unknown.
+
+    Hosts of one slice are joined by ICI, hosts of different slices by
+    DCN — the locality distinction SURVEY.md §5 requires the resource
+    model to encode. Reads the tpushare annotation first, then GKE's
+    node-pool label (all hosts of a GKE multi-host slice share a pool).
+    """
+    sid = node.annotations.get(const.ANN_NODE_SLICE, "")
+    if sid:
+        return sid
+    return node.labels.get(const.GKE_NODEPOOL_LABEL, "")
+
+
 def get_tpu_type(node: Node) -> str:
     """TPU generation, e.g. "v5e" / "v5p"; empty when unknown."""
     t = node.annotations.get(const.ANN_NODE_TPU_TYPE, "")
