@@ -76,6 +76,28 @@ class TestChipPodScoring:
                    ["v5e-node-0"])
         assert s["v5e-node-0"] == 0
 
+    def test_compactness_still_discriminates_for_plain_pods(self, api):
+        """A non-gang 2-chip pod must prefer adjacent free chips over a
+        diagonal pair — the slice-affinity headroom cap must not flatten
+        the ICI-compactness bonus for ordinary pods."""
+        api.create_node(make_node("adjacent", chips=4, hbm_per_chip=16))
+        api.create_node(make_node("diagonal", chips=4, hbm_per_chip=16))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        # adjacent: occupy chips 2,3 -> free {0,1} (one ICI hop apart);
+        # diagonal: occupy chips 1,2 -> free {0,3} (two hops on 2x2).
+        from tpushare.api.objects import Pod
+        from tpushare.utils import pod as podutils
+        for node, chip_ids in (("adjacent", [2, 3]), ("diagonal", [1, 2])):
+            for cid in chip_ids:
+                seeded = podutils.updated_pod_annotation_spec(
+                    Pod(make_pod(f"s-{node}-{cid}", hbm=16,
+                                 node_name=node, uid=f"u-{node}-{cid}")),
+                    [cid], 16, 16)
+                cache.add_or_update_pod(seeded)
+        s = scores(Prioritize(cache), make_pod("p", chips=2),
+                   ["adjacent", "diagonal"])
+        assert s["adjacent"] > s["diagonal"]
+
 
 class TestGangConsolidation:
     def test_gang_member_prefers_peer_node(self, api):

@@ -120,6 +120,31 @@ class TestNodeHelpers:
         assert not nodeutils.is_tpu_sharing_node(node)
         assert nodeutils.get_chip_capacities(node) == []
 
+    def test_slice_id_annotation_wins(self):
+        node = Node(make_node("n", slice_id="slice-7"))
+        assert nodeutils.get_slice_id(node) == "slice-7"
+
+    def test_slice_id_gke_fallback_requires_multihost(self):
+        """The node-pool label only counts as a slice id when the GKE
+        topology label proves the pool spans multiple hosts — a pool of
+        independent single-host nodes shares a name but no ICI."""
+        def gke_node(topology, chips):
+            return Node({
+                "metadata": {"name": "g", "labels": {
+                    const.GKE_TPU_TOPOLOGY_LABEL: topology,
+                    const.GKE_NODEPOOL_LABEL: "pool-a",
+                }},
+                "status": {"capacity": {const.CHIP_RESOURCE: str(chips)}},
+            })
+        # 4x4 slice topology over 4-chip hosts: 4 hosts share ICI.
+        assert nodeutils.get_slice_id(gke_node("4x4", 4)) == "pool-a"
+        # 2x2 topology == one host's chips: no cross-host ICI.
+        assert nodeutils.get_slice_id(gke_node("2x2", 4)) == ""
+        # No topology label at all: never infer a slice from the pool.
+        node = Node({"metadata": {"name": "g", "labels": {
+            const.GKE_NODEPOOL_LABEL: "pool-a"}}, "status": {}})
+        assert nodeutils.get_slice_id(node) == ""
+
     def test_gke_label_fallback(self):
         node = Node({
             "metadata": {"name": "gke", "labels": {

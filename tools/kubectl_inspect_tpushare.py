@@ -43,13 +43,18 @@ def render(doc: dict, details: bool = False) -> str:
         return "no TPU-sharing nodes found"
     max_chips = max(len(n.get("chips", [])) for n in nodes)
 
+    with_slices = any(n.get("sliceId") for n in nodes)
     headers = ["NAME", "TYPE", "TOPOLOGY"]
+    if with_slices:
+        headers.append("SLICE")
     headers += [f"CHIP{i}(Used/Total)" for i in range(max_chips)]
     headers += ["HBM GiB(Used/Total)"]
     rows = [headers]
     for n in nodes:
         row = [n.get("name", "?"), n.get("tpuType", "?"),
                n.get("topology", "?")]
+        if with_slices:
+            row.append(n.get("sliceId") or "-")
         chips = n.get("chips", [])
         for i in range(max_chips):
             if i < len(chips):

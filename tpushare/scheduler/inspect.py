@@ -59,6 +59,7 @@ class Inspect:
             "name": info.name,
             "tpuType": nodeutils.get_tpu_type(info.node),
             "topology": nodeutils.get_topology(info.node),
+            "sliceId": nodeutils.get_slice_id(info.node),
             "totalHBM": info.total_hbm,
             "usedHBM": used_total,
             "chips": chips,

@@ -87,12 +87,14 @@ class Prioritize:
                 score += 1
         elif chosen:
             score += 2  # single chip is trivially compact
-        # Cap the fit+compactness component below MAX_SCORE so the slice
-        # bonus always has headroom — an exact whole-host pack must still
-        # score higher on the member's slice than off it (the feature's
-        # motivating case; an uncapped 10+2 would clamp back to a tie).
-        score = min(score, MAX_SCORE - 2)
         if member_slices:
+            # Cap the fit+compactness component below MAX_SCORE so the
+            # slice bonus has headroom — an exact whole-host pack must
+            # still score higher on the member's slice than off it (an
+            # uncapped 10+2 would clamp back to a tie). Only when slice
+            # affinity is in play: for ordinary pods the compactness
+            # bonus must keep discriminating at the top of the scale.
+            score = min(score, MAX_SCORE - 2)
             # Slice affinity: hosts of one multi-host slice share ICI;
             # hosts of different slices only share DCN. Steering the
             # gang's next worker onto a slice that already hosts a

@@ -70,12 +70,26 @@ def get_slice_id(node: Node) -> str:
 
     Hosts of one slice are joined by ICI, hosts of different slices by
     DCN — the locality distinction SURVEY.md §5 requires the resource
-    model to encode. Reads the tpushare annotation first, then GKE's
-    node-pool label (all hosts of a GKE multi-host slice share a pool).
+    model to encode. Reads the tpushare annotation first; the GKE
+    node-pool label is used as a fallback ONLY when the GKE topology
+    label proves the pool is a multi-host slice (slice topology volume
+    exceeds this host's chip count). A pool of independent single-host
+    nodes shares a pool name but no ICI, and must not look like a slice.
     """
     sid = node.annotations.get(const.ANN_NODE_SLICE, "")
     if sid:
         return sid
+    topo = node.labels.get(const.GKE_TPU_TOPOLOGY_LABEL, "")
+    if not topo:
+        return ""
+    try:
+        volume = 1
+        for part in topo.split("x"):
+            volume *= int(part)
+    except ValueError:
+        return ""
+    if volume <= get_chip_count(node):
+        return ""  # single-host pool: no ICI beyond this host
     return node.labels.get(const.GKE_NODEPOOL_LABEL, "")
 
 
