@@ -73,6 +73,17 @@ class TestValidate:
                                      const.ANN_POD_GROUP_MIN: "2"})))
         assert ok
 
+    def test_no_lister_falls_back_to_cache(self, api, v5e_node):
+        """Without a node lister (degraded wiring) the fleet shape comes
+        from ledgers already materialized in the cache."""
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        adm = Admission(cache)  # no node_lister
+        # Nothing materialized yet: fleet unknown -> fail open.
+        assert adm.validate(Pod(make_pod("p", hbm=999)))[0]
+        cache.get_node_info("v5e-node-0")  # materialize the ledger
+        ok, reason = adm.validate(Pod(make_pod("p", hbm=999)))
+        assert not ok and "16" in reason
+
     def test_unknown_fleet_fails_open(self, api):
         """No TPU nodes known: allow (failurePolicy Ignore semantics —
         this webhook must never block a cluster that is scaling up)."""
