@@ -40,6 +40,7 @@ class _Store:
         self.pods: dict[str, dict] = {}    # "ns/name" -> doc
         self.nodes: dict[str, dict] = {}   # name -> doc
         self.events: list[dict] = []       # v1 Events posted
+        self.leases: dict[str, dict] = {}  # "ns/name" -> Lease doc
         #: append-only watch log: (kind, type, doc, rv)
         self.watch_log: list[tuple[str, str, dict, int]] = []
 
@@ -119,6 +120,10 @@ _BIND_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding$")
 _PODS_NS_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods$")
 _EVENTS_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/events$")
 _NODE_RE = re.compile(r"^/api/v1/nodes/([^/]+)$")
+_LEASE_RE = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases/([^/]+)$")
+_LEASES_NS_RE = re.compile(
+    r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases$")
 
 
 def _make_handler(server: MiniApiServer):
@@ -193,6 +198,15 @@ def _make_handler(server: MiniApiServer):
                 else:
                     self._json(doc)
                 return
+            m = _LEASE_RE.match(path)
+            if m:
+                with store.lock:
+                    doc = store.leases.get(f"{m.group(1)}/{m.group(2)}")
+                if doc is None:
+                    self._status_error(404, "NotFound")
+                else:
+                    self._json(doc)
+                return
             self._status_error(404, "NotFound")
 
         def do_POST(self):  # noqa: N802
@@ -240,6 +254,20 @@ def _make_handler(server: MiniApiServer):
                     store.events.append(self._body())
                 self._json({"kind": "Status", "status": "Success"}, 201)
                 return
+            m = _LEASES_NS_RE.match(path)
+            if m:
+                doc = self._body()
+                meta = doc.setdefault("metadata", {})
+                meta.setdefault("namespace", m.group(1))
+                key = f"{meta['namespace']}/{meta['name']}"
+                with store.lock:
+                    if key in store.leases:
+                        self._status_error(409, "AlreadyExists")
+                        return
+                    meta["resourceVersion"] = store.bump()
+                    store.leases[key] = doc
+                self._json(doc, 201)
+                return
             self._status_error(404, "NotFound")
 
         def do_PUT(self):  # noqa: N802
@@ -276,6 +304,23 @@ def _make_handler(server: MiniApiServer):
                         store.bump()
                     store.nodes[m.group(1)] = doc
                     store.record("Node", "MODIFIED", doc)
+                self._json(doc)
+                return
+            m = _LEASE_RE.match(path)
+            if m:
+                key = f"{m.group(1)}/{m.group(2)}"
+                with store.lock:
+                    current = store.leases.get(key)
+                    if current is None:
+                        self._status_error(404, "NotFound")
+                        return
+                    sent_rv = doc.get("metadata", {}).get("resourceVersion")
+                    cur_rv = current["metadata"].get("resourceVersion")
+                    if sent_rv and sent_rv != cur_rv:
+                        self._status_error(409, "Conflict")
+                        return
+                    doc["metadata"]["resourceVersion"] = store.bump()
+                    store.leases[key] = doc
                 self._json(doc)
                 return
             self._status_error(404, "NotFound")

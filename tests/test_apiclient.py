@@ -224,6 +224,55 @@ class TestTlsWire:
             s.close()
 
 
+class TestLeaseWire:
+    def test_lease_crud_and_conflict(self):
+        server = MiniApiServer().start()
+        try:
+            c = client_for(server)
+            assert c.get_lease("kube-system", "l") is None
+            lease = c.create_lease("kube-system", {
+                "metadata": {"name": "l"},
+                "spec": {"holderIdentity": "a"}})
+            stale_rv = lease["metadata"]["resourceVersion"]
+            lease["spec"]["holderIdentity"] = "b"
+            c.update_lease("kube-system", "l", lease)
+            lease["metadata"]["resourceVersion"] = stale_rv
+            with pytest.raises(ConflictError):
+                c.update_lease("kube-system", "l", lease)
+        finally:
+            server.close()
+
+    def test_election_over_the_wire(self):
+        """Two real LeaderElectors through the real HTTP client against
+        the real wire protocol: one leader, failover on stop."""
+        from tpushare.k8s.leader import LeaderElector
+
+        server = MiniApiServer().start()
+        a = LeaderElector(client_for(server), "a",
+                          lease_duration=0.5, renew_period=0.05)
+        b = LeaderElector(client_for(server), "b",
+                          lease_duration=0.5, renew_period=0.05)
+        try:
+            a.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not a.is_leader():
+                time.sleep(0.02)
+            assert a.is_leader()
+            b.start()
+            time.sleep(0.2)
+            assert not b.is_leader()
+            a.stop()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not b.is_leader():
+                time.sleep(0.02)
+            assert b.is_leader()
+        finally:
+            a.stop()
+            b.stop()
+            time.sleep(0.1)  # let elector threads observe stop
+            server.close()
+
+
 class TestFullStackOverWire:
     def test_controller_and_bind_through_real_http(self, server):
         """The ENTIRE control plane — informers, controller, ledger,

@@ -1,0 +1,238 @@
+"""Leader election: Lease semantics, elector lifecycle, HA bind gating.
+
+The reference pinned the extender to one replica (its Deployment) —
+two replicas binding against independent informer-fed ledgers could
+place two pods into the same HBM. These tests pin the election that
+makes multi-replica deployment safe: exactly one leader, follower
+binds rejected with 503, takeover after the leader stops renewing.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tpushare.cmd.main import build_stack
+from tpushare.k8s.errors import ConflictError, NotFoundError
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.k8s.leader import LeaderElector
+from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestLeaseStore:
+    def test_optimistic_concurrency(self, api):
+        lease = api.create_lease("kube-system", {
+            "metadata": {"name": "l"}, "spec": {"holderIdentity": "a"}})
+        stale_rv = lease["metadata"]["resourceVersion"]
+        lease["spec"]["holderIdentity"] = "b"
+        api.update_lease("kube-system", "l", lease)
+        # Second writer with the stale resourceVersion loses — the
+        # property election safety rests on.
+        lease["metadata"]["resourceVersion"] = stale_rv
+        with pytest.raises(ConflictError):
+            api.update_lease("kube-system", "l", lease)
+
+    def test_create_then_get(self, api):
+        assert api.get_lease("kube-system", "x") is None
+        api.create_lease("kube-system", {"metadata": {"name": "x"},
+                                         "spec": {}})
+        assert api.get_lease("kube-system", "x") is not None
+        with pytest.raises(ConflictError):
+            api.create_lease("kube-system", {"metadata": {"name": "x"},
+                                             "spec": {}})
+        with pytest.raises(NotFoundError):
+            api.update_lease("kube-system", "ghost", {"metadata": {}})
+
+
+class TestElector:
+    def test_single_candidate_acquires_and_renews(self, api):
+        e = LeaderElector(api, "a", lease_duration=1.0, renew_period=0.05)
+        e.start()
+        try:
+            assert _wait(e.is_leader)
+            lease = api.get_lease("kube-system", "tpushare-schd-extender")
+            assert lease["spec"]["holderIdentity"] == "a"
+            first_renew = lease["spec"]["renewTime"]
+            assert _wait(lambda: api.get_lease(
+                "kube-system", "tpushare-schd-extender"
+            )["spec"]["renewTime"] != first_renew)
+            assert e.is_leader()  # still leader after renewals
+        finally:
+            e.stop()
+
+    def test_exactly_one_leader(self, api):
+        a = LeaderElector(api, "a", lease_duration=1.0, renew_period=0.05)
+        b = LeaderElector(api, "b", lease_duration=1.0, renew_period=0.05)
+        a.start()
+        assert _wait(a.is_leader)
+        b.start()
+        try:
+            time.sleep(0.3)  # several election ticks
+            assert a.is_leader() and not b.is_leader()
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_failover_after_leader_stops(self, api):
+        a = LeaderElector(api, "a", lease_duration=0.3, renew_period=0.05)
+        b = LeaderElector(api, "b", lease_duration=0.3, renew_period=0.05)
+        a.start()
+        assert _wait(a.is_leader)
+        b.start()
+        a.stop()  # stops renewing; lease expires
+        try:
+            assert _wait(b.is_leader, timeout=5.0)
+            assert not a.is_leader()
+            lease = api.get_lease("kube-system", "tpushare-schd-extender")
+            assert lease["spec"]["holderIdentity"] == "b"
+            assert lease["spec"]["leaseTransitions"] == 1
+        finally:
+            b.stop()
+
+    def test_acquires_lease_with_missing_renew_time(self, api):
+        """A hand-created Lease with a holder but no renewTime must be
+        acquirable — treating it as forever-fresh would deadlock the
+        election with every replica a follower."""
+        api.create_lease("kube-system", {
+            "metadata": {"name": "tpushare-schd-extender"},
+            "spec": {"holderIdentity": "ghost"}})
+        e = LeaderElector(api, "a", lease_duration=1.0, renew_period=0.05)
+        e.start()
+        try:
+            assert _wait(e.is_leader)
+            lease = api.get_lease("kube-system", "tpushare-schd-extender")
+            assert lease["spec"]["holderIdentity"] == "a"
+        finally:
+            e.stop()
+
+    def test_wedged_leader_self_demotes(self, api):
+        """A leader that can no longer reach the apiserver must drop
+        leadership on its own clock before a peer can legitimately take
+        over — the no-two-binders safety argument."""
+        import types
+
+        from tpushare.k8s.errors import ApiError
+
+        e = LeaderElector(api, "a", lease_duration=0.3, renew_period=0.05)
+        e.start()
+        try:
+            assert _wait(e.is_leader)
+
+            def wedged(*args, **kwargs):
+                raise ApiError(500, reason="apiserver unreachable")
+            # Renewals now fail; is_leader must decay on the local clock
+            # even though nothing ever set the flag false explicitly.
+            e.client = types.SimpleNamespace(get_lease=api.get_lease,
+                                             create_lease=api.create_lease,
+                                             update_lease=wedged)
+            assert _wait(lambda: not e.is_leader(), timeout=2.0)
+        finally:
+            e.stop()
+
+
+class TestHABindGating:
+    def _server(self, api, elector):
+        stack = build_stack(api)
+        stack.controller.start(workers=2)
+        server = ExtenderHTTPServer(("127.0.0.1", 0), stack.predicate,
+                                    stack.binder, stack.inspect,
+                                    prioritize=stack.prioritize,
+                                    leader=elector)
+        serve_forever(server)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        return stack, server, base
+
+    @staticmethod
+    def _post(base, path, doc):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_only_leader_binds_followers_503(self, api):
+        api.create_node(make_node("v5e-0"))
+        a = LeaderElector(api, "a", lease_duration=1.0, renew_period=0.05)
+        b = LeaderElector(api, "b", lease_duration=1.0, renew_period=0.05)
+        a.start()
+        assert _wait(a.is_leader)
+        b.start()
+        stack_a, server_a, base_a = self._server(api, a)
+        stack_b, server_b, base_b = self._server(api, b)
+        try:
+            # read path serves on BOTH replicas
+            for base in (base_a, base_b):
+                pod = make_pod("probe", hbm=8)
+                status, result = self._post(
+                    base, "/tpushare-scheduler/filter",
+                    {"Pod": pod, "NodeNames": ["v5e-0"]})
+                assert status == 200 and result["NodeNames"] == ["v5e-0"]
+
+            pod = api.create_pod(make_pod("w", hbm=8))
+            bind = {"PodName": "w", "PodNamespace": "default",
+                    "PodUID": pod.uid, "Node": "v5e-0"}
+            status, result = self._post(
+                base_b, "/tpushare-scheduler/bind", bind)
+            assert status == 503 and "not the leader" in result["Error"]
+            assert api.get_pod("default", "w").node_name == ""
+
+            status, _ = self._post(base_a, "/tpushare-scheduler/bind", bind)
+            assert status == 200
+            assert api.get_pod("default", "w").node_name == "v5e-0"
+
+            with urllib.request.urlopen(f"{base_a}/healthz") as r:
+                assert r.read() == b"ok leader"
+            with urllib.request.urlopen(f"{base_b}/healthz") as r:
+                assert r.read() == b"ok follower"
+        finally:
+            for server, stack in ((server_a, stack_a), (server_b, stack_b)):
+                server.shutdown()
+                stack.binder.gang_planner.stop()
+                stack.controller.stop()
+            a.stop()
+            b.stop()
+
+    def test_failover_enables_standby_binds(self, api):
+        api.create_node(make_node("v5e-0"))
+        # 1s lease: long enough that stack construction under load never
+        # lets it lapse while the leader is healthy, short enough that
+        # failover stays fast in the test.
+        a = LeaderElector(api, "a", lease_duration=1.0, renew_period=0.05)
+        b = LeaderElector(api, "b", lease_duration=1.0, renew_period=0.05)
+        a.start()
+        assert _wait(a.is_leader)
+        stack_b, server_b, base_b = self._server(api, b)
+        b.start()
+        try:
+            pod = api.create_pod(make_pod("w", hbm=8))
+            bind = {"PodName": "w", "PodNamespace": "default",
+                    "PodUID": pod.uid, "Node": "v5e-0"}
+            status, _ = self._post(base_b, "/tpushare-scheduler/bind", bind)
+            assert status == 503  # standby while a leads
+
+            a.stop()  # leader dies
+            assert _wait(b.is_leader, timeout=5.0)
+            status, _ = self._post(base_b, "/tpushare-scheduler/bind", bind)
+            assert status == 200
+            assert api.get_pod("default", "w").node_name == "v5e-0"
+        finally:
+            server_b.shutdown()
+            stack_b.binder.gang_planner.stop()
+            stack_b.controller.stop()
+            a.stop()
+            b.stop()

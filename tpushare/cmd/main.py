@@ -14,6 +14,10 @@ Environment (reference cmd/main.go:23,92-98):
   but the code never read it, SURVEY.md §2 C16)
 * ``DEBUG_ROUTES`` — set 0/false to disable the /debug/pprof suite
   (it shares the webhook NodePort and the profiler taxes the hot path)
+* ``LEADER_ELECT`` — set 1/true to join Lease-based leader election so
+  several replicas can run safely (only the leader binds); pair with
+  ``LEASE_NAMESPACE`` (default kube-system). The reference was pinned
+  to one replica precisely because it had no election.
 """
 
 from __future__ import annotations
@@ -105,6 +109,18 @@ def main() -> None:
     setup_signals(stop)
 
     controller.start(workers=workers)
+    # HA: with LEADER_ELECT on, several replicas may run but only the
+    # Lease holder binds (a follower's eventually-consistent ledger must
+    # not place pods); read verbs serve from every replica.
+    leader = None
+    if os.environ.get("LEADER_ELECT", "").lower() in ("1", "true", "yes"):
+        from tpushare.k8s.leader import LeaderElector
+        identity = os.environ.get("HOSTNAME") or f"pid-{os.getpid()}"
+        leader = LeaderElector(
+            client, identity,
+            namespace=os.environ.get("LEASE_NAMESPACE", "kube-system"))
+        leader.start()
+        log.info("leader election enabled (identity %s)", identity)
     debug_routes = os.environ.get("DEBUG_ROUTES", "1").lower() not in (
         "0", "false", "no")
     server = ExtenderHTTPServer(("0.0.0.0", port), stack.predicate,
@@ -112,6 +128,7 @@ def main() -> None:
                                 prioritize=stack.prioritize,
                                 preempt=stack.preempt,
                                 admission=stack.admission,
+                                leader=leader,
                                 debug_routes=debug_routes)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
     if bool(cert) != bool(key):
@@ -128,6 +145,8 @@ def main() -> None:
     stop.wait()
     log.info("shutting down")
     server.shutdown()
+    if leader is not None:
+        leader.stop()
     binder.gang_planner.stop()
     controller.stop()
 

@@ -250,6 +250,30 @@ class ApiClient:
                            body=e.read().decode(errors="replace")) from None
 
     # ------------------------------------------------------------------ #
+    # Leases (coordination.k8s.io) — leader election for HA replicas
+    # ------------------------------------------------------------------ #
+
+    def get_lease(self, namespace: str, name: str) -> dict | None:
+        try:
+            return self._request(
+                "GET", f"/apis/coordination.k8s.io/v1/namespaces/"
+                       f"{namespace}/leases/{name}")
+        except NotFoundError:
+            return None
+
+    def create_lease(self, namespace: str, raw: dict) -> dict:
+        return self._request(
+            "POST",
+            f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases",
+            body=raw)
+
+    def update_lease(self, namespace: str, name: str, raw: dict) -> dict:
+        return self._request(
+            "PUT", f"/apis/coordination.k8s.io/v1/namespaces/"
+                   f"{namespace}/leases/{name}",
+            body=raw)
+
+    # ------------------------------------------------------------------ #
     # Events (reference controller.go:63-67 event broadcaster)
     # ------------------------------------------------------------------ #
 

@@ -27,6 +27,7 @@ class FakeApiServer:
         self._lock = threading.RLock()
         self._pods: dict[str, dict] = {}   # "ns/name" -> raw pod
         self._nodes: dict[str, dict] = {}  # name -> raw node
+        self._leases: dict[str, dict] = {}  # "ns/name" -> raw lease
         self._rv = itertools.count(1)
         self._watchers: list[queue.Queue] = []
         self._uid = itertools.count(1)
@@ -141,6 +142,46 @@ class FakeApiServer:
             pod.setdefault("spec", {})["nodeName"] = binding["target"]["name"]
             self._bump(pod)
             self._notify("Pod", "MODIFIED", pod)
+
+    # ------------------------------------------------------------------ #
+    # Leases (coordination.k8s.io) — optimistic-concurrency semantics
+    # like pods, the property leader election's safety rests on
+    # ------------------------------------------------------------------ #
+
+    def get_lease(self, namespace: str, name: str) -> dict | None:
+        with self._lock:
+            raw = self._leases.get(f"{namespace}/{name}")
+            return copy.deepcopy(raw) if raw else None
+
+    def create_lease(self, namespace: str, raw: dict) -> dict:
+        with self._lock:
+            lease = copy.deepcopy(raw)
+            meta = lease.setdefault("metadata", {})
+            meta.setdefault("namespace", namespace)
+            key = f"{namespace}/{meta['name']}"
+            if key in self._leases:
+                raise ConflictError(reason=f"lease {key} already exists")
+            self._bump(lease)
+            self._leases[key] = lease
+            return copy.deepcopy(lease)
+
+    def update_lease(self, namespace: str, name: str, raw: dict) -> dict:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            current = self._leases.get(key)
+            if current is None:
+                raise NotFoundError(reason=f"lease {key} not found")
+            cur_rv = current["metadata"].get("resourceVersion")
+            new_rv = raw.get("metadata", {}).get("resourceVersion")
+            if new_rv and new_rv != cur_rv:
+                raise ConflictError(
+                    reason="the object has been modified; please apply "
+                           "your changes to the latest version and try "
+                           "again")
+            updated = copy.deepcopy(raw)
+            self._bump(updated)
+            self._leases[key] = updated
+            return copy.deepcopy(updated)
 
     # ------------------------------------------------------------------ #
     # Events (reference wired an apiserver event recorder,

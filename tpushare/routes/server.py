@@ -48,13 +48,18 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, addr, predicate, binder, inspect,
                  prefix: str = DEFAULT_PREFIX, prioritize=None,
-                 preempt=None, admission=None, debug_routes: bool = True):
+                 preempt=None, admission=None, leader=None,
+                 debug_routes: bool = True):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
         self.prioritize = prioritize
         self.preempt = preempt
         self.admission = admission
+        #: Leader elector (``is_leader() -> bool``) when running as one
+        #: of several HA replicas. Only bind mutates the cluster +
+        #: ledger, so only bind is gated; read verbs serve everywhere.
+        self.leader = leader
         self.prefix = prefix
         #: /debug/* shares the NodePort with the scheduling webhook; the
         #: CPU profiler and tracemalloc tax the hot path, so operators
@@ -74,11 +79,14 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route through logging, not stderr
         log.debug("%s %s", self.address_string(), fmt % args)
 
-    def _send_json(self, doc: dict, status: int = 200) -> None:
+    def _send_json(self, doc: dict, status: int = 200,
+                   extra_headers: dict | None = None) -> None:
         body = json.dumps(doc).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for name, value in (extra_headers or {}).items():
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -117,7 +125,11 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/version":
                 self._send_json({"version": tpushare.__version__})
             elif path == "/healthz":
-                self._send_text(b"ok")
+                role = ""
+                if self.server.leader is not None:
+                    role = (" leader" if self.server.leader.is_leader()
+                            else " follower")
+                self._send_text(f"ok{role}".encode())
             elif path == "/metrics":
                 # Atomic refresh+render of per-node utilization gauges.
                 self._send_text(metrics.scrape(self.server.inspect.cache),
@@ -208,6 +220,14 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == f"{prefix}/bind":
                 doc = self._read_json()
                 if doc is None:
+                    return
+                if (self.server.leader is not None
+                        and not self.server.leader.is_leader()):
+                    # A follower must not bind against its own (possibly
+                    # stale) ledger: 503 makes the scheduler retry, and
+                    # the Service lands the retry on the leader.
+                    self._send_json({"Error": "not the leader"}, 503,
+                                    extra_headers={"Retry-After": "1"})
                     return
                 with metrics.BIND_LATENCY.time():
                     result = self.server.binder.handle(
