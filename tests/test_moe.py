@@ -1,0 +1,67 @@
+"""Ring-MoE expert parallelism: numerics vs the dense reference on the
+virtual 8-device CPU mesh (conftest), forward and gradients."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpushare.workload import moe
+from tpushare.workload.parallel import make_mesh
+
+D, F = 16, 32
+
+
+def _data(n_experts, seq=16, batch=2, seed=0):
+    key = jax.random.PRNGKey(seed)
+    k_p, k_x = jax.random.split(key)
+    params = moe.init_moe_params(k_p, D, F, n_experts)
+    x = jax.random.normal(k_x, (batch, seq, D), jnp.float32)
+    return params, x
+
+
+@pytest.mark.parametrize("n_experts", [8, 16])
+def test_ring_matches_reference(n_experts):
+    params, x = _data(n_experts)
+    want = moe.moe_ffn_reference(params, x)
+
+    mesh = make_mesh(dp=1, tp=1, sp=8)
+    fn = moe.make_ring_moe_fn(mesh, axis_name="sp")
+    with mesh:
+        placed = moe.place_moe_params(params, mesh)
+        got = jax.jit(fn)(placed, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients_match_reference():
+    params, x = _data(n_experts=8)
+
+    def loss_ref(p, x):
+        return jnp.sum(moe.moe_ffn_reference(p, x) ** 2)
+
+    want = jax.grad(loss_ref)(params, x)
+
+    mesh = make_mesh(dp=1, tp=1, sp=8)
+    fn = moe.make_ring_moe_fn(mesh, axis_name="sp")
+
+    def loss_ring(p, x):
+        return jnp.sum(fn(p, x) ** 2)
+
+    with mesh:
+        placed = moe.place_moe_params(params, mesh)
+        got = jax.jit(jax.grad(loss_ring))(placed, x)
+    for name in ("router", "w1", "w2"):
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want[name]),
+            rtol=5e-5, atol=5e-5, err_msg=name)
+
+
+def test_expert_weights_actually_sharded():
+    """The EP memory win: each device holds E/n experts, not E."""
+    params, _ = _data(n_experts=8)
+    mesh = make_mesh(dp=1, tp=1, sp=8)
+    placed = moe.place_moe_params(params, mesh)
+    shard = placed["w1"].addressable_shards[0]
+    assert shard.data.shape == (1, D, F)  # 8 experts / 8 devices
+    assert placed["router"].addressable_shards[0].data.shape == (D, 8)

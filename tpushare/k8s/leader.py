@@ -21,8 +21,12 @@ argument: two candidates racing to acquire produce one 409.
 Liveness guard: ``is_leader()`` is true only while the *local* clock
 confirms a renewal within the lease duration — a leader wedged on
 apiserver I/O demotes itself before a follower can legitimately take
-over, so there is no instant with two binding replicas (clock-skew
-bounded, same argument as client-go's leaderelection package).
+over (clock-skew bounded, same argument as client-go's leaderelection
+package). The residual exposure is a bind WRITE already in flight when
+leadership decays: it can land after a standby has taken over, so the
+apiserver request timeout on the bind path must stay below the lease
+duration — then any write that lands was issued while the lease was
+provably held.
 """
 
 from __future__ import annotations

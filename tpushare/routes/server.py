@@ -221,17 +221,22 @@ class _Handler(BaseHTTPRequestHandler):
                 doc = self._read_json()
                 if doc is None:
                     return
+                args_parsed = ExtenderBindingArgs.from_json(doc)
                 if (self.server.leader is not None
                         and not self.server.leader.is_leader()):
                     # A follower must not bind against its own (possibly
                     # stale) ledger: 503 makes the scheduler retry, and
-                    # the Service lands the retry on the leader.
+                    # the Service lands the retry on the leader. Checked
+                    # at the last moment before the ledger commit; the
+                    # residual window — a write already in flight when
+                    # leadership decays — is bounded by the apiserver
+                    # request timeout (keep it below the lease duration;
+                    # see k8s/leader.py).
                     self._send_json({"Error": "not the leader"}, 503,
                                     extra_headers={"Retry-After": "1"})
                     return
                 with metrics.BIND_LATENCY.time():
-                    result = self.server.binder.handle(
-                        ExtenderBindingArgs.from_json(doc))
+                    result = self.server.binder.handle(args_parsed)
                 if result.error:
                     metrics.BIND_ERRORS.inc()
                 # Reference returns HTTP 500 when bind fails
