@@ -1,0 +1,78 @@
+"""GPipe-style pipeline parallelism: numerics vs sequential reference
+on the virtual CPU mesh (conftest), forward and gradients."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpushare.workload import pipeline as pp
+from tpushare.workload.parallel import make_mesh
+
+D = 16
+
+
+def _stage_fn(params, x):
+    return jax.nn.gelu(x @ params["w"] + params["b"])
+
+
+def _data(n_stages, batch=8, seed=0):
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, n_stages + 1)
+    per_stage = [
+        {"w": jax.random.normal(jax.random.fold_in(k, 0), (D, D),
+                                jnp.float32) * (1.0 / D ** 0.5),
+         "b": jax.random.normal(jax.random.fold_in(k, 1), (D,),
+                                jnp.float32) * 0.01}
+        for k in keys[:-1]
+    ]
+    stacked = pp.stack_stage_params(per_stage)
+    x = jax.random.normal(keys[-1], (batch, D), jnp.float32)
+    return stacked, x
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(4, 4), (4, 8), (2, 4)])
+def test_pipeline_matches_reference(n_stages, n_micro):
+    stacked, x = _data(n_stages)
+    want = pp.pipeline_reference(_stage_fn, stacked, x)
+
+    mesh = make_mesh(dp=1, tp=1, sp=n_stages)
+    fn = pp.make_pipeline_fn(_stage_fn, mesh, axis_name="sp",
+                             n_microbatches=n_micro)
+    with mesh:
+        placed = pp.place_pipeline_params(stacked, mesh, axis_name="sp")
+        got = jax.jit(fn)(placed, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_gradients_match_reference():
+    stacked, x = _data(n_stages=4)
+
+    def loss_ref(p):
+        return jnp.sum(pp.pipeline_reference(_stage_fn, p, x) ** 2)
+
+    want = jax.grad(loss_ref)(stacked)
+
+    mesh = make_mesh(dp=1, tp=1, sp=4)
+    fn = pp.make_pipeline_fn(_stage_fn, mesh, axis_name="sp",
+                             n_microbatches=4)
+
+    def loss_pipe(p):
+        return jnp.sum(fn(p, x) ** 2)
+
+    with mesh:
+        placed = pp.place_pipeline_params(stacked, mesh, axis_name="sp")
+        got = jax.jit(jax.grad(loss_pipe))(placed)
+    for name in ("w", "b"):
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want[name]),
+            rtol=5e-5, atol=5e-5, err_msg=name)
+
+
+def test_stage_params_actually_sharded():
+    """The PP memory win: rank s holds only stage s's parameters."""
+    stacked, _ = _data(n_stages=4)
+    mesh = make_mesh(dp=1, tp=1, sp=4)
+    placed = pp.place_pipeline_params(stacked, mesh, axis_name="sp")
+    assert placed["w"].addressable_shards[0].data.shape == (1, D, D)

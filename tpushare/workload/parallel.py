@@ -35,6 +35,17 @@ from tpushare.workload import model as M
 # Mesh construction
 # --------------------------------------------------------------------------
 
+
+def to_varying(x, axes):
+    """Tag ``x`` as device-varying over ``axes`` (shard_map's typed
+    collectives require fresh scan carries to match the loop outputs'
+    varying-manual-axes type). One home for the pcast/pvary API shim —
+    pvary was deprecated in favor of ``pcast(..., to="varying")``."""
+    try:
+        return jax.lax.pcast(x, tuple(axes), to="varying")
+    except (AttributeError, TypeError):  # pragma: no cover - older jax
+        return jax.lax.pvary(x, tuple(axes))
+
 def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
               devices=None) -> Mesh:
     """Build a (dp, tp, sp) mesh over ``devices`` (default: all)."""
@@ -136,14 +147,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     m0 = jnp.full((b, h, lq), -1e30, jnp.float32)
     l0 = jnp.zeros((b, h, lq), jnp.float32)
     if vary_axes:
-        # Align the varying-manual-axes type of the fresh carries with the
-        # loop outputs (required by shard_map's typed collectives).
-        try:
-            acc0, m0, l0 = (jax.lax.pcast(x, vary_axes, to="varying")
-                            for x in (acc0, m0, l0))
-        except (AttributeError, TypeError):  # pragma: no cover - older jax
-            acc0, m0, l0 = (jax.lax.pvary(x, vary_axes)
-                            for x in (acc0, m0, l0))
+        acc0, m0, l0 = (to_varying(x, vary_axes) for x in (acc0, m0, l0))
 
     def step(carry, _):
         k_blk, v_blk, acc, m, l, src = carry
@@ -201,11 +205,7 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                        interpret=interpret)
     out = out.astype(jnp.float32)
     if vary_axes:
-        try:
-            out, lse = (jax.lax.pcast(x, vary_axes, to="varying")
-                        for x in (out, lse))
-        except (AttributeError, TypeError):  # pragma: no cover - older jax
-            out, lse = (jax.lax.pvary(x, vary_axes) for x in (out, lse))
+        out, lse = (to_varying(x, vary_axes) for x in (out, lse))
 
     def step(carry, _):
         k_blk, v_blk, out, lse, src = carry

@@ -1,0 +1,128 @@
+"""Pipeline parallelism: GPipe-style stage pipeline over a mesh axis.
+
+The last of the mesh dimensions (dp/tp/sp/ep/pp): layers are split into
+n contiguous STAGES, stage s's parameters live only on pipeline rank s
+(the memory win — each device holds 1/n of the layer stack), and
+activations flow rank → rank over ICI with ``ppermute``.
+
+Schedule: plain GPipe. The input batch is split into M microbatches;
+for ``M + n - 1`` ticks every rank applies its stage to whatever
+activation it currently holds and passes the result one hop forward.
+Rank 0 injects microbatch ``t`` at tick ``t``; rank n-1 emits microbatch
+``t - (n-1)`` at tick ``t``. Shapes are fully static — bubble ticks
+compute on garbage and are masked out, which is exactly the GPipe
+bubble cost (n-1 wasted ticks out of M + n - 1) paid in exchange for a
+trivially correct schedule. Gradients are exact: the whole schedule is
+a ``lax.scan`` over ``ppermute`` and the stage function, both of which
+JAX differentiates (the ppermute transpose is the reverse rotation —
+activations forward, gradients backward, as a hand-written 1F1B would).
+
+The stage function is caller-supplied, so any per-stage block works;
+``stack_stage_params``/``place_pipeline_params`` handle the [n_stages,
+...] parameter layout and its sharding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpushare.workload.parallel import (shard_map,  # jax shims
+                                        to_varying)
+
+
+def stack_stage_params(per_stage: list) -> dict | jax.Array:
+    """Stack a list of identically-shaped per-stage param pytrees into
+    one pytree with a leading [n_stages] axis (the axis ``pp`` shards)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def place_pipeline_params(stacked, mesh: Mesh, axis_name: str = "pp"):
+    """Shard the stacked stage params so rank s holds only stage s."""
+    def put(x):
+        spec = P(axis_name, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+    return jax.tree.map(put, stacked)
+
+
+def pipeline_reference(stage_fn, stacked, x: jax.Array) -> jax.Array:
+    """Single-device sequential application — the numerics the pipeline
+    must match."""
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    for s in range(n):
+        params_s = jax.tree.map(lambda a: a[s], stacked)
+        x = stage_fn(params_s, x)
+    return x
+
+
+def _pipeline_local(x_mb, stacked_local, *, stage_fn, axis_name: str):
+    """Per-rank body (inside shard_map).
+
+    ``x_mb``: [M, mb, ...] microbatched input, replicated (every rank
+    sees it; only rank 0 injects). ``stacked_local``: this rank's stage
+    params with the collapsed [1, ...] leading axis.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    params = jax.tree.map(lambda a: a[0], stacked_local)
+    M = x_mb.shape[0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        held, outs = carry
+        # Rank 0 swaps in microbatch t (clamped: bubble ticks reuse the
+        # last microbatch and are masked at emission).
+        inject = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.minimum(t, M - 1), axis=0, keepdims=False)
+        cur = jnp.where(idx == 0, inject, held)
+        y = stage_fn(params, cur)
+        # Rank n-1 finished microbatch (t - (n-1)) this tick.
+        out_t = t - (n - 1)
+        emit = (idx == n - 1) & (out_t >= 0)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs,
+            jnp.where(emit, y, jax.lax.dynamic_index_in_dim(
+                outs, jnp.maximum(out_t, 0), axis=0, keepdims=False)),
+            jnp.maximum(out_t, 0), axis=0)
+        held_next = jax.lax.ppermute(y, axis_name, perm)
+        return (held_next, outs), None
+
+    # The carry becomes device-varying after the first ppermute/where on
+    # axis_name; tag the (replicated-zero) initial carry the same way or
+    # scan rejects the carry type mismatch.
+    held0 = to_varying(jnp.zeros_like(x_mb[0]), (axis_name,))
+    outs0 = to_varying(jnp.zeros_like(x_mb), (axis_name,))
+    (_, outs), _ = jax.lax.scan(tick, (held0, outs0),
+                                jnp.arange(M + n - 1))
+    # Only rank n-1 holds real outputs; psum replicates them everywhere
+    # (cheap at these activation sizes; a production variant would leave
+    # the output on the last stage).
+    return jax.lax.psum(outs, axis_name)
+
+
+def make_pipeline_fn(stage_fn, mesh: Mesh, axis_name: str = "pp",
+                     n_microbatches: int = 4):
+    """Build ``fn(stacked_params, x) -> y`` running ``stage_fn`` as an
+    n-stage pipeline over ``axis_name``. ``x``: [batch, ...] with batch
+    divisible by ``n_microbatches``."""
+    def local(x_mb, stacked):
+        return _pipeline_local(x_mb, stacked, stage_fn=stage_fn,
+                               axis_name=axis_name)
+
+    def fn(stacked, x):
+        mb = x.shape[0] // n_microbatches
+        x_mb = x.reshape((n_microbatches, mb) + x.shape[1:])
+        in_specs = (
+            P(*([None] * x_mb.ndim)),  # microbatches replicated
+            jax.tree.map(lambda a: P(axis_name,
+                                     *([None] * (a.ndim - 1))), stacked),
+        )
+        mapped = shard_map(local, mesh=mesh, in_specs=in_specs,
+                           out_specs=P(*([None] * x_mb.ndim)))
+        y_mb = mapped(x_mb, stacked)
+        return y_mb.reshape((x.shape[0],) + y_mb.shape[2:])
+
+    return fn
