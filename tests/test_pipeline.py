@@ -70,6 +70,18 @@ def test_pipeline_gradients_match_reference():
             rtol=5e-5, atol=5e-5, err_msg=name)
 
 
+def test_stage_count_must_match_axis_size():
+    """4 stages on a 2-rank axis would silently drop stages 1 and 3
+    (each rank's body uses only its first local stage) — refused."""
+    stacked, x = _data(n_stages=4)
+    mesh = make_mesh(dp=1, tp=1, sp=2)
+    fn = pp.make_pipeline_fn(_stage_fn, mesh, axis_name="sp",
+                             n_microbatches=4)
+    with pytest.raises(ValueError, match="exactly 2 stages"):
+        with mesh:
+            fn(pp.place_pipeline_params(stacked, mesh, axis_name="sp"), x)
+
+
 def test_stage_params_actually_sharded():
     """The PP memory win: rank s holds only stage s's parameters."""
     stacked, _ = _data(n_stages=4)

@@ -28,9 +28,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def load_sample_pod(n: int, name: str | None = None) -> dict:
-    """Pod doc from samples/<n>.yaml's Deployment template."""
+    """Pod doc from samples/<n>.yaml's Deployment template (samples may
+    carry companion documents, e.g. 6.yaml's PriorityClass)."""
     with open(os.path.join(REPO, "samples", f"{n}.yaml")) as f:
-        dep = yaml.safe_load(f)
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    dep = next(d for d in docs if d.get("kind") == "Deployment")
     template = dep["spec"]["template"]
     pod = {
         "apiVersion": "v1", "kind": "Pod",
@@ -117,6 +119,48 @@ def test_sample_gang_all_or_nothing(api):
         nodes = {api.get_pod("default", f"gang-train-{i}").node_name
                  for i in range(4)}
         assert nodes == {f"v5p-{i}" for i in range(4)}
+    finally:
+        cluster.close()
+
+
+def test_sample_priority_preempts_batch(api):
+    """samples/6.yaml: the tpu-critical pod displaces a default-priority
+    batch pod on a saturated node — the full preemption loop the sample's
+    PriorityClass exists for. spec.priority is injected the way the
+    priority admission controller resolves priorityClassName."""
+    api.create_node(make_node("v5e-0", chips=4, hbm_per_chip=16))
+    cluster = Cluster(api)
+    try:
+        for i in range(4):  # saturate with default-priority batch pods
+            doc = load_sample_pod(1, name=f"batch-{i}")
+            doc["spec"]["containers"][0]["resources"]["limits"][
+                const.HBM_RESOURCE] = "16"
+            api.create_pod(doc)
+            bound, where = cluster.schedule(doc)
+            assert bound, where
+
+        crit = load_sample_pod(6)
+        assert crit["spec"]["priorityClassName"] == "tpu-critical"
+        crit["spec"]["priority"] = 1000  # what the admission plugin does
+        api.create_pod(crit)
+        bound, _ = cluster.schedule(crit)
+        assert not bound  # saturated: triggers the scheduler's preemption
+
+        pod = api.get_pod("default", "critical-inference")
+        status, plan = cluster._post("/tpushare-scheduler/preempt", {
+            "Pod": pod.raw,
+            "NodeNameToMetaVictims": {"v5e-0": {"Pods": []}}})
+        assert status == 200
+        victims = plan["NodeNameToMetaVictims"]["v5e-0"]["Pods"]
+        assert len(victims) == 1
+        victim = next(p for p in api.list_pods()
+                      if p.uid == victims[0]["UID"])
+        assert victim.name.startswith("batch-")
+        api.delete_pod(victim.namespace, victim.name)
+        assert cluster.controller.wait_idle(timeout=5)
+
+        bound, where = cluster.schedule(crit)
+        assert bound, where
     finally:
         cluster.close()
 

@@ -113,6 +113,15 @@ def make_pipeline_fn(stage_fn, mesh: Mesh, axis_name: str = "pp",
                                axis_name=axis_name)
 
     def fn(stacked, x):
+        n_stages = jax.tree.leaves(stacked)[0].shape[0]
+        if n_stages != mesh.shape[axis_name]:
+            # shard_map would happily give each rank n_stages/axis
+            # stages and _pipeline_local would silently use only the
+            # first — wrong answers with no error. Refuse instead.
+            raise ValueError(
+                f"pipeline over axis {axis_name!r} needs exactly "
+                f"{mesh.shape[axis_name]} stages (one per rank), got "
+                f"{n_stages}")
         mb = x.shape[0] // n_microbatches
         x_mb = x.reshape((n_microbatches, mb) + x.shape[1:])
         in_specs = (
