@@ -28,11 +28,11 @@ class Cluster:
         stack = build_stack(api)
         self.controller = stack.controller
         self.controller.start(workers=2)
-        self.server = ExtenderHTTPServer(("127.0.0.1", 0), stack.predicate,
-                                         stack.binder, stack.inspect,
-                                         prioritize=stack.prioritize,
-                                         preempt=stack.preempt,
-                                         admission=stack.admission)
+        self.server = ExtenderHTTPServer(
+            ("127.0.0.1", 0), stack.predicate, stack.binder, stack.inspect,
+            prioritize=stack.prioritize, preempt=stack.preempt,
+            admission=stack.admission,
+            gang_planner=stack.binder.gang_planner)
         serve_forever(self.server)
         self.base = f"http://127.0.0.1:{self.server.server_address[1]}"
 
@@ -164,6 +164,9 @@ class TestGangScheduling:
             make_pod("worker-0", chips=4, annotations=ann))
         assert not bound and "1/2" in str(detail)  # reserved, not bound
         assert api.get_pod("default", "worker-0").node_name == ""
+        # The below-quorum reservation is visible to operators/alerts.
+        with urllib.request.urlopen(f"{cluster.base}/metrics") as r:
+            assert b"tpushare_gangs_pending 1.0" in r.read()
 
         api.create_pod(make_pod("worker-1", chips=4, annotations=ann))
         bound, _ = cluster.schedule(

@@ -194,5 +194,8 @@ class TestHTTPGolden:
         assert body == b"ok"
         status, body = _get(f"{base}/metrics")
         assert b"tpushare_filter_latency_seconds" in body
+        # Election off => this replica is the binder. (gangs_pending is
+        # asserted where a planner is actually wired: test_e2e.)
+        assert b"tpushare_leader 1.0" in body
         status, body = _get(f"{base}/debug/threads")
         assert b"tpushare-http" in body or b"MainThread" in body

@@ -199,6 +199,10 @@ class TestHABindGating:
                 assert r.read() == b"ok leader"
             with urllib.request.urlopen(f"{base_b}/healthz") as r:
                 assert r.read() == b"ok follower"
+            with urllib.request.urlopen(f"{base_a}/metrics") as r:
+                assert b"tpushare_leader 1.0" in r.read()
+            with urllib.request.urlopen(f"{base_b}/metrics") as r:
+                assert b"tpushare_leader 0.0" in r.read()
         finally:
             for server, stack in ((server_a, stack_a), (server_b, stack_b)):
                 server.shutdown()

@@ -45,7 +45,8 @@ def main() -> None:
                                 stack.binder, stack.inspect,
                                 prioritize=stack.prioritize,
                                 preempt=stack.preempt,
-                                admission=stack.admission)
+                                admission=stack.admission,
+                                gang_planner=stack.binder.gang_planner)
     serve_forever(server)
     print(f"extender listening on http://127.0.0.1:{args.port} with "
           f"{args.nodes} simulated {args.tpu_type} nodes "

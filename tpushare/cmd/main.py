@@ -129,6 +129,7 @@ def main() -> None:
                                 preempt=stack.preempt,
                                 admission=stack.admission,
                                 leader=leader,
+                                gang_planner=stack.binder.gang_planner,
                                 debug_routes=debug_routes)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
     if bool(cert) != bool(key):

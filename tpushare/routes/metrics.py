@@ -66,6 +66,17 @@ HBM_USED = Gauge(
     "tpushare_node_hbm_used_gib", "Committed HBM per node",
     ["node"], registry=REGISTRY,
 )
+GANGS_PENDING = Gauge(
+    "tpushare_gangs_pending",
+    "Gangs holding reservations below quorum (stuck gangs -> alert)",
+    registry=REGISTRY,
+)
+IS_LEADER = Gauge(
+    "tpushare_leader",
+    "1 when this replica binds (lease holder, or election off); 0 when "
+    "a standby follower. Flapping -> alert",
+    registry=REGISTRY,
+)
 
 
 def render() -> bytes:
@@ -88,8 +99,16 @@ def observe_cache(cache) -> None:
             HBM_USED.labels(node=info.name).set(used)
 
 
-def scrape(cache) -> bytes:
+def scrape(cache, gang_planner=None, leader=None) -> bytes:
     """Atomic observe+render for the /metrics handler."""
     with _SCRAPE_LOCK:
         observe_cache(cache)
+        if gang_planner is not None:
+            # stats() is the cheap view (no member lists / TTL math) —
+            # this runs under the scrape lock.
+            GANGS_PENDING.set(sum(
+                1 for g in gang_planner.stats().values()
+                if not g["committed"]))
+        # Election off (single replica) => this replica is the binder.
+        IS_LEADER.set(1 if (leader is None or leader.is_leader()) else 0)
         return render()

@@ -49,13 +49,17 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
     def __init__(self, addr, predicate, binder, inspect,
                  prefix: str = DEFAULT_PREFIX, prioritize=None,
                  preempt=None, admission=None, leader=None,
-                 debug_routes: bool = True):
+                 gang_planner=None, debug_routes: bool = True):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
         self.prioritize = prioritize
         self.preempt = preempt
         self.admission = admission
+        #: Wired explicitly (not probed off the binder) so a refactor
+        #: that drops the attribute fails loudly instead of freezing the
+        #: gangs-pending gauge.
+        self.gang_planner = gang_planner
         #: Leader elector (``is_leader() -> bool``) when running as one
         #: of several HA replicas. Only bind mutates the cluster +
         #: ledger, so only bind is gated; read verbs serve everywhere.
@@ -132,8 +136,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_text(f"ok{role}".encode())
             elif path == "/metrics":
                 # Atomic refresh+render of per-node utilization gauges.
-                self._send_text(metrics.scrape(self.server.inspect.cache),
-                                ctype="text/plain; version=0.0.4")
+                self._send_text(
+                    metrics.scrape(self.server.inspect.cache,
+                                   gang_planner=self.server.gang_planner,
+                                   leader=self.server.leader),
+                    ctype="text/plain; version=0.0.4")
             elif path.startswith("/debug/") and not self.server.debug_routes:
                 self._send_json({"Error": "debug routes disabled"}, 404)
             elif path in ("/debug/threads", "/debug/pprof/goroutine"):
