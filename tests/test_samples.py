@@ -63,7 +63,8 @@ def test_config_files_parse():
     for fname in ("kube-scheduler-config.yaml", "kube-scheduler.yaml",
                   "tpushare-schd-extender.yaml",
                   "tpushare-device-plugin.yaml",
-                  "tpushare-admission-webhook.yaml"):
+                  "tpushare-admission-webhook.yaml",
+                  "tpushare-alerts.yaml"):
         with open(os.path.join(REPO, "config", fname)) as f:
             docs = [d for d in yaml.safe_load_all(f) if d]
         assert docs, fname
