@@ -159,14 +159,19 @@ class TestGangScheduling:
                                       tpu_type="v5p"))
         ann = {const.ANN_POD_GROUP: "train", const.ANN_POD_GROUP_MIN: "2"}
 
+        from tpushare.routes import metrics as m
+        errors_before = m.BIND_ERRORS._value.get()
         api.create_pod(make_pod("worker-0", chips=4, annotations=ann))
         bound, detail = cluster.schedule(
             make_pod("worker-0", chips=4, annotations=ann))
         assert not bound and "1/2" in str(detail)  # reserved, not bound
         assert api.get_pod("default", "worker-0").node_name == ""
-        # The below-quorum reservation is visible to operators/alerts.
+        # The below-quorum reservation is visible to operators/alerts —
+        # as a PENDING gang, not as a bind error (GangPending is an
+        # expected hold; counting it would page during normal assembly).
         with urllib.request.urlopen(f"{cluster.base}/metrics") as r:
             assert b"tpushare_gangs_pending 1.0" in r.read()
+        assert m.BIND_ERRORS._value.get() == errors_before
 
         api.create_pod(make_pod("worker-1", chips=4, annotations=ann))
         bound, _ = cluster.schedule(

@@ -60,13 +60,24 @@ def test_config_files_parse():
     assert managed == {const.HBM_RESOURCE, const.CHIP_RESOURCE}
     assert "/tpushare-scheduler" in ext["urlPrefix"]
 
+    class StrictLoader(yaml.SafeLoader):
+        """kubectl rejects duplicate mapping keys; PyYAML silently keeps
+        the last one, so a duplicated key would pass safe_load and break
+        the documented install step. Fail the test instead."""
+        def construct_mapping(self, node, deep=False):
+            keys = [self.construct_object(k, deep=deep)
+                    for k, _ in node.value]
+            dupes = {k for k in keys if keys.count(k) > 1}
+            assert not dupes, f"duplicate YAML keys: {dupes}"
+            return super().construct_mapping(node, deep)
+
     for fname in ("kube-scheduler-config.yaml", "kube-scheduler.yaml",
                   "tpushare-schd-extender.yaml",
                   "tpushare-device-plugin.yaml",
                   "tpushare-admission-webhook.yaml",
                   "tpushare-alerts.yaml"):
         with open(os.path.join(REPO, "config", fname)) as f:
-            docs = [d for d in yaml.safe_load_all(f) if d]
+            docs = [d for d in yaml.load_all(f, Loader=StrictLoader) if d]
         assert docs, fname
 
     sched = yaml.safe_load(

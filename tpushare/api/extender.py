@@ -110,6 +110,10 @@ class ExtenderBindingResult:
     """Result of ``POST .../bind``."""
 
     error: str = ""
+    #: True when the error is an EXPECTED hold (gang member reserved,
+    #: awaiting quorum): the scheduler must still retry (wire carries
+    #: Error), but metrics/alerts must not count it as a failure.
+    pending: bool = False
 
     def to_json(self) -> dict:
         return {"Error": self.error}

@@ -244,7 +244,10 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 with metrics.BIND_LATENCY.time():
                     result = self.server.binder.handle(args_parsed)
-                if result.error:
+                if result.error and not result.pending:
+                    # GangPending is an expected hold (scheduler retries
+                    # until quorum), not a failure — alerting on it would
+                    # page during normal gang assembly.
                     metrics.BIND_ERRORS.inc()
                 # Reference returns HTTP 500 when bind fails
                 # (routes.go:139-143) so the scheduler retries.

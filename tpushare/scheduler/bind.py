@@ -85,7 +85,7 @@ class Bind:
                 # Not a failure: the member is reserved, waiting on quorum.
                 events.record(self.client, pod,
                               events.REASON_GANG_PENDING, str(e))
-            else:
-                events.record(self.client, pod, events.REASON_BIND_FAILED,
-                              f"node {args.node}: {e}", event_type="Warning")
+                return ExtenderBindingResult(error=str(e), pending=True)
+            events.record(self.client, pod, events.REASON_BIND_FAILED,
+                          f"node {args.node}: {e}", event_type="Warning")
             return ExtenderBindingResult(error=str(e))
