@@ -34,6 +34,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 from tpushare.api.objects import Pod, binding_doc
 from tpushare.cache.nodeinfo import AllocationError
@@ -249,25 +250,46 @@ class GangPlanner:
 
     # ------------------------------------------------------------------ #
 
-    def _bind_one(self, group: _Group, uid: str) -> None:
+    def _post_binding(self, group: _Group, uid: str):
+        """POST one member's binding; returns the outcome WITHOUT
+        touching group state (safe to run concurrently)."""
         pod, node_name = group.reservations[uid]
         try:
             self.client.bind_pod(binding_doc(pod, node_name))
         except NotFoundError:
+            return "gone"
+        except ApiError as e:
+            if e.status != 409:  # 409 == already bound: fine
+                return e
+        return "bound"
+
+    def _apply_binding_outcome(self, group: _Group, uid: str,
+                               outcome) -> ApiError | None:
+        """Serially fold one POST outcome into group state; returns the
+        error when the binding failed."""
+        if outcome == "bound":
+            group.bound.add(uid)
+            return None
+        if outcome == "gone":
             # Member deleted while awaiting its binding: drop the
             # reservation (and its ledger hold) instead of POSTing a
             # doomed binding every housekeeping tick forever — with it
             # gone, fully_bound() can complete and forget the group.
+            pod, _ = group.reservations[uid]
             log.warning("gang %s: member %s vanished before binding; "
                         "dropping its reservation", group.name, pod.key())
             self.cache.remove_pod(pod)
             group.reservations.pop(uid, None)
             group.bound.discard(uid)
-            return
-        except ApiError as e:
-            if e.status != 409:  # 409 == already bound: fine
-                raise
-        group.bound.add(uid)
+            return None
+        return outcome  # ApiError
+
+    def _bind_one(self, group: _Group, uid: str) -> None:
+        """Serial POST+apply (housekeeping retries bind one at a time)."""
+        outcome = self._post_binding(group, uid)
+        err = self._apply_binding_outcome(group, uid, outcome)
+        if err is not None:
+            raise err
 
     def _commit(self, key, group: _Group, current_uid: str | None = None) -> None:
         """Post bindings for every reserved member. Partial failures keep
@@ -289,17 +311,29 @@ class GangPlanner:
                     f"({len(group.reservations)}/{group.minimum}); "
                     f"committing to node {member_node}")
         current_error: ApiError | None = None
-        for uid in list(group.reservations):
-            if uid in group.bound:
-                continue
-            try:
-                self._bind_one(group, uid)
-            except ApiError as e:
-                pod, _ = group.reservations[uid]
-                log.warning("gang %s/%s: binding %s failed (%s); will retry",
-                            key[0], group.name, pod.name, e)
-                if uid == current_uid:
-                    current_error = e
+        pending = [uid for uid in list(group.reservations)
+                   if uid not in group.bound]
+        if pending:
+            # POST the bindings concurrently — they are independent
+            # apiserver writes, and a whole-slice gang serialized at
+            # ~2 ms per member pays n×RTT on the scheduler's critical
+            # path. State mutations stay serial, folded in afterwards
+            # (the group lock is held by our caller throughout).
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(pending))) as ex:
+                outcomes = list(ex.map(
+                    lambda uid: (uid, self._post_binding(group, uid)),
+                    pending))
+            for uid, outcome in outcomes:
+                err = self._apply_binding_outcome(group, uid, outcome)
+                if err is not None:
+                    pod, _ = group.reservations[uid]
+                    log.warning("gang %s/%s: binding %s failed (%s); "
+                                "will retry", key[0], group.name,
+                                pod.name, err)
+                    if uid == current_uid:
+                        current_error = err
         if group.fully_bound():
             with self._table_lock:
                 self._groups.pop(key, None)
