@@ -58,8 +58,7 @@ class TestValidate:
 
     def test_malformed_gang_rejected(self, api, v5e_node):
         adm = _admission(api)
-        for ann in ({const.ANN_POD_GROUP: "g"},                      # no min
-                    {const.ANN_POD_GROUP: "g",
+        for ann in ({const.ANN_POD_GROUP: "g",
                      const.ANN_POD_GROUP_MIN: "zero"},               # NaN
                     {const.ANN_POD_GROUP: "g",
                      const.ANN_POD_GROUP_MIN: "0"},                  # < 1
@@ -68,10 +67,15 @@ class TestValidate:
                 Pod(make_pod("p", hbm=8, annotations=ann)))
             assert not ok, ann
 
-        ok, _ = adm.validate(Pod(make_pod(
-            "p", hbm=8, annotations={const.ANN_POD_GROUP: "g",
-                                     const.ANN_POD_GROUP_MIN: "2"})))
-        assert ok
+        # An ABSENT min is legal: the planner defaults it to 1, and
+        # manifests that scheduled before the webhook was installed must
+        # keep working after (advisor round-2 finding — webhook-on vs
+        # webhook-off clusters must not diverge).
+        for ann in ({const.ANN_POD_GROUP: "g"},
+                    {const.ANN_POD_GROUP: "g",
+                     const.ANN_POD_GROUP_MIN: "2"}):
+            ok, _ = adm.validate(Pod(make_pod("p", hbm=8, annotations=ann)))
+            assert ok, ann
 
     def test_no_lister_falls_back_to_cache(self, api, v5e_node):
         """Without a node lister (degraded wiring) the fleet shape comes

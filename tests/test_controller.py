@@ -206,3 +206,113 @@ class TestNodeLifecycle:
             assert info.get_available_hbm()[0] == 8  # pod re-accounted
         finally:
             c.stop()
+
+
+class TestGangReaper:
+    """Whole-gang reclamation: an assigned member dying mid-run below
+    quorum reaps the survivors (the cross-node half of gang-aware
+    preemption — the preempt verb's victim map is per-node, so siblings
+    elsewhere can only be reclaimed here)."""
+
+    def _gang_pod(self, api, name, node, minimum="3", extra=None):
+        from tpushare.utils import const
+        ann = {const.ANN_POD_GROUP: "trainjob",
+               const.ANN_POD_GROUP_MIN: minimum}
+        ann.update(extra or {})
+        pod = Pod(make_pod(name, chips=4, phase="Running",
+                           annotations=ann))
+        pod = podutils.updated_pod_annotation_spec(pod, [0, 1, 2, 3],
+                                                   380, 95)
+        pod.raw["spec"]["nodeName"] = node
+        return api.create_pod(pod.raw)
+
+    def _hosts(self, api, n=3):
+        for i in range(n):
+            api.create_node(make_node(f"host-{i}", chips=4,
+                                      hbm_per_chip=95, topology="2x2x1",
+                                      tpu_type="v5p"))
+
+    def _wait_gone(self, api, names, timeout=3.0):
+        from tpushare.k8s.errors import NotFoundError
+
+        def gone(n):
+            try:
+                api.get_pod("default", n)
+                return False
+            except NotFoundError:
+                return True
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(gone(n) for n in names):
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_evicted_member_reaps_survivors(self, api):
+        self._hosts(api)
+        for i in range(3):
+            self._gang_pod(api, f"m{i}", f"host-{i}")
+        c = start_controller(api)
+        try:
+            api.delete_pod("default", "m0")  # eviction mid-run
+            assert self._wait_gone(api, ["m1", "m2"]), \
+                "survivors below quorum must be reaped"
+            # their chips are free again
+            assert c.wait_idle()
+            time.sleep(0.05)
+            for i in range(1, 3):
+                info = c.cache.get_node_info(f"host-{i}")
+                assert len(info.get_free_chips()) == 4
+        finally:
+            c.stop()
+
+    def test_completed_member_never_reaps(self, api):
+        """A member finishing naturally is not an eviction: survivors
+        keep running (completion order within a gang is arbitrary)."""
+        self._hosts(api)
+        for i in range(3):
+            self._gang_pod(api, f"m{i}", f"host-{i}")
+        c = start_controller(api)
+        try:
+            api.update_pod_status("default", "m0", "Succeeded")
+            assert c.wait_idle()
+            api.delete_pod("default", "m0")  # GC of a finished pod
+            assert c.wait_idle()
+            time.sleep(0.1)
+            assert api.get_pod("default", "m1") is not None
+            assert api.get_pod("default", "m2") is not None
+        finally:
+            c.stop()
+
+    def test_above_quorum_survivors_spared(self, api):
+        """min=2 of 3: losing one member leaves quorum intact."""
+        self._hosts(api)
+        for i in range(3):
+            self._gang_pod(api, f"m{i}", "host-0" if i == 0 else f"host-{i}",
+                           minimum="2")
+        c = start_controller(api)
+        try:
+            api.delete_pod("default", "m0")
+            assert c.wait_idle()
+            time.sleep(0.1)
+            assert api.get_pod("default", "m1") is not None
+            assert api.get_pod("default", "m2") is not None
+        finally:
+            c.stop()
+
+    def test_reap_opt_out(self, api):
+        from tpushare.utils import const
+        self._hosts(api)
+        for i in range(3):
+            self._gang_pod(api, f"m{i}", f"host-{i}",
+                           extra={const.ANN_POD_GROUP_REAP: "false"})
+        c = start_controller(api)
+        try:
+            api.delete_pod("default", "m0")
+            assert c.wait_idle()
+            time.sleep(0.1)
+            assert api.get_pod("default", "m1") is not None
+            assert api.get_pod("default", "m2") is not None
+        finally:
+            c.stop()

@@ -287,6 +287,7 @@ class TestHonestCommit:
             planner.bind_member(p0, "host-0")
         p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
         planner.bind_member(p1, "host-1")
+        assert ev.flush()  # recorder is async; drain before asserting
         reasons = [e["reason"] for _ns, e in api.events]
         assert reasons.count(ev.REASON_GANG_COMMITTED) == 2
 
@@ -308,6 +309,50 @@ class TestDeletedMember:
         api.delete_pod("default", "w0")  # user deletes the straggler
         planner.retry_unbound()
         assert planner.stats() == {}  # group forgotten, not leaked
+        assert len(cache.get_node_info("host-0").get_free_chips()) == 4
+
+
+class TestLeaderGatedHousekeeping:
+    def test_follower_tick_skips_binding_retries(self, api):
+        """A replica that lost the lease must stop POSTing member
+        bindings from the housekeeping tick — a late binding racing the
+        new leader's placement of the same pods is the split-ledger
+        hazard election exists to close (advisor, round 2). Expiry still
+        runs on followers: TTL rollback of locally held reservations is
+        how a demoted leader sheds state."""
+        cache = make_cluster(api)
+        client = FlakyBindClient(api, fail_names={"w0"})
+        leading = True
+        planner = GangPlanner(cache, client, ttl=60,
+                              is_leader=lambda: leading)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
+        planner.bind_member(p1, "host-1")  # commits; w0's POST failed
+
+        leading = False
+        planner.housekeeping_tick()  # follower: must NOT retry the bind
+        assert api.get_pod("default", "w0").node_name == ""
+        assert planner.stats()["default/train"]["bound"] == 1
+
+        leading = True
+        planner.housekeeping_tick()  # regained the lease: drains
+        assert api.get_pod("default", "w0").node_name == "host-0"
+        assert planner.stats() == {}
+
+    def test_follower_tick_still_expires(self, api):
+        """Expiry is not leader-gated: an uncommitted reservation held by
+        a follower rolls back at TTL, freeing its ledger."""
+        cache = make_cluster(api)
+        planner = GangPlanner(cache, api, ttl=0.01,
+                              is_leader=lambda: False)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        time.sleep(0.02)
+        planner.housekeeping_tick()
+        assert planner.stats() == {}
         assert len(cache.get_node_info("host-0").get_free_chips()) == 4
 
 

@@ -85,6 +85,7 @@ class TestEvents:
             api.create_pod(make_pod("p1", hbm=8))
             bound, _ = cluster.schedule(make_pod("p1", hbm=8))
             assert bound
+            assert events.flush()  # recorder is async; drain before asserting
             reasons = [e["reason"] for _, e in api.events]
             assert events.REASON_BOUND in reasons
             ev = next(e for _, e in api.events
@@ -109,6 +110,7 @@ class TestEvents:
                 "PodName": "bigger", "PodNamespace": "default",
                 "PodUID": pod.uid, "Node": "v5e-0"})
             assert status == 500
+            assert events.flush()  # recorder is async; drain before asserting
             warnings = [e for _, e in api.events
                         if e["reason"] == events.REASON_BIND_FAILED]
             assert warnings and warnings[0]["type"] == "Warning"
@@ -130,6 +132,7 @@ class TestEvents:
             planner.bind_member(pod, "v5p-0")
         time.sleep(0.06)
         assert planner.expire_stale() == 1
+        assert events.flush()  # recorder is async; drain before asserting
         reasons = [e["reason"] for _, e in api.events]
         assert events.REASON_GANG_EXPIRED in reasons
 

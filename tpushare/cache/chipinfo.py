@@ -24,6 +24,10 @@ class ChipInfo:
         self.pods: dict[str, Pod] = {}  # uid -> Pod
         self._contrib: dict[str, int] = {}  # uid -> GiB counted
         self._used = 0
+        #: uids priced as active (not complete/terminating) at add time —
+        #: a set, not a counter, so it cannot drift if a stored pod's
+        #: status document is mutated in place between add and remove.
+        self._active: set[str] = set()
         self._lock = threading.RLock()
 
     def _contribution(self, pod: Pod) -> int:
@@ -45,6 +49,10 @@ class ChipInfo:
         Re-adding with a newer pod object (phase change) re-prices it."""
         with self._lock:
             self.pods[pod.uid] = pod
+            if podutils.is_complete_pod(pod):
+                self._active.discard(pod.uid)
+            else:
+                self._active.add(pod.uid)
             self._used -= self._contrib.get(pod.uid, 0)
             self._contrib[pod.uid] = self._contribution(pod)
             self._used += self._contrib[pod.uid]
@@ -53,7 +61,14 @@ class ChipInfo:
         """Drop ``pod`` (reference deviceinfo.go:68-80)."""
         with self._lock:
             if self.pods.pop(pod.uid, None) is not None:
+                self._active.discard(pod.uid)
                 self._used -= self._contrib.pop(pod.uid, 0)
+
+    def has_active_pods(self) -> bool:
+        """O(1) occupancy check for the whole-chip allocator (priced at
+        add/remove time like ``_used`` — no per-query resident scan)."""
+        with self._lock:
+            return bool(self._active)
 
     def get_used_hbm(self) -> int:
         """HBM GiB currently committed on this chip — O(1): the ledger

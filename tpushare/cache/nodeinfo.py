@@ -111,13 +111,12 @@ class NodeInfo:
 
     def get_free_chips(self) -> list[int]:
         """Chips with no resident pods at all (candidates for whole-chip
-        grants)."""
+        grants). O(chips): occupancy is priced at add/remove time, not
+        re-derived from resident snapshots on every filter query."""
         with self._lock:
             return [
                 i for i, chip in self.chips.items()
-                if chip.get_used_hbm() == 0 and not any(
-                    not podutils.is_complete_pod(p) for p in chip.snapshot_pods()
-                )
+                if chip.get_used_hbm() == 0 and not chip.has_active_pods()
             ]
 
     def count_fits(self, pod: Pod) -> int:

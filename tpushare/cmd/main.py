@@ -71,13 +71,18 @@ class Stack(NamedTuple):
     admission: object
 
 
-def build_stack(client) -> Stack:
-    """Wire controller + handlers over one shared cache."""
+def build_stack(client, is_leader=None) -> Stack:
+    """Wire controller + handlers over one shared cache.
+
+    ``is_leader`` (``() -> bool``) gates the gang planner's housekeeping
+    retries so a demoted leader stops POSTing member bindings (its /bind
+    route is already follower-gated by the HTTP layer)."""
     controller = Controller(client)
     # Quorum pre-checks enumerate nodes from the informer store — no
     # apiserver LIST on the bind path.
     gang = GangPlanner(controller.cache, client,
-                       node_lister=controller.hub.nodes.list)
+                       node_lister=controller.hub.nodes.list,
+                       is_leader=is_leader)
     gang.start()  # housekeeping tick: gang expiry + bind retries
     predicate = Predicate(controller.cache)
     prioritize = Prioritize(controller.cache, gang_planner=gang)
@@ -102,25 +107,35 @@ def main() -> None:
     workers = int(os.environ.get("WORKERS", "4"))
 
     client = ApiClient(ClusterConfig.auto())
-    stack = build_stack(client)
+
+    # HA: with LEADER_ELECT on, several replicas may run but only the
+    # Lease holder binds (a follower's eventually-consistent ledger must
+    # not place pods); read verbs serve from every replica. Built before
+    # the stack so the gang planner's housekeeping can be leader-gated.
+    leader = None
+    if os.environ.get("LEADER_ELECT", "").lower() in ("1", "true", "yes"):
+        from uuid import uuid4
+
+        from tpushare.k8s.leader import LeaderElector
+        # Globally unique even if HOSTNAME is unset: two replicas that
+        # collide on the same pid on different hosts would BOTH pass the
+        # holder==identity renew check — split brain (advisor, round 2).
+        identity = (f"{os.environ.get('HOSTNAME') or 'pid'}-"
+                    f"{os.getpid()}-{uuid4().hex[:8]}")
+        leader = LeaderElector(
+            client, identity,
+            namespace=os.environ.get("LEASE_NAMESPACE", "kube-system"))
+        leader.start()
+        log.info("leader election enabled (identity %s)", identity)
+
+    stack = build_stack(
+        client, is_leader=leader.is_leader if leader is not None else None)
     controller, binder = stack.controller, stack.binder
 
     stop = threading.Event()
     setup_signals(stop)
 
     controller.start(workers=workers)
-    # HA: with LEADER_ELECT on, several replicas may run but only the
-    # Lease holder binds (a follower's eventually-consistent ledger must
-    # not place pods); read verbs serve from every replica.
-    leader = None
-    if os.environ.get("LEADER_ELECT", "").lower() in ("1", "true", "yes"):
-        from tpushare.k8s.leader import LeaderElector
-        identity = os.environ.get("HOSTNAME") or f"pid-{os.getpid()}"
-        leader = LeaderElector(
-            client, identity,
-            namespace=os.environ.get("LEASE_NAMESPACE", "kube-system"))
-        leader.start()
-        log.info("leader election enabled (identity %s)", identity)
     debug_routes = os.environ.get("DEBUG_ROUTES", "1").lower() not in (
         "0", "false", "no")
     server = ExtenderHTTPServer(("0.0.0.0", port), stack.predicate,

@@ -20,9 +20,11 @@ import threading
 
 from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
+from tpushare.k8s import events
 from tpushare.k8s.errors import ApiError, NotFoundError
 from tpushare.k8s.informer import InformerHub
 from tpushare.k8s.workqueue import RateLimitedQueue
+from tpushare.utils import const
 from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
@@ -116,12 +118,61 @@ class Controller:
             if stashed is not None:
                 self.cache.remove_pod(stashed)
                 log.info("sync: removed deleted pod %s from ledger", key)
+                self._maybe_reap_gang(stashed)
             return
         if podutils.is_complete_pod(pod):
             self.cache.remove_pod(pod)
             log.info("sync: pod %s complete, freed its HBM", key)
         elif podutils.is_assumed(pod) and pod.node_name:
             self.cache.add_or_update_pod(pod)
+
+    def _maybe_reap_gang(self, dead: Pod) -> None:
+        """Whole-gang reclamation: an ASSIGNED gang member died mid-run
+        (eviction, preemption, node loss) and its group can no longer
+        reach quorum — the survivors are bricked but still pin whole TPU
+        hosts. Delete them so their chips return now; a recreating owner
+        restarts the full group, which re-gangs atomically. This is the
+        cross-node half of gang-aware preemption: the preempt verb's
+        victim map is per-node (upstream ``convertToVictims`` resolves
+        victim UIDs against one node's pod list), so siblings on other
+        nodes can only be reclaimed here. Opt out per group with
+        ``tpushare.io/pod-group-reap: "false"``."""
+        group, minimum = podutils.get_pod_group(dead)
+        if not group or minimum <= 1:
+            return
+        if podutils.is_complete_pod(dead) or not podutils.is_assumed(dead):
+            # Finished naturally (survivors are fine) or never granted
+            # chips (the gang planner's TTL rollback owns reservations).
+            return
+        if dead.annotations.get(const.ANN_POD_GROUP_REAP, "").lower() in (
+                "false", "0", "no"):
+            return
+        survivors = [
+            p for p in self.hub.pods.list()
+            if p.namespace == dead.namespace
+            and p.annotations.get(const.ANN_POD_GROUP) == group
+            and p.uid != dead.uid
+            and not podutils.is_complete_pod(p)
+        ]
+        if not survivors or len(survivors) >= minimum:
+            return  # group gone already, or still at/above quorum
+        log.warning(
+            "gang %s/%s below quorum after %s died (%d survivors < min "
+            "%d); reaping survivors to free their chips",
+            dead.namespace, group, dead.name, len(survivors), minimum)
+        for p in survivors:
+            try:
+                self.client.delete_pod(p.namespace, p.name)
+                events.record(
+                    self.client, p, events.REASON_GANG_REAPED,
+                    f"gang {group} lost member {dead.name} and cannot "
+                    f"reach quorum ({len(survivors)} < {minimum}); "
+                    "reclaiming this member's chips", event_type="Warning")
+            except NotFoundError:
+                pass  # raced another reaper pass / the owner
+            except ApiError as e:
+                log.warning("gang reap of %s failed (%s); its deletion "
+                            "will retrigger the reaper", p.key(), e)
 
     # -- worker loop (reference runWorker/processNextWorkItem, fixed) ---- #
 

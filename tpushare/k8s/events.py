@@ -5,6 +5,14 @@ actually emitted an event on any code path (SURVEY.md §5 observability
 gap). Here bind outcomes are recorded as real v1 Events, so
 ``kubectl describe pod`` explains TPU placement decisions — including
 why a pod is waiting on its gang.
+
+Emission is ASYNCHRONOUS, like client-go's event broadcaster: ``record``
+enqueues and returns; a daemon drains to the apiserver. A synchronous
+POST per event would put an apiserver round-trip on the bind hot path —
+15 of them while a 16-member gang trickles toward quorum — and
+observability must never set the scheduler's latency floor. The queue is
+bounded; under pathological backlog events are DROPPED (client-go does
+the same), which is the right failure mode for telemetry.
 """
 
 from __future__ import annotations
@@ -12,6 +20,9 @@ from __future__ import annotations
 import datetime
 import itertools
 import logging
+import queue
+import threading
+import time
 
 from tpushare.api.objects import Pod
 
@@ -19,19 +30,59 @@ log = logging.getLogger(__name__)
 
 _seq = itertools.count(1)
 
+_queue: "queue.Queue[tuple[object, str, dict]]" = queue.Queue(maxsize=1024)
+_worker: threading.Thread | None = None
+_worker_lock = threading.Lock()
+
+
+def _drain() -> None:
+    while True:
+        client, namespace, event = _queue.get()
+        try:
+            client.create_event(namespace, event)
+        except Exception as exc:  # noqa: BLE001 - observability must not throw
+            log.debug("event emission failed for %s/%s: %s",
+                      namespace, event["metadata"]["name"], exc)
+        finally:
+            _queue.task_done()
+
+
+def _ensure_worker() -> None:
+    global _worker
+    if _worker is not None and _worker.is_alive():
+        return
+    with _worker_lock:
+        if _worker is None or not _worker.is_alive():
+            _worker = threading.Thread(target=_drain,
+                                       name="tpushare-events", daemon=True)
+            _worker.start()
+
+
+def flush(timeout: float = 2.0) -> bool:
+    """Block until every queued event has been POSTed (or ``timeout``);
+    returns True when drained. Tests use this; production never needs
+    to."""
+    deadline = time.monotonic() + timeout
+    while _queue.unfinished_tasks:
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.001)
+    return True
+
 COMPONENT = "tpushare-scheduler-extender"
 
 REASON_BOUND = "TPUShareBound"
 REASON_BIND_FAILED = "TPUShareBindFailed"
 REASON_GANG_PENDING = "TPUShareGangPending"
 REASON_GANG_EXPIRED = "TPUShareGangExpired"
+REASON_GANG_REAPED = "TPUShareGangReaped"
 REASON_GANG_COMMITTED = "TPUShareGangCommitted"
 
 
 def record(client, pod: Pod, reason: str, message: str,
            event_type: str = "Normal") -> None:
-    """Best-effort Event creation; never lets observability break the
-    scheduling path."""
+    """Best-effort, non-blocking Event creation; never lets
+    observability break (or slow) the scheduling path."""
     now_dt = datetime.datetime.now(datetime.timezone.utc)
     now = now_dt.strftime("%Y-%m-%dT%H:%M:%SZ")
     # Name like client-go's recorder: pod + a time-derived component, so
@@ -61,6 +112,8 @@ def record(client, pod: Pod, reason: str, message: str,
         "count": 1,
     }
     try:
-        client.create_event(pod.namespace, event)
-    except Exception as exc:  # noqa: BLE001 - observability must not throw
-        log.debug("event emission failed for %s: %s", pod.key(), exc)
+        _queue.put_nowait((client, pod.namespace, event))
+    except queue.Full:
+        log.debug("event queue full; dropping %s for %s", reason, pod.key())
+        return
+    _ensure_worker()

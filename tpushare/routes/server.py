@@ -79,9 +79,31 @@ class _Handler(BaseHTTPRequestHandler):
     disable_nagle_algorithm = True
     server: ExtenderHTTPServer
 
+    _date_cache: tuple[float, str] = (0.0, "")
+
+    def version_string(self) -> str:
+        # Constant: the default concatenates server_version/sys_version
+        # per response.
+        return "tpushare"
+
+    def date_time_string(self, timestamp=None) -> str:
+        """The stdlib formats an RFC-2822 date string PER RESPONSE; at
+        webhook rates that formatting shows up in the latency histogram.
+        Second-granularity cache (the Date header has 1s resolution)."""
+        if timestamp is not None:
+            return super().date_time_string(timestamp)
+        import time as _time
+        now = _time.time()
+        stamp, value = _Handler._date_cache
+        if now - stamp >= 1.0 or not value:
+            value = super().date_time_string(now)
+            _Handler._date_cache = (now, value)
+        return value
+
     # -- plumbing ----------------------------------------------------------
     def log_message(self, fmt, *args):  # route through logging, not stderr
-        log.debug("%s %s", self.address_string(), fmt % args)
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug("%s %s", self.address_string(), fmt % args)
 
     def _send_json(self, doc: dict, status: int = 200,
                    extra_headers: dict | None = None) -> None:

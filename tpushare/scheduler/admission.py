@@ -99,16 +99,23 @@ class Admission:
             if not group:
                 return False, (
                     f"annotation {const.ANN_POD_GROUP} must not be empty")
-            raw_min = pod.annotations.get(const.ANN_POD_GROUP_MIN, "")
-            try:
-                minimum = int(raw_min)
-            except ValueError:
-                minimum = -1
-            if minimum < 1:
-                return False, (
-                    f"gang pod (annotation {const.ANN_POD_GROUP}={group!r}) "
-                    f"requires {const.ANN_POD_GROUP_MIN} to be an integer "
-                    f">= 1, got {raw_min!r}")
+            # An ABSENT min is legal — the planner defaults it to 1
+            # (utils/pod.get_pod_group + _get_group clamp), and manifests
+            # that scheduled fine before this webhook was installed must
+            # keep working after (advisor, round 2). Only an explicit
+            # value that is unparseable or < 1 is a manifest bug.
+            raw_min = pod.annotations.get(const.ANN_POD_GROUP_MIN)
+            if raw_min is not None:
+                try:
+                    minimum = int(raw_min)
+                except ValueError:
+                    minimum = -1
+                if minimum < 1:
+                    return False, (
+                        f"gang pod (annotation {const.ANN_POD_GROUP}="
+                        f"{group!r}) has explicit {const.ANN_POD_GROUP_MIN}="
+                        f"{raw_min!r}; when set it must be an integer >= 1 "
+                        "(omit it to default to 1)")
 
         max_chip, max_chips, nodes = self._fleet_shape()
         if nodes == 0:

@@ -35,9 +35,17 @@ Victim-selection policy (TPU-first):
 * Victims the scheduler already nominated (for its own resources) are
   preferred at equal cost — those pods are being evicted anyway, so
   reusing them keeps the total blast radius minimal.
-* Gang members are avoided at equal cost: evicting one member strands
-  the rest of the gang's reservations until TTL rollback, so a lone pod
-  of the same priority is always the cheaper real-world victim.
+* Gang victims are priced at their gang's FULL cluster footprint:
+  evicting one member of a committed gang bricks the whole job while the
+  surviving members squat on their chips, so the real cost of that
+  victim is every member's HBM across every node — not the one slice on
+  the chip under consideration. A lone pod of the same priority
+  therefore always beats a gang member, at any size, and a small gang
+  beats a large one. When a gang member IS evicted, every sibling on the
+  candidate node joins the victim map (the wire form is per-node — see
+  :meth:`Preempt.expand_gang_victims`), and the controller's gang reaper
+  reclaims members on other nodes once the eviction drops the group
+  below quorum — the whole gang's chips come back, not just one slice.
 """
 
 from __future__ import annotations
@@ -117,13 +125,19 @@ class Preempt:
     # ------------------------------------------------------------------ #
 
     def plan_node(self, info: NodeInfo, preemptor: Pod,
-                  preferred: set[str]) -> list[Pod] | None:
+                  preferred: set[str],
+                  gang_memo: dict | None = None) -> list[Pod] | None:
         """Victim pods whose eviction lets ``preemptor`` fit on ``info``;
-        [] when it already fits, None when no legal plan exists."""
+        [] when it already fits, None when no legal plan exists.
+        ``gang_memo`` caches per-gang (member count, footprint) across
+        cost evaluations — pass one dict per request so the combination
+        search never rescans the cluster pod table."""
+        if gang_memo is None:
+            gang_memo = {}
         req_chips = podutils.get_chips_from_pod_resource(preemptor)
         if req_chips > 0:
             return self._plan_node_chips(info, req_chips, preemptor,
-                                         preferred)
+                                         preferred, gang_memo)
         req_hbm = podutils.get_hbm_from_pod_resource(preemptor)
         if req_hbm <= 0:
             return None  # not a TPU pod; caller handles pass-through
@@ -136,14 +150,15 @@ class Preempt:
                                        preemptor, preferred)
             if plan is None:
                 continue
-            if best is None or (self._plan_cost(plan, preferred)
-                                < self._plan_cost(best, preferred)):
+            if best is None or (
+                    self._plan_cost(plan, preferred, info, gang_memo)
+                    < self._plan_cost(best, preferred, info, gang_memo)):
                 best = plan
         return None if best is None else self._dedup([p for p, _ in best])
 
     def _plan_node_chips(self, info: NodeInfo, req_chips: int,
-                         preemptor: Pod,
-                         preferred: set[str]) -> list[Pod] | None:
+                         preemptor: Pod, preferred: set[str],
+                         gang_memo: dict) -> list[Pod] | None:
         """The N-chip set whose *distinct-victim union* is cheapest.
 
         Chips cannot be costed independently: one multi-chip victim can
@@ -176,45 +191,141 @@ class Preempt:
         import math
 
         # comb(16,8)=12870: exact search covers every real host form
-        # factor (up to 16 chips); the greedy is a defensive fallback.
+        # factor (up to 16 chips); the greedy is the >16-chip fallback
+        # (exercised by tests/test_preempt.py's synthetic 32-chip host).
         if math.comb(len(clearable), req_chips) <= 13000:
             best = min(
                 (union_plan(combo) for combo in
                  itertools.combinations(sorted(clearable), req_chips)),
-                key=lambda pl: self._plan_cost(pl, preferred))
-        else:  # pragma: no cover - >16-chip hosts don't exist today
+                key=lambda pl: self._plan_cost(pl, preferred, info,
+                                               gang_memo))
+        else:
             chosen: list[int] = []
             while len(chosen) < req_chips:
-                held = {p.uid for p, _ in union_plan(chosen)}
+                held_pods = union_plan(chosen)
+                held = {p.uid for p, _ in held_pods}
+                # Groups already doomed by a held member cost nothing
+                # more: their siblings' chips are free in practice, and
+                # the marginal cost must say so or the greedy would
+                # evict a pristine victim instead of finishing off a
+                # gang it has already condemned.
+                doomed = frozenset(
+                    (p.namespace, podutils.get_pod_group(p)[0])
+                    for p, _ in held_pods
+                    if podutils.get_pod_group(p)[0])
                 nxt = min(
                     (i for i in sorted(clearable) if i not in chosen),
                     key=lambda i: self._plan_cost(
                         [(p, c) for p, c in clearable[i]
-                         if p.uid not in held], preferred))
+                         if p.uid not in held], preferred, info,
+                        gang_memo, doomed))
                 chosen.append(nxt)
             best = union_plan(chosen)
         return self._dedup([p for p, _ in best])
 
-    @staticmethod
-    def _plan_cost(plan: list[tuple[Pod, int]],
-                   preferred: set[str]) -> tuple[int, int, int, int, int]:
+    def _pod_footprint(self, pod: Pod, info: NodeInfo | None) -> int:
+        """A victim's FULL granted HBM footprint in GiB — what eviction
+        actually destroys, cluster-truth, not its share on the chips
+        under consideration. HBM pods carry the grant in their
+        annotation; whole-chip pods carry no HBM annotation (advisor
+        round-2 finding), so their footprint is every granted chip's full
+        HBM, read from their node's ledger (a 2-chip trainer destroyed to
+        free one chip still costs both chips)."""
+        hbm = podutils.get_hbm_from_pod_annotation(pod)
+        if hbm > 0:
+            return hbm
+        chip_ids = podutils.get_chip_ids_from_annotation(pod)
+        if not chip_ids:
+            return 0
+        node = None
+        if info is not None and pod.node_name == info.name:
+            node = info
+        elif pod.node_name:
+            node = self.cache.peek_node_info(pod.node_name)
+        if node is None:
+            return 0
+        return sum(node.chips[i].total_hbm for i in chip_ids
+                   if i in node.chips)
+
+    def _gang_price(self, key: tuple[str, str], fallback: Pod,
+                    info: NodeInfo | None,
+                    gang_memo: dict) -> tuple[int, int]:
+        """(member count, summed cluster footprint GiB) for gang ``key``,
+        memoized per request: the exact search evaluates up to ~13k
+        candidate plans and must not rescan the cluster pod table (under
+        the cache lock) for every one of them."""
+        priced = gang_memo.get(key)
+        if priced is None:
+            members = self.cache.gang_members(*key) or [fallback]
+            priced = (len(members),
+                      sum(self._pod_footprint(m, info) for m in members))
+            gang_memo[key] = priced
+        return priced
+
+    def _plan_cost(self, plan: list[tuple[Pod, int]], preferred: set[str],
+                   info: NodeInfo | None, gang_memo: dict,
+                   doomed: frozenset = frozenset(),
+                   ) -> tuple[int, int, int, int, int]:
         """Compare eviction plans across chips. Criteria order follows
         upstream k8s preemption (``pickOneNodeForPreemption``): the
         highest victim priority is minimized FIRST — disruption lands on
         the lowest-priority workloads even when that means more victims
         (two priority-0 slices die before one priority-5 trainer). Then
-        fewest gang members stranded, then fewest victims *beyond* what
-        the scheduler already nominated, then fewest victims, then the
-        least HBM destroyed — each victim priced at its FULL granted
-        footprint, not just its share on the chips under consideration
-        (a 2-chip trainer destroyed to free one chip still costs both
-        chips' HBM)."""
+        fewest GANG MEMBERS STRANDED — a gang victim drags its whole
+        group down, so it counts every cluster-wide member while a lone
+        pod counts 0: a lone pod always beats a same-priority gang member
+        at any size, and a 4-member gang beats a 16-member one. Then
+        fewest victims beyond what the scheduler already nominated, then
+        fewest in-plan victims, then least HBM destroyed — each victim at
+        full granted footprint (:meth:`_pod_footprint`), gang victims at
+        their group's summed cluster-wide footprint."""
+        stranded = 0
+        hbm = 0
+        gangs_seen: set[tuple[str, str]] = set(doomed)
+        for p, c in plan:
+            group, _ = podutils.get_pod_group(p)
+            if group:
+                key = (p.namespace, group)
+                if key in gangs_seen:
+                    continue  # whole gang already priced (or doomed: 0)
+                gangs_seen.add(key)
+                count, footprint = self._gang_price(key, p, info, gang_memo)
+                stranded += count
+                hbm += footprint
+            else:
+                hbm += self._pod_footprint(p, info) or c
         return (max((p.priority for p, _ in plan), default=-1),
-                sum(1 for p, _ in plan if podutils.is_gang_pod(p)),
+                stranded,
                 sum(1 for p, _ in plan if p.uid not in preferred),
                 len(plan),
-                sum(podutils.get_hbm_from_pod_annotation(p) or c
-                    for p, c in plan))
+                hbm)
+
+    def expand_gang_victims(self, plan: list[Pod],
+                            node: str) -> list[Pod]:
+        """Close the victim set over gang membership ON ``node``: if any
+        member of a committed gang dies, the job is bricked, so every
+        sibling on the same node is named too and its chips come back
+        with the eviction.
+
+        Only same-node siblings can go on the wire: the scheduler
+        resolves each meta-victim UID against THAT node's pod list
+        (upstream ``convertToVictims``), so a cross-node UID would abort
+        the whole preemption attempt. Siblings on other nodes are
+        reclaimed by the controller's gang reaper when it observes the
+        eviction drop the group below quorum
+        (:meth:`tpushare.controller.controller.Controller` pod-delete
+        path)."""
+        out = list(plan)
+        seen = {p.uid for p in plan}
+        for p in plan:
+            group, _ = podutils.get_pod_group(p)
+            if not group:
+                continue
+            for member in self.cache.gang_members(p.namespace, group):
+                if member.uid not in seen and member.node_name == node:
+                    seen.add(member.uid)
+                    out.append(member)
+        return out
 
     @staticmethod
     def _dedup(pods: list[Pod]) -> list[Pod]:
@@ -242,14 +353,20 @@ class Preempt:
                 result.pdb_violations[name] = victims.num_pdb_violations
             return result
 
+        gang_memo: dict = {}  # per-request (ns, group) pricing cache
         for name, victims in args.node_victims.items():
             info = self.cache.get_node_info(name)
             if info is None:
                 continue  # node vanished; drop it from the candidates
             nominated = victims.victim_uids()
-            plan = self.plan_node(info, pod, set(nominated))
+            plan = self.plan_node(info, pod, set(nominated), gang_memo)
             if plan is None:
                 continue  # no legal eviction set frees enough TPU capacity
+            # Whole-gang closure: a gang member in the plan dooms its
+            # entire group, so every same-node sibling is named too —
+            # their chips come back now, not at TTL expiry (cross-node
+            # siblings: controller gang reaper).
+            plan = self.expand_gang_victims(plan, name)
             # UNION with the scheduler's own nominations: the scheduler
             # replaces its victim map with this response, so dropping a
             # CPU/memory victim it needs would livelock the preemptor.

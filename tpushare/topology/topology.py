@@ -14,6 +14,7 @@ future multi-chip pods).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import lru_cache
 from itertools import combinations
 
 #: Per-chip HBM GiB by generation (public specs; used by discovery when the
@@ -72,6 +73,7 @@ class Topology:
             n *= d
         return n
 
+    @lru_cache(maxsize=None)
     def coords(self, idx: int) -> tuple[int, ...]:
         """Row-major index → coordinate tuple."""
         if not 0 <= idx < self.chip_count:
@@ -88,6 +90,7 @@ class Topology:
             idx = idx * d + c
         return idx
 
+    @lru_cache(maxsize=None)
     def distance(self, a: int, b: int) -> int:
         """ICI hop distance (Manhattan on the mesh, wrapped on a torus)."""
         ca, cb = self.coords(a), self.coords(b)
@@ -133,6 +136,11 @@ class Topology:
             return None
         if k == 1:
             return [free[0]]
+        if len(free) == k:
+            # Taking every free chip: there is exactly one choice, and a
+            # whole-host grant (the common slice-gang member shape) must
+            # not pay the O(n^3) seeded search for it.
+            return sorted(free)
         best: list[int] | None = None
         best_cost = None
         for seed in free:

@@ -94,6 +94,15 @@ ANN_POD_GROUP = "tpushare.io/pod-group"
 #: is bound (all-or-nothing admission).
 ANN_POD_GROUP_MIN = "tpushare.io/pod-group-min"
 
+#: Set to "false" to disable the controller's gang reaper for this group:
+#: by default, when an ASSIGNED member of a gang dies mid-run (eviction,
+#: preemption, node failure) and the group drops below its minimum, the
+#: surviving members are deleted too — they cannot make progress without
+#: quorum, and squatting on whole TPU hosts until a human notices is the
+#: exact failure mode gang semantics exist to prevent. A recreating owner
+#: (Job/JobSet) then restarts the WHOLE group, which re-gangs atomically.
+ANN_POD_GROUP_REAP = "tpushare.io/pod-group-reap"
+
 # --------------------------------------------------------------------------
 # Environment variables injected into containers by the device plugin at
 # Allocate() time (counterpart of the reference's SHARED_GPU_MEM_* env

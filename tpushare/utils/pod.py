@@ -65,13 +65,29 @@ def is_gang_pod(pod: Pod) -> bool:
 # --------------------------------------------------------------------------
 
 def get_hbm_from_pod_resource(pod: Pod) -> int:
-    """Sum of ``tpu-hbm`` limits across containers, GiB."""
-    return sum(pod.iter_resource_limits(const.HBM_RESOURCE))
+    """Sum of ``tpu-hbm`` limits across containers, GiB.
+
+    Memoized on the Pod instance: the filter verb re-reads the SAME pod
+    object once per candidate node (a fleet-wide walk), and container
+    limits are immutable for a pod's lifetime — re-parsing quantity
+    strings per node was measurable on the hot path."""
+    try:
+        return pod._req_hbm_memo
+    except AttributeError:
+        val = sum(pod.iter_resource_limits(const.HBM_RESOURCE))
+        pod._req_hbm_memo = val
+        return val
 
 
 def get_chips_from_pod_resource(pod: Pod) -> int:
-    """Sum of whole-chip limits across containers."""
-    return sum(pod.iter_resource_limits(const.CHIP_RESOURCE))
+    """Sum of whole-chip limits across containers (memoized like
+    :func:`get_hbm_from_pod_resource`)."""
+    try:
+        return pod._req_chips_memo
+    except AttributeError:
+        val = sum(pod.iter_resource_limits(const.CHIP_RESOURCE))
+        pod._req_chips_memo = val
+        return val
 
 
 # --------------------------------------------------------------------------
