@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: test chipcheck native bench all
+.PHONY: test chipcheck native bench bench-workload all
 
 # CPU test suite (virtual 8-device mesh; kernels in interpreter mode).
 test:
@@ -19,5 +19,10 @@ native:
 # Scheduling benchmark (prints the one-line JSON contract).
 bench:
 	python bench.py
+
+# On-chip workload perf: flash-vs-XLA attention + flagship MFU, with
+# regression gates — REQUIRES real TPU hardware (chipcheck's perf twin).
+bench-workload:
+	python bench_workload.py --gate
 
 all: native test

@@ -316,3 +316,44 @@ class TestGangReaper:
             assert api.get_pod("default", "m2") is not None
         finally:
             c.stop()
+
+    def test_reap_does_not_cascade_to_replacements(self, api):
+        """The reaper's own deletions must not re-trigger reaping: by
+        the time their delete events drain, the owner may already have
+        recreated members, and killing the (unassigned) replacements
+        would loop the group forever."""
+        from tpushare.utils import const
+        self._hosts(api)
+        for i in range(3):
+            self._gang_pod(api, f"m{i}", f"host-{i}")
+        c = start_controller(api)
+        try:
+            api.delete_pod("default", "m0")
+            assert self._wait_gone(api, ["m1", "m2"])
+            # Owner recreates all three members: fresh, unassigned.
+            for i in range(3):
+                ann = {const.ANN_POD_GROUP: "trainjob",
+                       const.ANN_POD_GROUP_MIN: "3"}
+                api.create_pod(make_pod(f"m{i}-new", chips=4,
+                                        annotations=ann))
+            assert c.wait_idle()
+            time.sleep(0.15)  # let every queued delete event drain
+            for i in range(3):
+                assert api.get_pod("default", f"m{i}-new") is not None
+        finally:
+            c.stop()
+
+    def test_follower_replica_never_reaps(self, api):
+        self._hosts(api)
+        for i in range(3):
+            self._gang_pod(api, f"m{i}", f"host-{i}")
+        c = Controller(api, is_leader=lambda: False)
+        c.start(workers=2)
+        try:
+            api.delete_pod("default", "m0")
+            assert c.wait_idle()
+            time.sleep(0.1)
+            assert api.get_pod("default", "m1") is not None
+            assert api.get_pod("default", "m2") is not None
+        finally:
+            c.stop()

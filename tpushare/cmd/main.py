@@ -76,8 +76,9 @@ def build_stack(client, is_leader=None) -> Stack:
 
     ``is_leader`` (``() -> bool``) gates the gang planner's housekeeping
     retries so a demoted leader stops POSTing member bindings (its /bind
-    route is already follower-gated by the HTTP layer)."""
-    controller = Controller(client)
+    route is already follower-gated by the HTTP layer), and the
+    controller's gang reaper so only one replica issues deletions."""
+    controller = Controller(client, is_leader=is_leader)
     # Quorum pre-checks enumerate nodes from the informer store — no
     # apiserver LIST on the bind path.
     gang = GangPlanner(controller.cache, client,

@@ -31,14 +31,23 @@ log = logging.getLogger(__name__)
 
 
 class Controller:
-    def __init__(self, client, hub: InformerHub | None = None):
+    def __init__(self, client, hub: InformerHub | None = None,
+                 is_leader=None):
         self.client = client
         self.hub = hub or InformerHub(client)
         self.queue = RateLimitedQueue()
         self.cache = SchedulerCache(self._get_node, self._list_pods)
+        #: ``() -> bool`` — gates apiserver WRITES this controller
+        #: originates (today: the gang reaper). Reads/ledger upkeep run
+        #: on every replica; deletes from N replicas would multiply.
+        self._is_leader = is_leader or (lambda: True)
         #: ns/name -> last seen Pod, for deletes (reference removePodCache)
         self._removed: dict[str, Pod] = {}
         self._removed_lock = threading.Lock()
+        #: uids the gang reaper itself deleted: their delete events must
+        #: not re-trigger reaping (the cascade would race the owning
+        #: Job's freshly recreated replacement pods).
+        self._reaped_uids: set[str] = set()
         self._workers: list[threading.Thread] = []
         self._stop = threading.Event()
 
@@ -144,18 +153,40 @@ class Controller:
             # Finished naturally (survivors are fine) or never granted
             # chips (the gang planner's TTL rollback owns reservations).
             return
+        if not dead.node_name:
+            # Assigned but never BOUND: the gang was still forming, and
+            # formation failures are the planner's TTL-rollback domain —
+            # reaping reserved peers would reset groups that can still
+            # recruit. nodeName is only ever set via the binding
+            # subresource, so its presence == the gang committed.
+            return
+        with self._removed_lock:
+            if dead.uid in self._reaped_uids:
+                # Our own reap: do NOT cascade — the owner may already be
+                # recreating members, and counting/killing those would
+                # loop the whole group forever.
+                self._reaped_uids.discard(dead.uid)
+                return
+        if not self._is_leader():
+            return  # one replica reaps; N replicas would race the owner
         if dead.annotations.get(const.ANN_POD_GROUP_REAP, "").lower() in (
                 "false", "0", "no"):
             return
+        # Only ASSUMED members count and die: they are the ones holding
+        # chips. A recreated replacement (same group annotation, not yet
+        # scheduled) neither props up the quorum count nor gets killed.
         survivors = [
             p for p in self.hub.pods.list()
             if p.namespace == dead.namespace
             and p.annotations.get(const.ANN_POD_GROUP) == group
             and p.uid != dead.uid
+            and podutils.is_assumed(p)
             and not podutils.is_complete_pod(p)
         ]
         if not survivors or len(survivors) >= minimum:
             return  # group gone already, or still at/above quorum
+        with self._removed_lock:
+            self._reaped_uids.update(p.uid for p in survivors)
         log.warning(
             "gang %s/%s below quorum after %s died (%d survivors < min "
             "%d); reaping survivors to free their chips",

@@ -415,10 +415,13 @@ class GangPlanner:
                 for uid, outcome in outcomes:
                     err = self._apply_binding_outcome(group, uid, outcome)
                     if err is not None:
-                        pod, _ = group.reservations[uid]
+                        # .get: a racing commit's fold may have dropped
+                        # this reservation ("gone") while our POST was
+                        # in flight — the lock is released during POSTs.
+                        entry = group.reservations.get(uid)
                         log.warning("gang %s/%s: binding %s failed (%s); "
                                     "will retry", key[0], group.name,
-                                    pod.name, err)
+                                    entry[0].name if entry else uid, err)
                         if uid == current_uid:
                             current_error = err
         with group.lock:
