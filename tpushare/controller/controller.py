@@ -202,6 +202,11 @@ class Controller:
             except NotFoundError:
                 pass  # raced another reaper pass / the owner
             except ApiError as e:
+                # Un-mark it: the delete never happened, so this pod's
+                # EVENTUAL death must retrigger the reaper rather than
+                # be swallowed by the own-reap guard.
+                with self._removed_lock:
+                    self._reaped_uids.discard(p.uid)
                 log.warning("gang reap of %s failed (%s); its deletion "
                             "will retrigger the reaper", p.key(), e)
 

@@ -39,12 +39,18 @@ from tpushare.workload import model as M
 def to_varying(x, axes):
     """Tag ``x`` as device-varying over ``axes`` (shard_map's typed
     collectives require fresh scan carries to match the loop outputs'
-    varying-manual-axes type). One home for the pcast/pvary API shim —
-    pvary was deprecated in favor of ``pcast(..., to="varying")``."""
+    varying-manual-axes type). Idempotent: an already-varying value
+    (e.g. ``zeros_like`` of a sharded input) passes through untouched.
+    One home for the pcast/pvary API shim — pvary was deprecated in
+    favor of ``pcast(..., to="varying")``."""
     try:
         return jax.lax.pcast(x, tuple(axes), to="varying")
     except (AttributeError, TypeError):  # pragma: no cover - older jax
         return jax.lax.pvary(x, tuple(axes))
+    except ValueError as e:
+        if "varying" in str(e):
+            return x  # already varying over these axes: idempotent
+        raise  # unrelated pcast failure (e.g. unknown axis name)
 
 def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
               devices=None) -> Mesh:
