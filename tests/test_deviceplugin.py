@@ -697,3 +697,94 @@ def test_preferred_ids_batch_advances_span_per_container():
     assert second == ["tpushare-chip-02", "tpushare-chip-03"]
     # Preference is speculative: nothing persisted.
     assert plugin._partial_chips == {}
+
+
+class TestPartialGrantCheckpoint:
+    """Plugin restart between a multi-container pod's Allocate calls:
+    the served-span state is checkpointed to disk (kubelet's own
+    kubelet_internal_checkpoint pattern) so the next container still
+    takes its CONSECUTIVE planned span instead of re-serving span 0."""
+
+    def _mcchip_pod(self, api):
+        doc = make_pod("mcchip", node_name="host-a",
+                       annotations={
+                           const.ANN_CHIP_IDX: "0,1,2,3",
+                           const.ANN_HBM_POD: "64",
+                           const.ANN_HBM_CHIP: "16",
+                           const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+                           const.ANN_ASSUME_TIME: "1",
+                       })
+        doc["spec"]["containers"] = [
+            {"name": f"c{i}",
+             "resources": {"limits": {const.CHIP_RESOURCE: "2"}}}
+            for i in range(2)]
+        api.create_pod(doc)
+
+    def test_restart_between_containers_serves_next_span(self, tmp_path):
+        api = FakeApiServer()
+        api.create_node(make_node("host-a", chips=4, hbm_per_chip=16))
+        inv = disc.fake_inventory(chips=4, hbm_gib=16)
+        self._mcchip_pod(api)
+
+        p1 = TPUSharePlugin("host-a", api, inv, state_dir=str(tmp_path))
+        a1 = p1.allocate_chips(["tpushare-chip-00", "tpushare-chip-01"])
+        assert a1.envs[const.ENV_TPU_VISIBLE_CHIPS] == "0,1"
+
+        # Plugin restarts (new process, same state dir): container 2's
+        # Allocate must continue at span 2,3 — NOT re-serve 0,1.
+        p2 = TPUSharePlugin("host-a", api, inv, state_dir=str(tmp_path))
+        a2 = p2.allocate_chips(["tpushare-chip-02", "tpushare-chip-03"])
+        assert a2.envs[const.ENV_TPU_VISIBLE_CHIPS] == "2,3"
+        assert api.get_pod("default", "mcchip").annotations[
+            const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+    def test_completed_pod_clears_checkpoint(self, tmp_path):
+        """Once the pod fully commits, its checkpoint entry is gone — a
+        later restart starts clean."""
+        import json as _json
+
+        api = FakeApiServer()
+        api.create_node(make_node("host-a", chips=4, hbm_per_chip=16))
+        inv = disc.fake_inventory(chips=4, hbm_gib=16)
+        self._mcchip_pod(api)
+        p = TPUSharePlugin("host-a", api, inv, state_dir=str(tmp_path))
+        p.allocate_chips(["tpushare-chip-00", "tpushare-chip-01"])
+        p.allocate_chips(["tpushare-chip-02", "tpushare-chip-03"])
+        doc = _json.loads(
+            (tmp_path / "tpushare_grants.json").read_text())
+        assert doc == {"hbm": {}, "chips": {}}
+
+    def test_corrupt_checkpoint_starts_clean(self, tmp_path):
+        api = FakeApiServer()
+        api.create_node(make_node("host-a", chips=4, hbm_per_chip=16))
+        inv = disc.fake_inventory(chips=4, hbm_gib=16)
+        (tmp_path / "tpushare_grants.json").write_text("{not json")
+        p = TPUSharePlugin("host-a", api, inv, state_dir=str(tmp_path))
+        assert p._partial == {} and p._partial_chips == {}
+
+    def test_pruned_pod_leaves_checkpoint(self, tmp_path):
+        """A mid-allocation pod deleted from the apiserver is pruned
+        from the checkpoint on the next Allocate."""
+        import json as _json
+
+        api = FakeApiServer()
+        api.create_node(make_node("host-a", chips=4, hbm_per_chip=16))
+        inv = disc.fake_inventory(chips=4, hbm_gib=16)
+        self._mcchip_pod(api)
+        p = TPUSharePlugin("host-a", api, inv, state_dir=str(tmp_path))
+        p.allocate_chips(["tpushare-chip-00", "tpushare-chip-01"])
+        api.delete_pod("default", "mcchip")
+        # A fresh single-container chip pod allocates; the stale entry
+        # is pruned and the checkpoint reflects it.
+        api.create_pod(make_pod(
+            "fresh", node_name="host-a",
+            annotations={const.ANN_CHIP_IDX: "2",
+                         const.ANN_HBM_POD: "16",
+                         const.ANN_HBM_CHIP: "16",
+                         const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+                         const.ANN_ASSUME_TIME: "2"},
+            chips=1))
+        p.allocate_chips(["tpushare-chip-02"])
+        doc = _json.loads(
+            (tmp_path / "tpushare_grants.json").read_text())
+        assert doc["chips"] == {}

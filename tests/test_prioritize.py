@@ -197,3 +197,75 @@ class TestPrioritizeWire:
             assert 0 <= doc[0]["Score"] <= 10
         finally:
             server.shutdown()
+
+
+class TestIntraSliceAdjacency:
+    """Within a multi-host slice, gang placement prefers hosts
+    ICI-ADJACENT to reserved members over same-slice-but-far hosts
+    (round-2 verdict: the flat slice-id bonus could not see the
+    difference between one hop and the far corner of the torus)."""
+
+    def _slice_16(self, api):
+        """A virtual 8x8 v5e slice of 2x2 hosts: a 4x4 host grid,
+        workers 0-15 row-major."""
+        for w in range(16):
+            api.create_node(make_node(
+                f"host-{w:02d}", chips=4, hbm_per_chip=16,
+                topology="2x2", tpu_type="v5e", slice_id="pod-slice",
+                slice_topology="8x8", worker_index=w))
+        # One host on a different slice entirely.
+        api.create_node(make_node(
+            "other-slice", chips=4, hbm_per_chip=16, topology="2x2",
+            tpu_type="v5e", slice_id="slice-b", slice_topology="8x8",
+            worker_index=0))
+        return SchedulerCache(api.get_node, api.list_pods)
+
+    def test_adjacent_host_beats_far_corner(self, api):
+        cache = self._slice_16(api)
+        planner = GangPlanner(cache, api, ttl=60)
+        ann = {const.ANN_POD_GROUP: "big", const.ANN_POD_GROUP_MIN: "4"}
+        w0 = api.create_pod(make_pod("w0", chips=4, annotations=ann))
+        with pytest.raises(GangPending):
+            planner.bind_member(w0, "host-05")  # coords (1, 1)
+
+        prio = Prioritize(cache, gang_planner=planner)
+        w1 = make_pod("w1", chips=4, annotations=ann)
+        s = scores(prio, w1, ["host-06",      # (1,2): one hop
+                              "host-15",      # (3,3): four hops
+                              "other-slice"])  # DCN away
+        assert s["host-06"] > s["host-15"], s
+        assert s["host-15"] > s["other-slice"], s
+
+    def test_flat_bonus_without_worker_indices(self, api):
+        """Slice ids but no worker indices: every same-slice host gets
+        the full flat bonus (no adjacency data to discriminate on)."""
+        for name in ("a", "b", "c"):
+            api.create_node(make_node(name, chips=4, hbm_per_chip=16,
+                                      topology="2x2", tpu_type="v5e",
+                                      slice_id="s1"))
+        api.create_node(make_node("far", chips=4, hbm_per_chip=16,
+                                  topology="2x2", tpu_type="v5e",
+                                  slice_id="s2"))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60)
+        ann = {const.ANN_POD_GROUP: "g", const.ANN_POD_GROUP_MIN: "3"}
+        w0 = api.create_pod(make_pod("w0", chips=4, annotations=ann))
+        with pytest.raises(GangPending):
+            planner.bind_member(w0, "a")
+        prio = Prioritize(cache, gang_planner=planner)
+        s = scores(prio, make_pod("w1", chips=4, annotations=ann),
+                   ["b", "c", "far"])
+        assert s["b"] == s["c"] > s["far"], s
+
+    def test_inspect_surfaces_host_coords(self, api):
+        from tpushare.scheduler.inspect import Inspect
+
+        self._slice_16(api)
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        cache.get_node_info("host-06")
+        inspect = Inspect(cache, api.list_nodes)
+        doc = inspect.handle("host-06")
+        node = doc["nodes"][0]
+        assert node["workerIndex"] == 6
+        assert node["hostCoords"] == [1, 2]
+        assert node["sliceTopology"] == "8x8"

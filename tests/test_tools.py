@@ -43,6 +43,74 @@ class TestPprofEndpoints:
         # the serving thread itself shows up with stack frames joined by ';'
         assert ";" in body or "samples" in body
 
+    def test_block_profile_catches_cond_waiters(self, cluster):
+        """The block-profile half: a thread parked in a condition/event
+        wait shows up with its full call path. (Raw C-level
+        ``Lock.acquire`` leaves no Python frame — that case is the
+        mutex profile's job, below.)"""
+        import threading
+
+        gate = threading.Event()
+        started = threading.Event()
+
+        def contender():
+            started.set()
+            gate.wait(10)  # parks in threading.py Condition.wait
+
+        t = threading.Thread(target=contender,
+                             name="contention-victim", daemon=True)
+        t.start()
+        started.wait(2)
+        try:
+            status, body = _get(
+                cluster, "/debug/pprof/block?seconds=0.3&hz=50")
+        finally:
+            gate.set()
+            t.join(2)
+        assert status == 200
+        assert body.startswith("# lock-wait profile")
+        assert "contender" in body  # the blocked call path, attributed
+
+    def test_mutex_profile_records_contended_ledger_locks(self, cluster):
+        """The mutex-profile half: a CONTENDED TracingRLock acquire is
+        recorded by site with wait time; uncontended acquires are not."""
+        import threading
+        import time as _time
+
+        from tpushare.utils import locks
+
+        locks.reset_contention()
+        lk = locks.TracingRLock("test/ledger")
+        with lk:  # uncontended: must not record
+            pass
+        assert "test/ledger" not in locks.contention_snapshot()
+
+        hold = threading.Event()
+
+        def holder():
+            with lk:
+                hold.set()
+                _time.sleep(0.05)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        hold.wait(2)
+        with lk:  # contended: recorded with the wait duration
+            pass
+        t.join(2)
+        snap = locks.contention_snapshot()
+        assert snap["test/ledger"][0] == 1
+        assert snap["test/ledger"][1] > 0.01
+
+        status, body = _get(cluster, "/debug/pprof/mutex")
+        assert status == 200
+        assert "mutex profile" in body and "test/ledger" in body
+
+    def test_block_profile_index_listed(self, cluster):
+        status, body = _get(cluster, "/debug/pprof")
+        assert status == 200 and "/debug/pprof/block" in body
+        assert "/debug/pprof/mutex" in body
+
     def test_heap_snapshot_and_stop(self, cluster):
         import tracemalloc
 

@@ -21,6 +21,7 @@ import logging
 
 from tpushare.api.objects import Node, Pod
 from tpushare.cache.nodeinfo import NodeInfo
+from tpushare.utils import locks
 from tpushare.utils import const
 from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
@@ -42,7 +43,7 @@ class SchedulerCache:
         #: that fetched the node doc before the delete cannot re-insert
         #: a zombie ledger afterwards.
         self._node_epochs: dict[str, int] = {}
-        self._lock = threading.RLock()
+        self._lock = locks.TracingRLock("cache/table")
 
     # ------------------------------------------------------------------ #
     # Known-pod set (reference cache.go:76-87)

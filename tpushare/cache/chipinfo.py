@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 
+from tpushare.utils import locks
 from tpushare.api.objects import Pod
 from tpushare.utils import pod as podutils
 
@@ -28,7 +29,7 @@ class ChipInfo:
         #: a set, not a counter, so it cannot drift if a stored pod's
         #: status document is mutated in place between add and remove.
         self._active: set[str] = set()
-        self._lock = threading.RLock()
+        self._lock = locks.TracingRLock(f"chip/{idx}")
 
     def _contribution(self, pod: Pod) -> int:
         """What ``pod`` pins on this chip.

@@ -21,6 +21,7 @@ from __future__ import annotations
 import threading
 import time
 
+from tpushare.utils import locks
 from tpushare.api.objects import Node, Pod, binding_doc
 from tpushare.cache.chipinfo import ChipInfo
 from tpushare.k8s.errors import ConflictError
@@ -66,7 +67,7 @@ class NodeInfo:
                 "falling back to flat", self.name, topo_spec,
                 self.topology.chip_count, self.chip_count)
             self.topology = Topology.flat(self.chip_count)
-        self._lock = threading.RLock()
+        self._lock = locks.TracingRLock(f"node/{self.name}")
 
     # ------------------------------------------------------------------ #
     # Ledger bookkeeping (reference nodeinfo.go:72-110)

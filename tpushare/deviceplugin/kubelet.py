@@ -290,7 +290,8 @@ def run_node_daemon(node_name: str, client, inventory,
                     poll_interval: float = 5.0) -> list[PluginServer]:
     """Full node bootstrap: annotate the node, then advertise both
     resources (the daemon entrypoint wires discovery into this)."""
-    plugin = TPUSharePlugin(node_name, client, inventory)
+    plugin = TPUSharePlugin(node_name, client, inventory,
+                            state_dir=plugin_dir)
     plugin.annotate_node()
     servers = []
     for resource in (const.HBM_RESOURCE, const.CHIP_RESOURCE):

@@ -26,6 +26,7 @@ logic so it is fully testable against the fake apiserver.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import threading
@@ -72,7 +73,8 @@ class TPUSharePlugin:
     """The node-local half of the two-phase commit protocol."""
 
     def __init__(self, node_name: str, client, inventory: HostInventory,
-                 headroom: float | None = None):
+                 headroom: float | None = None,
+                 state_dir: str | None = None):
         self.node_name = node_name
         self.client = client
         self.inventory = inventory
@@ -83,10 +85,20 @@ class TPUSharePlugin:
         #: container and committed only when its full request is served.
         self._partial: dict[str, list[int]] = {}
         self._partial_chips: dict[str, list[int]] = {}
+        #: Partial-grant CHECKPOINT file (kubelet persists its own
+        #: device state as kubelet_internal_checkpoint for exactly this
+        #: reason): a plugin restart between a multi-container pod's
+        #: Allocate calls must not forget served spans — the next
+        #: container would re-match from scratch and double-serve span
+        #: 0 / break planned-span consistency. None disables (tests).
+        self._state_path = (os.path.join(state_dir,
+                                         "tpushare_grants.json")
+                            if state_dir else None)
         #: Serializes match->record->commit: concurrent Allocate RPCs
         #: (the gRPC servicer runs on a thread pool) must not both match
         #: the same pending container.
         self._alloc_lock = threading.Lock()
+        self._load_state()
 
     # ------------------------------------------------------------------ #
     # Advertisement (reference: ListAndWatch reporting gpu-mem totals)
@@ -255,6 +267,8 @@ class TPUSharePlugin:
                 table.pop(uid, None)
             else:
                 table[uid] = staged[uid]
+        if touched:
+            self._save_state()
         return allocations
 
     @staticmethod
@@ -388,10 +402,57 @@ class TPUSharePlugin:
     def _prune_partials(self, live_uids: set[str]) -> None:
         """Drop partial-allocation state for pods that vanished (deleted
         between container allocations)."""
+        dropped = False
         for table in (self._partial, self._partial_chips):
             for uid in list(table):
                 if uid not in live_uids:
                     del table[uid]
+                    dropped = True
+        if dropped:
+            self._save_state()
+
+    # -- partial-grant checkpoint --------------------------------------- #
+
+    def _load_state(self) -> None:
+        if not self._state_path:
+            return
+        try:
+            with open(self._state_path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if not isinstance(doc, dict):
+                raise ValueError(f"checkpoint root is {type(doc).__name__},"
+                                 " not an object")
+            self._partial = {str(u): [int(g) for g in v]
+                             for u, v in (doc.get("hbm") or {}).items()}
+            self._partial_chips = {
+                str(u): [int(g) for g in v]
+                for u, v in (doc.get("chips") or {}).items()}
+            if self._partial or self._partial_chips:
+                log.info("restored partial-grant checkpoint: %d hbm / "
+                         "%d chip pods mid-allocation",
+                         len(self._partial), len(self._partial_chips))
+        except FileNotFoundError:
+            pass
+        except (OSError, ValueError, TypeError, AttributeError) as e:
+            # A corrupt checkpoint must not brick the plugin: start
+            # empty — worst case a mid-allocation pod fails its next
+            # container and kubelet readmits it under a fresh uid.
+            log.warning("partial-grant checkpoint unreadable (%s); "
+                        "starting clean", e)
+
+    def _save_state(self) -> None:
+        """Atomic write (tmp + rename), same pattern kubelet uses for
+        its own checkpoint file."""
+        if not self._state_path:
+            return
+        tmp = self._state_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"hbm": self._partial,
+                           "chips": self._partial_chips}, f)
+            os.replace(tmp, self._state_path)
+        except OSError as e:  # pragma: no cover - disk trouble
+            log.warning("partial-grant checkpoint write failed: %s", e)
 
     # -- commit --------------------------------------------------------- #
 

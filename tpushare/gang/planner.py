@@ -36,6 +36,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from tpushare.utils import locks
 from tpushare.api.objects import Pod, binding_doc
 from tpushare.cache.nodeinfo import AllocationError
 from tpushare.k8s import events
@@ -56,7 +57,10 @@ class _Group:
         self.minimum = minimum
         self.deadline = time.monotonic() + ttl
         self.committed = False
-        self.lock = threading.RLock()
+        # One shared site, not per-gang: gang names are unbounded over
+        # the extender's lifetime and the contention registry keeps
+        # every site it ever sees.
+        self.lock = locks.TracingRLock("gang/group")
         #: uid -> (annotated pod, node name)
         self.reservations: dict[str, tuple[Pod, str]] = {}
         #: uids whose binding POST succeeded

@@ -50,7 +50,8 @@ def make_pod(name: str, hbm: int = 0, chips: int = 0,
 def make_node(name: str, chips: int = 4, hbm_per_chip: int = 16,
               topology: str = "2x2x1", tpu_type: str = "v5e",
               chip_hbm: list[int] | None = None,
-              slice_id: str = "") -> dict:
+              slice_id: str = "", slice_topology: str = "",
+              worker_index: int | None = None) -> dict:
     caps = chip_hbm if chip_hbm is not None else [hbm_per_chip] * chips
     annotations = {
         const.ANN_NODE_CHIP_HBM: ",".join(str(c) for c in caps),
@@ -59,6 +60,10 @@ def make_node(name: str, chips: int = 4, hbm_per_chip: int = 16,
     }
     if slice_id:
         annotations[const.ANN_NODE_SLICE] = slice_id
+    if slice_topology:
+        annotations[const.ANN_NODE_SLICE_TOPOLOGY] = slice_topology
+    if worker_index is not None:
+        annotations[const.ANN_NODE_WORKER] = str(worker_index)
     return {
         "apiVersion": "v1",
         "kind": "Node",

@@ -12,6 +12,12 @@ on the serving router. The analogues here:
   un-profiled server pays nothing.
 * ``goroutine`` — all-threads stack dump (``/debug/pprof/goroutine``,
   same payload as ``/debug/threads``).
+* ``block``     — lock-contention sampler (``/debug/pprof/block``, the
+  block/mutex-profile analogue the reference's Go suite mounted): the
+  CPU sampler restricted to threads parked in a lock/condition wait.
+  The extender is thread-per-request over shared ledgers, so lock
+  contention IS its plausible production pathology — this shows which
+  call paths sit blocked and on what.
 
 All return plain text, curl-friendly, like Go's pprof endpoints.
 """
@@ -65,7 +71,46 @@ def sample_profile(seconds: float = 5.0, hz: int = 100,
         _profile_lock.release()
 
 
-def _sample_profile_locked(seconds, hz, clock, sleep) -> str:
+#: Leaf frames that mean "this thread is parked waiting on a lock /
+#: condition / queue", by (function name, file basename). threading's
+#: pure-Python layer always has one of these on top of a blocked stack;
+#: a raw ``lock.acquire`` C call shows the caller's frame instead, which
+#: the ``acquire``/``wait`` name check still catches in threading.py and
+#: queue.py call sites.
+_BLOCKED_LEAVES = {
+    ("wait", "threading.py"),
+    ("acquire", "threading.py"),
+    ("wait_for", "threading.py"),
+    # Thread.join delegates to _wait_for_tstate_lock, whose C-level
+    # lock.acquire leaves THIS as the visible leaf (join itself is
+    # never the top frame on 3.12).
+    ("_wait_for_tstate_lock", "threading.py"),
+    ("join", "threading.py"),
+    ("get", "queue.py"),
+    ("put", "queue.py"),
+}
+
+
+def _stack_of(frame) -> list[str]:
+    stack = []
+    f = frame
+    while f is not None:
+        code = f.f_code
+        stack.append(f"{code.co_name} "
+                     f"({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})")
+        f = f.f_back
+    stack.reverse()
+    return stack
+
+
+def _is_blocked(frame) -> bool:
+    code = frame.f_code
+    return (code.co_name,
+            code.co_filename.rsplit("/", 1)[-1]) in _BLOCKED_LEAVES
+
+
+def _sample_profile_locked(seconds, hz, clock, sleep,
+                           blocked_only: bool = False) -> str:
     counts: collections.Counter[str] = collections.Counter()
     me = threading.get_ident()
     interval = 1.0 / max(hz, 1)
@@ -75,19 +120,32 @@ def _sample_profile_locked(seconds, hz, clock, sleep) -> str:
         for tid, frame in sys._current_frames().items():
             if tid == me:
                 continue
-            stack = []
-            f = frame
-            while f is not None:
-                code = f.f_code
-                stack.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})")
-                f = f.f_back
-            counts[";".join(reversed(stack))] += 1
+            if blocked_only and not _is_blocked(frame):
+                continue
+            counts[";".join(_stack_of(frame))] += 1
         samples += 1
         sleep(interval)
-    header = (f"# collapsed-stack profile: {samples} samples at {hz}Hz "
+    kind = "lock-wait" if blocked_only else "collapsed-stack"
+    header = (f"# {kind} profile: {samples} samples at {hz}Hz "
               f"over {seconds:.1f}s\n")
     body = "\n".join(f"{stack} {n}" for stack, n in counts.most_common())
     return header + body
+
+
+def sample_block_profile(seconds: float = 5.0, hz: int = 100,
+                         clock=time.monotonic, sleep=time.sleep) -> str:
+    """The block/mutex-profile analogue: collapsed stacks of threads
+    observed PARKED in a lock/condition/queue wait. Each line's count is
+    proportional to time spent blocked on that call path — the top entry
+    is the extender's hottest contention point. Shares the one-profiler
+    gate with :func:`sample_profile`."""
+    if not _profile_lock.acquire(blocking=False):
+        raise ProfileBusyError("a profile is already in progress")
+    try:
+        return _sample_profile_locked(seconds, hz, clock, sleep,
+                                      blocked_only=True)
+    finally:
+        _profile_lock.release()
 
 
 #: Serializes start/stop/snapshot on tracemalloc: concurrent ?stop=1 and
@@ -137,6 +195,10 @@ def index(prefix: str = "/debug/pprof") -> str:
     return (
         "tpushare pprof endpoints (reference pkg/routes/pprof.go analogue)\n"
         f"  {prefix}/profile?seconds=5&hz=100  CPU profile, collapsed stacks\n"
+        f"  {prefix}/block?seconds=5&hz=100    lock-contention profile "
+        "(threads parked in lock/cond waits)\n"
+        f"  {prefix}/mutex                     contended-lock registry "
+        "(per-site wait counts/time; exact, not sampled)\n"
         f"  {prefix}/heap[?stop=1]             live-allocation snapshot "
         "(stop=1 disables tracing)\n"
         f"  {prefix}/goroutine                 all-threads stack dump\n")

@@ -183,6 +183,23 @@ class _Handler(BaseHTTPRequestHandler):
                         pprof.sample_profile(seconds, hz).encode())
                 except pprof.ProfileBusyError as e:
                     self._send_json({"Error": str(e)}, 409)
+            elif path == "/debug/pprof/block":
+                q = self._query()
+                try:
+                    seconds = min(max(float(q.get("seconds", "5")), 0.1), 60.0)
+                    hz = min(max(int(q.get("hz", "100")), 1), 1000)
+                except ValueError:
+                    self._send_json(
+                        {"Error": "seconds/hz must be numeric"}, 400)
+                    return
+                try:
+                    self._send_text(
+                        pprof.sample_block_profile(seconds, hz).encode())
+                except pprof.ProfileBusyError as e:
+                    self._send_json({"Error": str(e)}, 409)
+            elif path == "/debug/pprof/mutex":
+                from tpushare.utils import locks
+                self._send_text(locks.render_mutex_profile().encode())
             elif path == "/debug/pprof/heap":
                 stop = self._query().get("stop") in ("1", "true")
                 self._send_text(pprof.heap_snapshot(stop=stop).encode())

@@ -55,7 +55,7 @@ class Inspect:
                 "usedHBM": used,
                 "pods": pods,
             })
-        return {
+        doc = {
             "name": info.name,
             "tpuType": nodeutils.get_tpu_type(info.node),
             "topology": nodeutils.get_topology(info.node),
@@ -64,6 +64,17 @@ class Inspect:
             "usedHBM": used_total,
             "chips": chips,
         }
+        # Position within a multi-host slice, when known: operators (and
+        # the what-if CLI) can see which hosts of a slice are grid
+        # neighbors — the adjacency gang placement optimizes for.
+        widx = nodeutils.get_worker_index(info.node)
+        if widx is not None:
+            doc["workerIndex"] = widx
+        pos = nodeutils.host_position(info.node)
+        if pos is not None:
+            doc["hostCoords"] = list(pos[0])
+            doc["sliceTopology"] = nodeutils.get_slice_topology(info.node)
+        return doc
 
     def handle(self, node_name: str | None = None) -> dict:
         """All nodes, or one (reference inspect.go:9-31)."""

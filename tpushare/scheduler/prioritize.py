@@ -70,7 +70,7 @@ class Prioritize:
         return max(0, min(MAX_SCORE, score))
 
     def _score_chips(self, info, req: int,
-                     member_slices: frozenset[str] = frozenset()) -> int:
+                     member_slices: dict | None = None) -> int:
         free = info.get_free_chips()
         if len(free) < req or info.chip_count == 0:
             return 0
@@ -99,21 +99,44 @@ class Prioritize:
             # hosts of different slices only share DCN. Steering the
             # gang's next worker onto a slice that already hosts a
             # member keeps the job's collectives off the datacenter
-            # network.
+            # network — and WITHIN the slice, onto a host ICI-adjacent
+            # to a member: one hop on the host grid beats the far
+            # corner of the torus (every extra hop is contended
+            # bandwidth on the job's all-reduce path).
             sid = nodeutils.get_slice_id(info.node)
             if sid and sid in member_slices:
-                score += 2
+                bonus = 2
+                member_coords = member_slices[sid]
+                pos = nodeutils.host_position(info.node)
+                if member_coords and pos is not None:
+                    coords, grid = pos
+                    d = min(grid.distance_coords(coords, mc)
+                            for mc in member_coords)
+                    # Adjacent (or same host) beats same-slice-far.
+                    bonus = 2 if d <= 1 else 1
+                score += bonus
         return max(0, min(MAX_SCORE, score))
 
     # ------------------------------------------------------------------ #
 
-    def _slice_of(self, node_name: str) -> str:
-        info = self.cache.get_node_info(node_name)
-        return nodeutils.get_slice_id(info.node) if info is not None else ""
-
-    def _member_slices(self, gang_nodes: set[str]) -> frozenset[str]:
-        """Slices already holding a reserved member of the gang."""
-        return frozenset(s for s in map(self._slice_of, gang_nodes) if s)
+    def _member_slices(self, gang_nodes: set[str]) -> dict:
+        """slice-id → tuple of member HOST COORDS already holding a
+        reserved member of the gang (empty tuple when members are on
+        the slice but their grid position is unknown — flat slice
+        affinity then applies)."""
+        placement: dict[str, tuple] = {}
+        for name in gang_nodes:
+            info = self.cache.get_node_info(name)
+            if info is None:
+                continue
+            sid = nodeutils.get_slice_id(info.node)
+            if not sid:
+                continue
+            coords = placement.setdefault(sid, ())
+            pos = nodeutils.host_position(info.node)
+            if pos is not None:
+                placement[sid] = coords + (pos[0],)
+        return placement
 
     def score_node(self, pod, node_name: str, gang_nodes: set[str]) -> int:
         """Convenience single-node entry (tests); ``handle`` inlines the
@@ -125,7 +148,7 @@ class Prioritize:
 
     def _score_one(self, node_name: str, req_chips: int, req_hbm: int,
                    gang_nodes: set[str],
-                   member_slices: frozenset[str] = frozenset()) -> int:
+                   member_slices: dict | None = None) -> int:
         info = self.cache.get_node_info(node_name)
         if info is None:
             return 0
@@ -148,7 +171,7 @@ class Prioritize:
         req_chips = podutils.get_chips_from_pod_resource(pod)
         req_hbm = podutils.get_hbm_from_pod_resource(pod)
         gang_nodes: set[str] = set()
-        member_slices: frozenset[str] = frozenset()
+        member_slices: dict = {}
         if self.gang_planner is not None and podutils.is_gang_pod(pod):
             gang_nodes = self.gang_planner.member_nodes(pod)
             if req_chips > 0 and gang_nodes:

@@ -93,7 +93,11 @@ class Topology:
     @lru_cache(maxsize=None)
     def distance(self, a: int, b: int) -> int:
         """ICI hop distance (Manhattan on the mesh, wrapped on a torus)."""
-        ca, cb = self.coords(a), self.coords(b)
+        return self.distance_coords(self.coords(a), self.coords(b))
+
+    def distance_coords(self, ca: tuple[int, ...],
+                        cb: tuple[int, ...]) -> int:
+        """Hop distance between two coordinate tuples."""
         total = 0
         for x, y, d in zip(ca, cb, self.dims):
             delta = abs(x - y)
@@ -158,3 +162,31 @@ class Topology:
     def free_neighbor_count(self, idx: int, free: set[int]) -> int:
         """How many of ``idx``'s ICI neighbors are in ``free``."""
         return sum(1 for nb in self.neighbors(idx) if nb in free)
+
+
+def slice_host_grid(slice_topo: str, host_topo: str,
+                    tpu_type: str = "") -> Topology | None:
+    """The HOST-level grid of a multi-host slice: slice chip dims
+    divided elementwise by host chip dims (e.g. an "8x8" v5e slice of
+    "2x2" hosts is a 4x4 host grid; a v5p "4x4x8" slice of "2x2x1"
+    hosts is a 2x2x8 host grid). Worker index i sits at
+    ``grid.coords(i)`` (row-major — the TPU runtime's numbering), and
+    ``grid.distance`` is the inter-host ICI hop count, torus-wrapped
+    where the slice itself wraps. None when either topology is missing,
+    malformed, or not an exact tiling."""
+    if not slice_topo or not host_topo:
+        return None
+    try:
+        s = parse_topology(slice_topo)
+        h = parse_topology(host_topo)
+    except ValueError:
+        return None
+    h = h + (1,) * (len(s) - len(h))
+    if len(h) > len(s) or any(si % hi for si, hi in zip(s, h)):
+        return None
+    dims = tuple(si // hi for si, hi in zip(s, h))
+    if all(d == 1 for d in dims):
+        return None  # single-host "slice": no inter-host grid
+    # Wraparound follows the SLICE topology (same rule as from_spec).
+    torus = tpu_type in ("v4", "v5p") and all(d >= 4 for d in s)
+    return Topology(dims=dims, torus=torus)

@@ -75,8 +75,22 @@ ANN_NODE_TPU_TYPE = "tpushare.io/tpu-type"
 #: placement prefers keeping a job's workers on one slice.
 ANN_NODE_SLICE = "tpushare.io/slice-id"
 
+#: Chip topology of the WHOLE slice (e.g. "8x8" for a v5e-64 pod slice
+#: of "2x2" hosts). Together with the host topology and worker index it
+#: locates this host on the slice's host grid, so gang placement can
+#: prefer ICI-adjacent hosts *within* the slice — a flat slice-id only
+#: says "same slice", not "one hop vs the far corner of the torus".
+ANN_NODE_SLICE_TOPOLOGY = "tpushare.io/slice-topology"
+
+#: This host's worker index within its multi-host slice (row-major over
+#: the host grid, matching the TPU runtime's worker numbering).
+ANN_NODE_WORKER = "tpushare.io/worker-index"
+
 # GKE well-known labels used as a discovery fallback by the device plugin.
 GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+#: Worker index of this node within a GKE multi-host TPU slice (set by
+#: the TPU webhook/runtime on multi-host node pools).
+GKE_TPU_WORKER_LABEL = "cloud.google.com/gke-tpu-worker-id"
 GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 #: All hosts of one GKE multi-host TPU slice live in one node pool, so the
 #: node-pool label is the slice-id fallback when the tpushare annotation

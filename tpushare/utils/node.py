@@ -93,6 +93,63 @@ def get_slice_id(node: Node) -> str:
     return node.labels.get(const.GKE_NODEPOOL_LABEL, "")
 
 
+def get_slice_topology(node: Node) -> str:
+    """Chip topology of the WHOLE multi-host slice (e.g. "8x8"); empty
+    when unknown or when the node is not part of a multi-host slice.
+
+    Reads the tpushare annotation first; the GKE topology label is the
+    fallback — on multi-host node pools that label carries the SLICE
+    dims (the per-host dims come from the chip inventory), which is
+    exactly the case where its volume exceeds this host's chip count."""
+    st = node.annotations.get(const.ANN_NODE_SLICE_TOPOLOGY, "")
+    if st:
+        return st
+    topo = node.labels.get(const.GKE_TPU_TOPOLOGY_LABEL, "")
+    if not topo:
+        return ""
+    try:
+        volume = 1
+        for part in topo.split("x"):
+            volume *= int(part)
+    except ValueError:
+        return ""
+    return topo if volume > get_chip_count(node) else ""
+
+
+def get_worker_index(node: Node) -> int | None:
+    """This host's worker index within its multi-host slice (row-major
+    over the host grid), or None when unknown."""
+    for source in (node.annotations.get(const.ANN_NODE_WORKER),
+                   node.labels.get(const.GKE_TPU_WORKER_LABEL)):
+        if source is None:
+            continue
+        try:
+            idx = int(source)
+        except ValueError:
+            continue
+        if idx >= 0:
+            return idx
+    return None
+
+
+def host_position(node: Node) -> tuple[tuple[int, ...], "object"] | None:
+    """(host coords, host grid Topology) of this node within its slice,
+    or None when the slice topology / worker index are unknown. The
+    grid's ``distance_coords`` is the inter-host ICI hop count — what
+    gang placement minimizes WITHIN a slice (a flat slice-id match says
+    nothing about adjacency on a big torus)."""
+    from tpushare.topology import topology as T
+
+    grid = T.slice_host_grid(get_slice_topology(node), get_topology(node),
+                             get_tpu_type(node))
+    if grid is None:
+        return None
+    widx = get_worker_index(node)
+    if widx is None or widx >= grid.chip_count:
+        return None
+    return grid.coords(widx), grid
+
+
 def get_tpu_type(node: Node) -> str:
     """TPU generation, e.g. "v5e" / "v5p"; empty when unknown."""
     t = node.annotations.get(const.ANN_NODE_TPU_TYPE, "")

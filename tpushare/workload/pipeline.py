@@ -283,15 +283,33 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
             slot_f, axis=0)
 
         # Last rank: loss + dLoss/dy the moment F(i) completes; B(i)
-        # consumes it next tick from the register.
-        lval, loss_vjp = jax.vjp(loss_fn, edge, y, tgt_in)
-        d_edge_l, dy_l, _ = loss_vjp(jnp.ones_like(lval))
+        # consumes it next tick from the register. Gated by lax.cond —
+        # the predicate is per-device under shard_map manual mode and
+        # loss_fn contains no collectives, so non-last ranks (and
+        # bubble ticks) genuinely SKIP the vocab-size logits einsum and
+        # its vjp, the single largest matmul in an LM, instead of
+        # computing it everywhere and masking.
         is_last = idx == n - 1
         take_loss = do_f & is_last
-        loss_acc = loss_acc + jnp.where(take_loss, lval, 0.0)
-        g_edge = jax.tree.map(
-            lambda acc, d: acc + jnp.where(take_loss, d, 0.0),
-            g_edge, d_edge_l)
+
+        def run_loss(edge, y, tgt):
+            lval, loss_vjp = jax.vjp(loss_fn, edge, y, tgt)
+            d_edge, dy, _ = loss_vjp(jnp.ones_like(lval))
+            return lval, d_edge, dy
+
+        def skip_loss(edge, y, tgt):
+            # Fresh constants are unvarying; both cond branches must
+            # carry the same varying-manual-axes type.
+            return (to_varying(jnp.zeros((), jnp.float32), (axis_name,)),
+                    jax.tree.map(
+                        lambda a: to_varying(jnp.zeros_like(a),
+                                             (axis_name,)), edge),
+                    to_varying(jnp.zeros_like(y), (axis_name,)))
+
+        lval, d_edge_l, dy_l = jax.lax.cond(
+            take_loss, run_loss, skip_loss, edge, y, tgt_in)
+        loss_acc = loss_acc + lval
+        g_edge = jax.tree.map(lambda acc, d: acc + d, g_edge, d_edge_l)
         loss_g = jnp.where(take_loss, dy_l, loss_g)
 
         # ---- backward half --------------------------------------- #
@@ -306,12 +324,22 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
         g_params = jax.tree.map(
             lambda acc, d: acc + jnp.where(do_b, d, 0.0),
             g_params, d_params)
-        # Rank 0's dx continues into the embedding.
-        _, emb_vjp = jax.vjp(embed_fn, edge, tok_b)
-        d_edge_e, _ = emb_vjp(dx)
-        g_edge = jax.tree.map(
-            lambda acc, d: acc + jnp.where(do_b & (idx == 0), d, 0.0),
-            g_edge, d_edge_e)
+
+        # Rank 0's dx continues into the embedding — a dense [V, d]
+        # scatter, gated like the loss head so only rank 0's B ticks
+        # pay for it.
+        def run_emb(edge, tok, dx):
+            _, emb_vjp = jax.vjp(embed_fn, edge, tok)
+            return emb_vjp(dx)[0]
+
+        def skip_emb(edge, tok, dx):
+            return jax.tree.map(
+                lambda a: to_varying(jnp.zeros_like(a), (axis_name,)),
+                edge)
+
+        d_edge_e = jax.lax.cond(do_b & (idx == 0), run_emb, skip_emb,
+                                edge, tok_b, dx)
+        g_edge = jax.tree.map(lambda acc, d: acc + d, g_edge, d_edge_e)
 
         # ---- messages + stream rotation -------------------------- #
         held_act = jax.lax.ppermute(y, axis_name, fwd_perm)
@@ -362,8 +390,9 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
 
     * ``stage_fn(stage_params, x) -> x`` — one pipeline stage.
     * ``embed_fn(edge_params, tok_mb) -> x`` — runs on rank 0 only.
-    * ``loss_fn(edge_params, y, tgt_mb) -> scalar loss SUM`` — runs on
-      the last rank only.
+    * ``loss_fn(edge_params, y, tgt_mb) -> scalar loss SUM`` (float32 —
+      the cond gate's skip branch must match dtypes) — runs on the last
+      rank only.
     * ``tokens``/``targets``: [batch, L] ints, batch divisible by
       ``n_microbatches``.
 
@@ -410,6 +439,38 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
 # Flagship model through the pipe
 # --------------------------------------------------------------------------
 
+def _flagship_blocks_apply(blocks_stacked, x: jax.Array) -> jax.Array:
+    """Run a [k, ...] stack of flagship transformer blocks sequentially
+    (rotary positions are static per microbatch — nothing rides the
+    pipe). ONE definition shared by the pipeline stage fn and the
+    sequential reference, so the exactness test can never drift against
+    stale math."""
+    from tpushare.workload import model as M
+
+    L = x.shape[1]
+    positions = jnp.broadcast_to(jnp.arange(L), x.shape[:2])
+
+    def body(x, blk):
+        x = M.attention_block(blk, x, positions, M.causal_attention)
+        return M.ffn_block(blk, x), None
+
+    x, _ = jax.lax.scan(body, x, blocks_stacked)
+    return x
+
+
+def _flagship_loss_sum(edge, y: jax.Array, tgt: jax.Array) -> jax.Array:
+    """Final norm + tied-lm-head logits + summed token cross-entropy
+    (shared by the pipe's loss head and the reference)."""
+    from tpushare.workload import model as M
+
+    x = M.rms_norm(y, edge["final_norm"])
+    logits = jnp.einsum("bld,vd->blv", x,
+                        edge["embed"]).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll)
+
+
 def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
                            n_microbatches: int = 8):
     """Wire the flagship transformer LM through the 1F1B pipe.
@@ -436,30 +497,11 @@ def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
                          f"{n_stages} pipeline stages")
     per_stage = cfg.n_layers // n_stages
 
-    def stage_fn(stage_params, x):
-        L = x.shape[1]
-        positions = jnp.broadcast_to(jnp.arange(L), x.shape[:2])
-
-        def body(x, blk):
-            x = M.attention_block(blk, x, positions, M.causal_attention)
-            return M.ffn_block(blk, x), None
-
-        x, _ = jax.lax.scan(body, x, stage_params)
-        return x
-
     def embed_fn(edge, tok_mb):
         return edge["embed"][tok_mb]
 
-    def loss_fn(edge, y, tgt_mb):
-        x = M.rms_norm(y, edge["final_norm"])
-        logits = jnp.einsum("bld,vd->blv", x,
-                            edge["embed"]).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, tgt_mb[..., None],
-                                   axis=-1)[..., 0]
-        return jnp.sum(nll)
-
-    pipe = make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh,
+    pipe = make_pipeline_train_fn(_flagship_blocks_apply, embed_fn,
+                                  _flagship_loss_sum, mesh,
                                   axis_name=axis_name,
                                   n_microbatches=n_microbatches)
 
@@ -495,23 +537,10 @@ def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
 def flagship_pipeline_reference(cfg, stacked, edge, tokens, targets):
     """Single-device flagship forward+loss matching
     :func:`make_flagship_pipeline`'s numerics (mean token CE), for
-    gradient-exactness tests."""
-    from tpushare.workload import model as M
-
+    gradient-exactness tests. Uses the SAME per-layer and loss-head
+    helpers as the pipe — only the schedule differs."""
     blocks = jax.tree.map(
         lambda a: a.reshape((-1,) + a.shape[2:]), stacked)
-    x = edge["embed"][tokens]
-    positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]),
-                                 tokens.shape)
-
-    def body(x, blk):
-        x = M.attention_block(blk, x, positions, M.causal_attention)
-        return M.ffn_block(blk, x), None
-
-    x, _ = jax.lax.scan(body, x, blocks)
-    x = M.rms_norm(x, edge["final_norm"])
-    logits = jnp.einsum("bld,vd->blv", x,
-                        edge["embed"]).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(nll)
+    x = _flagship_blocks_apply(blocks, edge["embed"][tokens])
+    n_tok = tokens.shape[0] * tokens.shape[1]
+    return _flagship_loss_sum(edge, x, targets) / n_tok
