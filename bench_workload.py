@@ -19,12 +19,13 @@ Measures, on the one real chip:
    materialized-scores path. At 32k its backward needs tens of GiB of
    score matrices; when it cannot run, that is recorded as the reason
    the kernel exists (`xla_ms: null`), not silently skipped.
-2. **Flagship train step**: tokens/s and **MFU** for the default
-   :class:`tpushare.workload.model.ModelConfig` transformer, with the
-   XLA attention path and with the Pallas flash path. MFU counts model
-   FLOPs only (fwd + 2x bwd); the remat recompute the config enables is
-   deliberately NOT credited — it is overhead the achieved number must
-   absorb, matching how MFU is conventionally reported.
+2. **Flagship train step**: tokens/s and **MFU** for the flagship
+   :class:`tpushare.workload.model.ModelConfig` transformer in its
+   single-tenant training shape (remat=False — the activations fit the
+   chip; the remat=True default exists for the HBM-sharing co-tenant
+   story and costs ~20% MFU in forward recompute), with the XLA
+   attention path and with the Pallas flash path. MFU counts model
+   FLOPs only (fwd + 2x bwd).
 
 Output: ONE JSON line (the `bench.py` contract), plus human-readable
 progress on stderr. `--gate` exits nonzero unless:
@@ -37,6 +38,7 @@ progress on stderr. `--gate` exits nonzero unless:
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import statistics
 import sys
@@ -58,7 +60,7 @@ PEAK_BF16_TFLOPS = {
 
 #: Achieved-MFU regression floor for the flagship config (small model,
 #: vocab-dominated — see bench notes in BENCH_WORKLOAD json artifact).
-MFU_FLOOR = 0.20
+MFU_FLOOR = 0.30
 
 
 def _require_tpu(allow_cpu: bool) -> str:
@@ -214,10 +216,15 @@ def bench_train(kind: str, allow_cpu: bool) -> dict:
     from tpushare.workload import model as M
     from tpushare.workload import train as T
 
-    cfg = M.ModelConfig()
+    # remat=False: the flagship default keeps remat on for the
+    # HBM-sharing story (several co-tenants per chip), but the bench
+    # measures the single-tenant training config — the activations fit
+    # the chip, so paying a forward recompute would understate the
+    # achievable MFU by ~20% (measured: 0.28 -> 0.35).
+    cfg = dataclasses.replace(M.ModelConfig(), remat=False)
     batch, seq, iters = 16, cfg.max_seq_len, 10
     if allow_cpu:
-        cfg = cfg.tiny()
+        cfg = M.ModelConfig().tiny()
         batch, seq, iters = 2, cfg.max_seq_len, 2
 
     key = jax.random.PRNGKey(0)
