@@ -98,21 +98,28 @@ def _measure_rtt() -> float:
     return _RTT_S
 
 
-def _time_scalar_fn(fn, *args, iters: int = 30, warmup: int = 2) -> float:
+def _time_scalar_fn(fn, *args, iters: int = 30, warmup: int = 2,
+                    reps: int = 2) -> float:
     """Seconds per call of ``fn`` (which must return a SCALAR jax array
     that data-depends on all the work being timed). Queues ``iters``
     executions back-to-back and forces ONE readback of the last result:
     the device runs programs in issue order, so draining the last drains
-    them all; the tunnel RTT is paid once and subtracted."""
+    them all; the tunnel RTT is paid once and subtracted. Minimum of
+    ``reps`` measurements: the RTT varies by tens of ms between
+    readbacks, and a single unlucky subtraction can swing a
+    few-millisecond kernel by 2x — the min is the honest steady-state."""
     for _ in range(warmup):
         float(fn(*args))
-    t0 = time.perf_counter()
-    last = None
-    for _ in range(iters):
-        last = fn(*args)
-    float(last)  # drains the whole queue (program order)
-    total = time.perf_counter() - t0
-    return max(total - _RTT_S, 0.0) / iters
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(iters):
+            last = fn(*args)
+        float(last)  # drains the whole queue (program order)
+        t = max(time.perf_counter() - t0 - _RTT_S, 0.0) / iters
+        best = t if best is None or t < best else best
+    return best
 
 
 # --------------------------------------------------------------------------
