@@ -207,3 +207,61 @@ class TestTrain1F1B:
         # (ints) plus slack, but reject anything near linear
         # activation growth.
         assert sizes[16] < sizes[4] * 2.0, sizes
+
+
+class TestDpPipeComposition:
+    """dp × pp in ONE shard_map: each dp row pipelines its shard of
+    every microbatch; the gradient all-reduce over dp fuses into the
+    pipe's final reductions. Grads must equal the single-device
+    reference over the FULL batch."""
+
+    CFG = M.ModelConfig(vocab_size=64, d_model=32, n_heads=4,
+                        n_layers=4, d_ff=64, max_seq_len=16,
+                        dtype=jnp.float32, remat=False)
+
+    def test_dp2_pp4_grads_match_reference(self):
+        from tpushare.workload.parallel import Mesh
+
+        devices = jax.devices()[:8]
+        mesh = Mesh(np.array(devices).reshape(2, 4), ("dp", "pp"))
+        n_micro = 4
+        init_fn, train_fn = pp.make_flagship_pipeline(
+            self.CFG, mesh, axis_name="pp", n_microbatches=n_micro,
+            dp_axis="dp")
+        key = jax.random.PRNGKey(7)
+        tokens = jax.random.randint(key, (8, self.CFG.max_seq_len),
+                                    0, self.CFG.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+        with mesh:
+            stacked, edge = init_fn(jax.random.PRNGKey(0))
+            loss, g_stacked, g_edge = jax.jit(train_fn)(
+                stacked, edge, tokens, targets)
+
+        def ref_loss(stacked, edge):
+            return pp.flagship_pipeline_reference(
+                self.CFG, stacked, edge, tokens, targets)
+
+        hs, he = jax.device_get(stacked), jax.device_get(edge)
+        np.testing.assert_allclose(float(loss), float(ref_loss(hs, he)),
+                                   rtol=1e-5)
+        want_gs, want_ge = jax.grad(ref_loss, argnums=(0, 1))(hs, he)
+        for got, want in ((g_stacked, want_gs), (g_edge, want_ge)):
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-4,
+                    atol=2e-5),
+                jax.device_get(got), want)
+
+    def test_microbatch_not_divisible_by_dp_refused(self):
+        from tpushare.workload.parallel import Mesh
+
+        devices = jax.devices()[:8]
+        mesh = Mesh(np.array(devices).reshape(2, 4), ("dp", "pp"))
+        init_fn, train_fn = pp.make_flagship_pipeline(
+            self.CFG, mesh, axis_name="pp", n_microbatches=3,
+            dp_axis="dp")
+        tokens = jnp.zeros((3, self.CFG.max_seq_len), jnp.int32)
+        with mesh:
+            stacked, edge = init_fn(jax.random.PRNGKey(0))
+            with pytest.raises(ValueError, match="not divisible by dp"):
+                train_fn(stacked, edge, tokens, tokens)

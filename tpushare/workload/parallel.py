@@ -39,18 +39,21 @@ from tpushare.workload import model as M
 def to_varying(x, axes):
     """Tag ``x`` as device-varying over ``axes`` (shard_map's typed
     collectives require fresh scan carries to match the loop outputs'
-    varying-manual-axes type). Idempotent: an already-varying value
-    (e.g. ``zeros_like`` of a sharded input) passes through untouched.
-    One home for the pcast/pvary API shim — pvary was deprecated in
-    favor of ``pcast(..., to="varying")``."""
-    try:
-        return jax.lax.pcast(x, tuple(axes), to="varying")
-    except (AttributeError, TypeError):  # pragma: no cover - older jax
-        return jax.lax.pvary(x, tuple(axes))
-    except ValueError as e:
-        if "varying" in str(e):
-            return x  # already varying over these axes: idempotent
-        raise  # unrelated pcast failure (e.g. unknown axis name)
+    varying-manual-axes type). Idempotent PER AXIS: a value already
+    varying over some of ``axes`` (e.g. ``zeros_like`` of a pp-sharded
+    input inside a dp×pp body) gains only the missing tags. One home
+    for the pcast/pvary API shim — pvary was deprecated in favor of
+    ``pcast(..., to="varying")``."""
+    for ax in axes:
+        try:
+            x = jax.lax.pcast(x, (ax,), to="varying")
+        except (AttributeError, TypeError):  # pragma: no cover - old jax
+            x = jax.lax.pvary(x, (ax,))
+        except ValueError as e:
+            if "varying" in str(e):
+                continue  # already varying over this axis
+            raise  # unrelated pcast failure (e.g. unknown axis name)
+    return x
 
 def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
               devices=None) -> Mesh:

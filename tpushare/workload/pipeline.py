@@ -221,7 +221,8 @@ def last_stage_output(y_staged: jax.Array) -> jax.Array:
 
 def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
                           *, stage_fn, embed_fn, loss_fn,
-                          axis_name: str, M: int):
+                          axis_name: str, M: int,
+                          dp_axis: str | None = None):
     """Per-rank 1F1B body. Returns (loss_sum, stage grads [1, ...],
     edge grads). Schedule: F_r(i) at tick r + 2i, B_r(i) at tick
     (2n - 2 - r) + 2i; both messages (activation fwd, gradient bwd)
@@ -236,7 +237,14 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
     # masked bubble ticks. Tag it varying so each rank's vjp cotangent
     # stays local; the one explicit psum at the end then does the only
     # reduction.
-    edge = jax.tree.map(lambda a: to_varying(a, (axis_name,)), edge)
+    vary_axes = ((axis_name,) if dp_axis is None
+                 else (axis_name, dp_axis))
+    edge = jax.tree.map(lambda a: to_varying(a, vary_axes), edge)
+    # Same trap for the stage params when composed with dp: they are
+    # sharded over the pipe axis but REPLICATED over dp, so a vjp
+    # against them would auto-psum the cotangent over dp — and the
+    # explicit dp all-reduce at the end would then double-count.
+    params = jax.tree.map(lambda a: to_varying(a, vary_axes), params)
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
     bwd_perm = [(i, (i - 1) % n) for i in range(n)]
     T_total = 2 * M + 2 * n - 3  # B_0(M-1) lands at 2M + 2n - 4
@@ -300,11 +308,11 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
         def skip_loss(edge, y, tgt):
             # Fresh constants are unvarying; both cond branches must
             # carry the same varying-manual-axes type.
-            return (to_varying(jnp.zeros((), jnp.float32), (axis_name,)),
+            return (to_varying(jnp.zeros((), jnp.float32), vary_axes),
                     jax.tree.map(
                         lambda a: to_varying(jnp.zeros_like(a),
-                                             (axis_name,)), edge),
-                    to_varying(jnp.zeros_like(y), (axis_name,)))
+                                             vary_axes), edge),
+                    to_varying(jnp.zeros_like(y), vary_axes))
 
         lval, d_edge_l, dy_l = jax.lax.cond(
             take_loss, run_loss, skip_loss, edge, y, tgt_in)
@@ -334,7 +342,7 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
 
         def skip_emb(edge, tok, dx):
             return jax.tree.map(
-                lambda a: to_varying(jnp.zeros_like(a), (axis_name,)),
+                lambda a: to_varying(jnp.zeros_like(a), vary_axes),
                 edge)
 
         d_edge_e = jax.lax.cond(do_b & (idx == 0), run_emb, skip_emb,
@@ -356,7 +364,7 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
                 stash_tok, tok_st, tgt_st, g_params, g_edge,
                 loss_acc), None
 
-    vary = lambda x: to_varying(x, (axis_name,))  # noqa: E731
+    vary = lambda x: to_varying(x, vary_axes)  # noqa: E731
     carry0 = (
         vary(act0),                                        # held_act
         vary(jnp.zeros(mb_shape, tgt_store.dtype)),        # held_tgt
@@ -373,16 +381,23 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
     carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T_total))
     g_params, g_edge, loss_acc = carry[8], carry[9], carry[10]
     # Edge grads were accumulated on their using rank only; the loss
-    # lives on the last rank. One reduction each at the very end.
-    loss_total = jax.lax.psum(loss_acc, axis_name)
-    g_edge = jax.tree.map(lambda a: jax.lax.psum(a, axis_name), g_edge)
+    # lives on the last rank. One reduction each at the very end — and
+    # when the pipe is composed with data parallelism (each dp row ran
+    # the same stages over its microbatch shard), the dp all-reduce
+    # happens here too, fused with the pipeline's own reductions.
+    loss_total = jax.lax.psum(loss_acc, vary_axes)
+    g_edge = jax.tree.map(lambda a: jax.lax.psum(a, vary_axes), g_edge)
+    if dp_axis is not None:
+        g_params = jax.tree.map(
+            lambda a: jax.lax.psum(a, dp_axis), g_params)
     g_params = jax.tree.map(lambda a: a[None], g_params)
     return loss_total, g_params, g_edge
 
 
 def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
                            axis_name: str = "pp",
-                           n_microbatches: int = 8):
+                           n_microbatches: int = 8,
+                           dp_axis: str | None = None):
     """Build a 1F1B training step::
 
         fn(stacked_stage_params, edge_params, tokens, targets)
@@ -394,7 +409,14 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
       the cond gate's skip branch must match dtypes) — runs on the last
       rank only.
     * ``tokens``/``targets``: [batch, L] ints, batch divisible by
-      ``n_microbatches``.
+      ``n_microbatches`` (and, with ``dp_axis``, each microbatch
+      divisible by the dp size).
+
+    With ``dp_axis`` the pipe composes with DATA parallelism on the
+    same mesh: each dp row runs the full 1F1B schedule over its shard
+    of every microbatch (the microbatch dim is split over dp), and the
+    gradient all-reduce over dp fuses into the pipeline's own final
+    reductions — dp×pp in one shard_map, no outer machinery.
 
     Gradients are exact w.r.t. the sequential reference (same vjp
     chain, reordered); loss and grads come back replicated, ready for
@@ -403,7 +425,7 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
         return _pipeline_train_local(
             tok_store, tgt_store, stacked, edge, stage_fn=stage_fn,
             embed_fn=embed_fn, loss_fn=loss_fn, axis_name=axis_name,
-            M=M)
+            M=M, dp_axis=dp_axis)
 
     def fn(stacked, edge, tokens, targets):
         n_stages = jax.tree.leaves(stacked)[0].shape[0]
@@ -414,6 +436,10 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
                 f"{n_stages}")
         M = n_microbatches
         mb = tokens.shape[0] // M
+        if dp_axis is not None and mb % mesh.shape[dp_axis]:
+            raise ValueError(
+                f"microbatch size {mb} not divisible by dp axis "
+                f"{dp_axis!r} ({mesh.shape[dp_axis]})")
         tok_mb = tokens.reshape((M, mb) + tokens.shape[1:])
         tgt_mb = targets.reshape((M, mb) + targets.shape[1:])
         tok_store = _stream_shard(tok_mb, n_stages)
@@ -422,11 +448,11 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
             lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked)
         edge_specs = jax.tree.map(
             lambda a: P(*([None] * a.ndim)), edge)
-        in_specs = (
-            P(axis_name, *([None] * (tok_store.ndim - 1))),
-            P(axis_name, *([None] * (tgt_store.ndim - 1))),
-            stage_specs, edge_specs,
-        )
+        # store layout [n_stages, K, mb, ...]: pipe axis shards the
+        # stage dim; dp (when composed) shards the microbatch dim.
+        stream_spec = P(axis_name, None, dp_axis,
+                        *([None] * (tok_store.ndim - 3)))
+        in_specs = (stream_spec, stream_spec, stage_specs, edge_specs)
         out_specs = (P(), stage_specs, edge_specs)
         mapped = shard_map(partial(local, M=M), mesh=mesh,
                            in_specs=in_specs, out_specs=out_specs)
@@ -472,7 +498,8 @@ def _flagship_loss_sum(edge, y: jax.Array, tgt: jax.Array) -> jax.Array:
 
 
 def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
-                           n_microbatches: int = 8):
+                           n_microbatches: int = 8,
+                           dp_axis: str | None = None):
     """Wire the flagship transformer LM through the 1F1B pipe.
 
     Returns ``(init_fn, train_fn)``:
@@ -503,7 +530,8 @@ def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
     pipe = make_pipeline_train_fn(_flagship_blocks_apply, embed_fn,
                                   _flagship_loss_sum, mesh,
                                   axis_name=axis_name,
-                                  n_microbatches=n_microbatches)
+                                  n_microbatches=n_microbatches,
+                                  dp_axis=dp_axis)
 
     def init_fn(key):
         params = M.init_params(key, cfg)
