@@ -269,3 +269,44 @@ class TestIntraSliceAdjacency:
         assert node["workerIndex"] == 6
         assert node["hostCoords"] == [1, 2]
         assert node["sliceTopology"] == "8x8"
+
+
+class TestScoringPolicy:
+    """TPUSHARE_SCORING=spread inverts the fit component: emptiest
+    placement wins (fewer co-tenants per chip) while gang/ICI/slice
+    affinities still apply."""
+
+    def _two_nodes(self, api):
+        api.create_node(make_node("partial", chips=4, hbm_per_chip=16))
+        api.create_node(make_node("pristine", chips=4, hbm_per_chip=16))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        from tests.conftest import make_pod as mp
+        from tpushare.api.objects import Pod
+        from tpushare.utils import pod as podutils
+        resident = Pod(mp("r", hbm=8, node_name="partial",
+                          uid="uid-r", phase="Running"))
+        resident = podutils.updated_pod_annotation_spec(resident, [0], 8, 16)
+        cache.add_or_update_pod(resident)
+        return cache
+
+    def test_spread_prefers_pristine_hbm(self, api):
+        cache = self._two_nodes(api)
+        spread = Prioritize(cache, policy="spread")
+        binpack = Prioritize(cache)  # default
+        pod = make_pod("p", hbm=8)
+        s_spread = scores(spread, pod, ["partial", "pristine"])
+        s_binpack = scores(binpack, pod, ["partial", "pristine"])
+        assert s_spread["pristine"] > s_spread["partial"]
+        assert s_binpack["partial"] > s_binpack["pristine"]
+
+    def test_spread_prefers_emptier_chip_host(self, api):
+        cache = self._two_nodes(api)
+        spread = Prioritize(cache, policy="spread")
+        pod = make_pod("p", chips=2)
+        s = scores(spread, pod, ["partial", "pristine"])
+        assert s["pristine"] > s["partial"]
+
+    def test_unknown_policy_refused(self, api):
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        with pytest.raises(ValueError, match="unknown scoring policy"):
+            Prioritize(cache, policy="tetris")

@@ -124,14 +124,28 @@ def test_randomized_churn_soak(api):
                 pod_uid=pod.uid, node=best))
             if not r.error:
                 bound.append(pod.name)
-        elif op < 0.80:
+        elif op < 0.78:
             # -- completion frees HBM --------------------------------- #
             name = bound.pop(rng.randrange(len(bound)))
             api.update_pod_status("default", name, "Succeeded")
-        elif op < 0.95:
+        elif op < 0.90:
             # -- deletion frees HBM ----------------------------------- #
             name = bound.pop(rng.randrange(len(bound)))
             api.delete_pod("default", name)
+        elif op < 0.95:
+            # -- preemption planning: read-only under churn ----------- #
+            # The preemptor never evicts (the scheduler would); the
+            # invariant is that PLANNING against a churning ledger
+            # neither mutates it nor crashes on pods mid-lifecycle.
+            from tpushare.api.extender import ExtenderPreemptionArgs
+            hi = make_pod(f"hi{seq}", hbm=rng.choice([8, 16]),
+                          priority=1000)
+            seq += 1
+            stack.preempt.handle(ExtenderPreemptionArgs.from_json({
+                "Pod": hi,
+                "NodeNameToMetaVictims": {
+                    n.name: {"Pods": []} for n in api.list_nodes()},
+            }))
         else:
             # -- node flap: delete + re-register ---------------------- #
             node = rng.choice(api.list_nodes())

@@ -70,7 +70,17 @@ def main() -> None:
             print(f"pod {name}: unschedulable: {result['FailedNodes']}",
                   flush=True)
             return
-        target = result["NodeNames"][0]
+        # Full verb sequence like the real scheduler: prioritize the
+        # survivors and bind the top-scoring host (this is what makes
+        # TPUSHARE_SCORING visible in the demo).
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{args.port}/tpushare-scheduler/prioritize",
+            data=json.dumps({"Pod": pod.raw,
+                             "NodeNames": result["NodeNames"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            ranked = json.loads(resp.read())
+        target = max(ranked, key=lambda e: e["Score"])["Host"]
         req = urllib.request.Request(
             f"http://127.0.0.1:{args.port}/tpushare-scheduler/bind",
             data=json.dumps({"PodName": name, "PodNamespace": "default",

@@ -16,7 +16,6 @@ capacities change, not only on the non-sharing → sharing transition
 
 from __future__ import annotations
 
-import threading
 import logging
 
 from tpushare.api.objects import Node, Pod

@@ -9,7 +9,6 @@ capacity as used regardless of the pod's aggregate HBM annotation.
 
 from __future__ import annotations
 
-import threading
 
 from tpushare.utils import locks
 from tpushare.api.objects import Pod

@@ -18,7 +18,6 @@ Counterpart of the reference's ``pkg/cache/nodeinfo.go`` (NodeInfo,
 
 from __future__ import annotations
 
-import threading
 import time
 
 from tpushare.utils import locks

@@ -18,6 +18,10 @@ Environment (reference cmd/main.go:23,92-98):
   several replicas can run safely (only the leader binds); pair with
   ``LEASE_NAMESPACE`` (default kube-system). The reference was pinned
   to one replica precisely because it had no election.
+* ``TPUSHARE_SCORING`` — ``binpack`` (default: tightest fit, maximizes
+  whole-free chips) or ``spread`` (emptiest placement wins — fewer
+  co-tenants per chip for latency-sensitive inference fleets). Gang
+  consolidation and ICI/slice affinity apply under both.
 """
 
 from __future__ import annotations
@@ -86,7 +90,11 @@ def build_stack(client, is_leader=None) -> Stack:
                        is_leader=is_leader)
     gang.start()  # housekeeping tick: gang expiry + bind retries
     predicate = Predicate(controller.cache)
-    prioritize = Prioritize(controller.cache, gang_planner=gang)
+    # TPUSHARE_SCORING=spread flips the fit scoring for fleets that
+    # prefer fewer co-tenants per chip over packing density.
+    prioritize = Prioritize(
+        controller.cache, gang_planner=gang,
+        policy=os.environ.get("TPUSHARE_SCORING", "binpack"))
     binder = Bind(controller.cache, client, gang_planner=gang,
                   pod_lister=controller.hub.get_pod)
     inspect = Inspect(controller.cache, client.list_nodes,

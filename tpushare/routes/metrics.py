@@ -66,6 +66,31 @@ HBM_USED = Gauge(
     "tpushare_node_hbm_used_gib", "Committed HBM per node",
     ["node"], registry=REGISTRY,
 )
+PREEMPT_VICTIMS = Counter(
+    "tpushare_preempt_victims_total",
+    "Worst-case victim count per preemption plan (the max over the "
+    "plan's candidate nodes — the scheduler evicts ONE node's set, so "
+    "summing across candidates would over-count by the fleet factor). "
+    "A rising rate means priority traffic is displacing work.",
+    registry=REGISTRY,
+)
+
+
+def safe_inc(counter, n: float = 1) -> None:
+    """Increment that can never break the calling code path — metrics
+    are observability, not control flow. One home for the guard so call
+    sites don't copy the try/except."""
+    try:
+        counter.inc(n)
+    except Exception:  # pragma: no cover - metrics must not throw
+        pass
+GANGS_REAPED = Counter(
+    "tpushare_gangs_reaped_total",
+    "Gangs whose below-quorum survivors were reclaimed by the "
+    "controller reaper (each one is a job restart; a steady rate means "
+    "something keeps evicting gang members)",
+    registry=REGISTRY,
+)
 GANGS_PENDING = Gauge(
     "tpushare_gangs_pending",
     "Gangs holding reservations below quorum (stuck gangs -> alert)",

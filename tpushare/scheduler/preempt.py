@@ -374,6 +374,11 @@ class Preempt:
             result.node_victims[name] = ours + [
                 u for u in nominated if u not in set(ours)]
             result.pdb_violations[name] = victims.num_pdb_violations
+        if result.node_victims:
+            from tpushare.routes import metrics
+            metrics.safe_inc(
+                metrics.PREEMPT_VICTIMS,
+                max(len(v) for v in result.node_victims.values()))
         log.debug("preempt pod %s: %s", pod.key(),
                   {n: len(v) for n, v in result.node_victims.items()})
         return result

@@ -47,9 +47,23 @@ MAX_SCORE = 10
 class Prioritize:
     name = "tpushare-prioritize"
 
-    def __init__(self, cache: SchedulerCache, gang_planner=None):
+    def __init__(self, cache: SchedulerCache, gang_planner=None,
+                 policy: str = "binpack"):
+        """``policy``: ``"binpack"`` (default — tightest fit, maximizes
+        whole-free chips for future multi-chip pods; the policy the
+        whole bench story is built on) or ``"spread"`` (inverted fit —
+        emptiest placement wins; for latency-sensitive inference fleets
+        that prefer fewer co-tenants per chip over packing density).
+        Gang consolidation, ICI-compactness, and slice-affinity bonuses
+        apply under BOTH policies: a gang wants its members together
+        and its chips adjacent regardless of how lone pods spread."""
+        if policy not in ("binpack", "spread"):
+            raise ValueError(
+                f"unknown scoring policy {policy!r}; expected "
+                "'binpack' or 'spread'")
         self.cache = cache
         self.gang_planner = gang_planner
+        self.policy = policy
 
     # ------------------------------------------------------------------ #
     # Per-node scoring
@@ -63,8 +77,12 @@ class Prioritize:
             return 0
         free, cap = min(fits)  # tightest chip on this node
         waste = free - req
-        # waste == 0 -> 10; waste == full pristine chip -> 0.
-        score = round(MAX_SCORE * (1.0 - waste / cap)) if cap else 0
+        # binpack: waste == 0 -> 10; waste == full pristine chip -> 0.
+        # spread: inverted — the emptiest fitting chip wins.
+        fit = (waste / cap) if cap else 0.0
+        if self.policy == "binpack":
+            fit = 1.0 - fit
+        score = round(MAX_SCORE * fit)
         if gang_nodes and info.name in gang_nodes and score < MAX_SCORE:
             score += 1  # consolidate gang slices onto fewer hosts
         return max(0, min(MAX_SCORE, score))
@@ -75,8 +93,12 @@ class Prioritize:
         if len(free) < req or info.chip_count == 0:
             return 0
         leftover = len(free) - req
-        # Exact pack -> 8; a pristine host asked for one chip -> low.
-        score = round((MAX_SCORE - 2) * (1.0 - leftover / info.chip_count))
+        # binpack: exact pack -> 8, cracking a pristine host -> low.
+        # spread: inverted — the emptiest host wins.
+        fit = leftover / info.chip_count
+        if self.policy == "binpack":
+            fit = 1.0 - fit
+        score = round((MAX_SCORE - 2) * fit)
         chosen = info.topology.select_compact(free, req)
         if chosen and len(chosen) > 1:
             pairs = len(chosen) * (len(chosen) - 1) / 2
