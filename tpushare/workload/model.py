@@ -133,22 +133,37 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def attention_block(block: dict, x: jax.Array, positions: jax.Array,
+def attention_delta(block: dict, x: jax.Array, positions: jax.Array,
                     attn_fn) -> jax.Array:
+    """The attention sublayer's PRE-RESIDUAL contribution. Split from
+    the residual add so tensor parallelism can psum partial deltas from
+    head-sharded weights over the tp axis before adding — one
+    definition of the math serves both the single-device block and the
+    Megatron-style sharded stage."""
     h = rms_norm(x, block["attn_norm"])
     qkv = jnp.einsum("bld,dthc->btlhc", h, block["wqkv"])
     q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
     q = rotary(q, positions)
     k = rotary(k, positions)
     out = attn_fn(q, k, v)
-    return x + jnp.einsum("blhc,hcd->bld", out, block["wo"])
+    return jnp.einsum("blhc,hcd->bld", out, block["wo"])
+
+
+def attention_block(block: dict, x: jax.Array, positions: jax.Array,
+                    attn_fn) -> jax.Array:
+    return x + attention_delta(block, x, positions, attn_fn)
+
+
+def ffn_delta(block: dict, x: jax.Array) -> jax.Array:
+    """The SwiGLU ffn's pre-residual contribution (see
+    :func:`attention_delta` for why the residual is split off)."""
+    h = rms_norm(x, block["ffn_norm"])
+    gate = jax.nn.silu(h @ block["w_gate"])
+    return (gate * (h @ block["w_up"])) @ block["w_down"]
 
 
 def ffn_block(block: dict, x: jax.Array) -> jax.Array:
-    h = rms_norm(x, block["ffn_norm"])
-    gate = jax.nn.silu(h @ block["w_gate"])
-    out = (gate * (h @ block["w_up"])) @ block["w_down"]
-    return x + out
+    return x + ffn_delta(block, x)
 
 
 def forward(params: dict, tokens: jax.Array, cfg: ModelConfig,

@@ -397,7 +397,8 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
 def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
                            axis_name: str = "pp",
                            n_microbatches: int = 8,
-                           dp_axis: str | None = None):
+                           dp_axis: str | None = None,
+                           stage_specs=None):
     """Build a 1F1B training step::
 
         fn(stacked_stage_params, edge_params, tokens, targets)
@@ -417,6 +418,14 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
     of every microbatch (the microbatch dim is split over dp), and the
     gradient all-reduce over dp fuses into the pipeline's own final
     reductions — dp×pp in one shard_map, no outer machinery.
+
+    ``stage_specs`` (a pytree of PartitionSpecs matching the stacked
+    stage params) overrides the default ``P(axis_name, None, ...)``
+    placement — how TENSOR parallelism composes in: shard a weight's
+    head/ffn axis over a tp mesh axis and have ``stage_fn`` psum its
+    partial outputs over that axis (Megatron-style). Gradients for
+    tp-sharded leaves come back sharded the same way; the pipeline's
+    machinery only assumes the leading axis is ``axis_name``.
 
     Gradients are exact w.r.t. the sequential reference (same vjp
     chain, reordered); loss and grads come back replicated, ready for
@@ -444,7 +453,7 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
         tgt_mb = targets.reshape((M, mb) + targets.shape[1:])
         tok_store = _stream_shard(tok_mb, n_stages)
         tgt_store = _stream_shard(tgt_mb, n_stages)
-        stage_specs = jax.tree.map(
+        sspecs = stage_specs if stage_specs is not None else jax.tree.map(
             lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked)
         edge_specs = jax.tree.map(
             lambda a: P(*([None] * a.ndim)), edge)
@@ -452,8 +461,8 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
         # stage dim; dp (when composed) shards the microbatch dim.
         stream_spec = P(axis_name, None, dp_axis,
                         *([None] * (tok_store.ndim - 3)))
-        in_specs = (stream_spec, stream_spec, stage_specs, edge_specs)
-        out_specs = (P(), stage_specs, edge_specs)
+        in_specs = (stream_spec, stream_spec, sspecs, edge_specs)
+        out_specs = (P(), sspecs, edge_specs)
         mapped = shard_map(partial(local, M=M), mesh=mesh,
                            in_specs=in_specs, out_specs=out_specs)
         return mapped(tok_store, tgt_store, stacked, edge)
@@ -484,6 +493,51 @@ def _flagship_blocks_apply(blocks_stacked, x: jax.Array) -> jax.Array:
     return x
 
 
+def _flagship_tp_blocks_apply(blocks_stacked, x: jax.Array,
+                              tp_axis: str) -> jax.Array:
+    """Tensor-parallel flagship blocks (Megatron-style): attention heads
+    and the ffn hidden axis are sharded over ``tp_axis``; each rank
+    computes its partial sublayer DELTA (the same
+    ``model.attention_delta``/``ffn_delta`` math as the single-device
+    block — only the weights are narrower) and ONE psum per sublayer
+    restores the replicated activation before the residual add."""
+    from tpushare.workload import model as M
+
+    L = x.shape[1]
+    positions = jnp.broadcast_to(jnp.arange(L), x.shape[:2])
+
+    def body(x, blk):
+        x = x + jax.lax.psum(
+            M.attention_delta(blk, x, positions, M.causal_attention),
+            tp_axis)
+        x = x + jax.lax.psum(M.ffn_delta(blk, x), tp_axis)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, blocks_stacked)
+    return x
+
+
+#: Which axis of each STACKED block leaf ([n_stages, per_stage, *param])
+#: tensor parallelism shards: wqkv (d,3,H,c) -> heads at 4; wo (H,c,d)
+#: -> heads at 2; w_gate/w_up (d,ff) -> ffn at 3; w_down (ff,d) -> 2.
+_FLAGSHIP_TP_AXES = {"wqkv": 4, "wo": 2, "w_gate": 3, "w_up": 3,
+                     "w_down": 2}
+
+
+def _flagship_tp_stage_specs(stacked, axis_name: str, tp_axis: str):
+    """PartitionSpecs for the stacked blocks: stage dim over the pipe
+    axis, the head/ffn dim of each matmul over tp, norms replicated."""
+    def spec(path, a):
+        key = path[-1].key
+        parts = [axis_name] + [None] * (a.ndim - 1)
+        tp_dim = _FLAGSHIP_TP_AXES.get(key)
+        if tp_dim is not None:
+            parts[tp_dim] = tp_axis
+        return P(*parts)
+
+    return jax.tree_util.tree_map_with_path(spec, stacked)
+
+
 def _flagship_loss_sum(edge, y: jax.Array, tgt: jax.Array) -> jax.Array:
     """Final norm + tied-lm-head logits + summed token cross-entropy
     (shared by the pipe's loss head and the reference)."""
@@ -499,7 +553,8 @@ def _flagship_loss_sum(edge, y: jax.Array, tgt: jax.Array) -> jax.Array:
 
 def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
                            n_microbatches: int = 8,
-                           dp_axis: str | None = None):
+                           dp_axis: str | None = None,
+                           tp_axis: str | None = None):
     """Wire the flagship transformer LM through the 1F1B pipe.
 
     Returns ``(init_fn, train_fn)``:
@@ -524,14 +579,27 @@ def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
                          f"{n_stages} pipeline stages")
     per_stage = cfg.n_layers // n_stages
 
+    if tp_axis is not None:
+        tp = mesh.shape[tp_axis]
+        if cfg.n_heads % tp or cfg.d_ff % tp:
+            raise ValueError(
+                f"tensor parallelism over {tp_axis!r} ({tp}) needs "
+                f"n_heads ({cfg.n_heads}) and d_ff ({cfg.d_ff}) "
+                "divisible by it")
+
     def embed_fn(edge, tok_mb):
         return edge["embed"][tok_mb]
 
-    pipe = make_pipeline_train_fn(_flagship_blocks_apply, embed_fn,
-                                  _flagship_loss_sum, mesh,
-                                  axis_name=axis_name,
-                                  n_microbatches=n_microbatches,
-                                  dp_axis=dp_axis)
+    if tp_axis is None:
+        stage_fn = _flagship_blocks_apply
+        stage_specs_of = None
+    else:
+        stage_fn = partial(_flagship_tp_blocks_apply, tp_axis=tp_axis)
+
+        def stage_specs_of(stacked):
+            return _flagship_tp_stage_specs(stacked, axis_name, tp_axis)
+
+    pipe = None  # built lazily once the stacked tree's shape is known
 
     def init_fn(key):
         params = M.init_params(key, cfg)
@@ -543,7 +611,13 @@ def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
             blocks)
         edge = {"embed": params["embed"],
                 "final_norm": params["final_norm"]}
-        stacked = place_pipeline_params(stacked, mesh, axis_name)
+        if stage_specs_of is None:
+            stacked = place_pipeline_params(stacked, mesh, axis_name)
+        else:
+            specs = stage_specs_of(stacked)
+            stacked = jax.tree.map(
+                lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+                stacked, specs)
         edge = jax.device_put(
             edge, jax.tree.map(
                 lambda a: NamedSharding(mesh, P(*([None] * a.ndim))),
@@ -551,6 +625,14 @@ def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
         return stacked, edge
 
     def train_fn(stacked, edge, tokens, targets):
+        nonlocal pipe
+        if pipe is None:
+            pipe = make_pipeline_train_fn(
+                stage_fn, embed_fn, _flagship_loss_sum, mesh,
+                axis_name=axis_name, n_microbatches=n_microbatches,
+                dp_axis=dp_axis,
+                stage_specs=(None if stage_specs_of is None
+                             else stage_specs_of(stacked)))
         loss_sum, g_stacked, g_edge = pipe(stacked, edge, tokens,
                                            targets)
         n_tok = tokens.shape[0] * tokens.shape[1]
