@@ -191,6 +191,82 @@ def _heap_snapshot_locked(tracemalloc, top: int, stop: bool) -> str:
     return "\n".join(lines)
 
 
+def sample_trace(seconds: float = 2.0, hz: int = 200,
+                 clock=time.monotonic, sleep=time.sleep) -> str:
+    """Execution-trace analogue (Go's ``/debug/pprof/trace``): a
+    time-boxed sampled timeline of every thread, emitted as CHROME
+    TRACE EVENT JSON — load it in Perfetto / chrome://tracing and see
+    which thread ran what, when, and for how long. Consecutive samples
+    whose top frame matches collapse into one span, so the artifact
+    reads as spans of work, not sample confetti. Shares the
+    one-profiler gate with the CPU/block samplers."""
+    if not _profile_lock.acquire(blocking=False):
+        raise ProfileBusyError("a profile is already in progress")
+    try:
+        return _sample_trace_locked(seconds, hz, clock, sleep)
+    finally:
+        _profile_lock.release()
+
+
+def _sample_trace_locked(seconds, hz, clock, sleep) -> str:
+    import json as _json
+
+    me = threading.get_ident()
+    interval = 1.0 / max(hz, 1)
+    t0 = clock()
+    deadline = t0 + seconds
+    # Display lanes are keyed by (ident, thread name), NOT bare ident:
+    # CPython recycles idents, and under request churn a new handler
+    # thread can reuse a dead one's ident between samples — bare-tid
+    # keying would render its work as the dead thread's continuation.
+    lanes: dict[tuple[int, str], int] = {}
+    #: lane -> (current leaf label, span start us); emitted on change
+    open_spans: dict[int, tuple[str, float]] = {}
+    events: list[dict] = []
+
+    def lane_of(tid: int, name: str) -> int:
+        key = (tid, name)
+        lane = lanes.get(key)
+        if lane is None:
+            lane = lanes[key] = len(lanes) + 1
+            events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                           "tid": lane, "args": {"name": name}})
+        return lane
+
+    def close(lane, now_us):
+        label, start = open_spans.pop(lane)
+        events.append({"name": label, "ph": "X", "pid": 1, "tid": lane,
+                       "ts": round(start, 1),
+                       "dur": round(max(now_us - start, 1.0), 1)})
+
+    while clock() < deadline:
+        now_us = (clock() - t0) * 1e6
+        live = {t.ident: t.name for t in threading.enumerate()}
+        frames = sys._current_frames()
+        seen: set[int] = set()
+        for tid, frame in frames.items():
+            if tid == me:
+                continue
+            lane = lane_of(tid, live.get(tid, f"thread-{tid}"))
+            seen.add(lane)
+            code = frame.f_code
+            label = (f"{code.co_name} "
+                     f"({code.co_filename.rsplit('/', 1)[-1]})"
+                     + (" [blocked]" if _is_blocked(frame) else ""))
+            if lane in open_spans and open_spans[lane][0] != label:
+                close(lane, now_us)
+            if lane not in open_spans:
+                open_spans[lane] = (label, now_us)
+        for lane in [ln for ln in open_spans if ln not in seen]:
+            close(lane, now_us)  # thread exited (or its ident recycled)
+        sleep(interval)
+    end_us = (clock() - t0) * 1e6
+    for lane in list(open_spans):
+        close(lane, end_us)
+    return _json.dumps({"traceEvents": events,
+                        "displayTimeUnit": "ms"})
+
+
 def index(prefix: str = "/debug/pprof") -> str:
     return (
         "tpushare pprof endpoints (reference pkg/routes/pprof.go analogue)\n"
@@ -199,6 +275,8 @@ def index(prefix: str = "/debug/pprof") -> str:
         "(threads parked in lock/cond waits)\n"
         f"  {prefix}/mutex                     contended-lock registry "
         "(per-site wait counts/time; exact, not sampled)\n"
+        f"  {prefix}/trace?seconds=2&hz=200    sampled all-threads "
+        "timeline as Chrome trace JSON (open in Perfetto)\n"
         f"  {prefix}/heap[?stop=1]             live-allocation snapshot "
         "(stop=1 disables tracing)\n"
         f"  {prefix}/goroutine                 all-threads stack dump\n")

@@ -137,6 +137,31 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json({"Error": f"malformed request body: {e}"}, 400)
             return None
 
+    def _serve_sampler(self, sampler, *, default_seconds: str,
+                       default_hz: str,
+                       ctype: str = "text/plain; charset=utf-8") -> None:
+        """Shared seconds/hz parse+clamp+dispatch for the time-boxed
+        profilers (profile/block/trace): one home for the bounds and the
+        400/409 contract. NaN is rejected explicitly — it slips through
+        min/max clamping and would silently produce an empty profile."""
+        import math
+
+        q = self._query()
+        try:
+            seconds = float(q.get("seconds", default_seconds))
+            hz = int(q.get("hz", default_hz))
+            if not math.isfinite(seconds):
+                raise ValueError(seconds)
+        except ValueError:
+            self._send_json({"Error": "seconds/hz must be numeric"}, 400)
+            return
+        seconds = min(max(seconds, 0.1), 60.0)
+        hz = min(max(hz, 1), 1000)
+        try:
+            self._send_text(sampler(seconds, hz).encode(), ctype=ctype)
+        except pprof.ProfileBusyError as e:
+            self._send_json({"Error": str(e)}, 409)
+
     # -- verbs -------------------------------------------------------------
     def _query(self) -> dict[str, str]:
         if "?" not in self.path:
@@ -170,33 +195,18 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/debug/pprof":
                 self._send_text(pprof.index().encode())
             elif path == "/debug/pprof/profile":
-                q = self._query()
-                try:
-                    seconds = min(max(float(q.get("seconds", "5")), 0.1), 60.0)
-                    hz = min(max(int(q.get("hz", "100")), 1), 1000)
-                except ValueError:
-                    self._send_json(
-                        {"Error": "seconds/hz must be numeric"}, 400)
-                    return
-                try:
-                    self._send_text(
-                        pprof.sample_profile(seconds, hz).encode())
-                except pprof.ProfileBusyError as e:
-                    self._send_json({"Error": str(e)}, 409)
+                self._serve_sampler(pprof.sample_profile,
+                                    default_seconds="5",
+                                    default_hz="100")
             elif path == "/debug/pprof/block":
-                q = self._query()
-                try:
-                    seconds = min(max(float(q.get("seconds", "5")), 0.1), 60.0)
-                    hz = min(max(int(q.get("hz", "100")), 1), 1000)
-                except ValueError:
-                    self._send_json(
-                        {"Error": "seconds/hz must be numeric"}, 400)
-                    return
-                try:
-                    self._send_text(
-                        pprof.sample_block_profile(seconds, hz).encode())
-                except pprof.ProfileBusyError as e:
-                    self._send_json({"Error": str(e)}, 409)
+                self._serve_sampler(pprof.sample_block_profile,
+                                    default_seconds="5",
+                                    default_hz="100")
+            elif path == "/debug/pprof/trace":
+                self._serve_sampler(pprof.sample_trace,
+                                    default_seconds="2",
+                                    default_hz="200",
+                                    ctype="application/json")
             elif path == "/debug/pprof/mutex":
                 from tpushare.utils import locks
                 self._send_text(locks.render_mutex_profile().encode())
