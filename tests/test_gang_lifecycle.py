@@ -379,3 +379,63 @@ class TestHeterogeneousGang:
         planner.bind_member(big, "hetero")  # quorum -> commit
         assert api.get_pod("default", "big").node_name == "hetero"
         assert api.get_pod("default", "small").node_name == "hetero"
+
+
+class TestCordonAwareQuorum:
+    def test_cordoned_node_capacity_not_counted(self, api):
+        """Two hosts, one cordoned: a min=2 whole-host gang is rejected
+        at the quorum pre-check instead of squatting until the TTL —
+        kube-scheduler would never offer the cordoned host to member 2."""
+        from tpushare.cache.nodeinfo import AllocationError
+
+        api.create_node(make_node("host-0", chips=4, hbm_per_chip=95))
+        api.create_node(make_node("host-1", chips=4, hbm_per_chip=95,
+                                  unschedulable=True))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60)
+        p = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(AllocationError) as ei:
+            planner.bind_member(p, "host-0")
+        assert not isinstance(ei.value, GangPending)
+        assert "infeasible" in str(ei.value)
+        assert planner.stats() == {}  # nothing reserved
+
+    def test_tainted_node_counted_only_with_toleration(self, api):
+        """An untolerated NoSchedule taint hides a host from quorum; the
+        same gang WITH the toleration sees it and reserves."""
+        taint = {"key": "pool", "value": "tpu", "effect": "NoSchedule"}
+        api.create_node(make_node("host-0", chips=4, hbm_per_chip=95))
+        api.create_node(make_node("host-1", chips=4, hbm_per_chip=95,
+                                  taints=[taint]))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60)
+
+        from tpushare.cache.nodeinfo import AllocationError
+        doc = make_pod("w0", chips=4, annotations=ANN)
+        p = api.create_pod(doc)
+        with pytest.raises(AllocationError) as ei:
+            planner.bind_member(p, "host-0")
+        assert not isinstance(ei.value, GangPending)
+        api.delete_pod("default", "w0")
+
+        tolerant = make_pod("t0", chips=4,
+                            annotations={const.ANN_POD_GROUP: "t",
+                                         const.ANN_POD_GROUP_MIN: "2"})
+        tolerant["spec"]["tolerations"] = [
+            {"key": "pool", "operator": "Equal", "value": "tpu",
+             "effect": "NoSchedule"}]
+        pt = api.create_pod(tolerant)
+        with pytest.raises(GangPending):
+            planner.bind_member(pt, "host-0")  # feasible: reserves 1/2
+
+    def test_empty_node_listing_fails_open(self, api):
+        """A not-yet-synced informer lists zero nodes — indistinguishable
+        from an empty cluster, which never reaches bind. Quorum must fail
+        open (like apiserver errors) rather than hard-reject the gang."""
+        api.create_node(make_node("host-0", chips=4, hbm_per_chip=95))
+        api.create_node(make_node("host-1", chips=4, hbm_per_chip=95))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60, node_lister=lambda: [])
+        p = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p, "host-0")  # reserved, not rejected

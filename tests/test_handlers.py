@@ -117,6 +117,17 @@ class TestInspectHandler:
         _, _, _, _, inspect = build_stack(api)
         assert "error" in inspect.handle("ghost")
 
+    def test_inspect_surfaces_cordon(self, api):
+        """A cordoned node is flagged so operators don't read its free
+        chips as placeable capacity."""
+        api.create_node(make_node("cordoned", chips=4, hbm_per_chip=16,
+                                  unschedulable=True))
+        api.create_node(make_node("open", chips=4, hbm_per_chip=16))
+        _, _, _, _, inspect = build_stack(api)
+        nodes = {n["name"]: n for n in inspect.handle()["nodes"]}
+        assert nodes["cordoned"]["unschedulable"] is True
+        assert "unschedulable" not in nodes["open"]
+
 
 @pytest.fixture
 def http_stack(api, v5e_node):

@@ -156,3 +156,54 @@ class TestNodeHelpers:
         })
         assert nodeutils.get_topology(node) == "2x4"
         assert nodeutils.get_tpu_type(node) == "v5e"
+
+
+class TestSchedulability:
+    """is_schedulable mirrors the NodeUnschedulable + TaintToleration
+    filters that run upstream of any extender — our own fleet scans
+    (gang quorum) must exclude the same nodes."""
+
+    def test_plain_node_is_schedulable(self):
+        node = Node(make_node("n"))
+        assert nodeutils.is_schedulable(node)
+
+    def test_cordoned_node_excluded(self):
+        node = Node(make_node("n", unschedulable=True))
+        pod = Pod(make_pod("p", hbm=8))
+        assert not nodeutils.is_schedulable(node, pod)
+
+    def test_cordon_tolerated_by_daemonset_style_pod(self):
+        node = Node(make_node("n", unschedulable=True))
+        doc = make_pod("p", hbm=8)
+        doc["spec"]["tolerations"] = [
+            {"key": "node.kubernetes.io/unschedulable",
+             "operator": "Exists", "effect": "NoSchedule"}]
+        assert nodeutils.is_schedulable(node, Pod(doc))
+
+    def test_noschedule_taint_excluded(self):
+        node = Node(make_node("n", taints=[
+            {"key": "maintenance", "value": "true", "effect": "NoSchedule"}]))
+        assert not nodeutils.is_schedulable(node, Pod(make_pod("p", hbm=8)))
+
+    def test_prefer_noschedule_taint_does_not_exclude(self):
+        node = Node(make_node("n", taints=[
+            {"key": "maintenance", "effect": "PreferNoSchedule"}]))
+        assert nodeutils.is_schedulable(node, Pod(make_pod("p", hbm=8)))
+
+    def test_equal_toleration_matches_value(self):
+        node = Node(make_node("n", taints=[
+            {"key": "pool", "value": "tpu", "effect": "NoSchedule"}]))
+        doc = make_pod("p", hbm=8)
+        doc["spec"]["tolerations"] = [
+            {"key": "pool", "operator": "Equal", "value": "tpu",
+             "effect": "NoSchedule"}]
+        assert nodeutils.is_schedulable(node, Pod(doc))
+        doc["spec"]["tolerations"][0]["value"] = "gpu"
+        assert not nodeutils.is_schedulable(node, Pod(doc))
+
+    def test_empty_key_exists_tolerates_everything(self):
+        node = Node(make_node("n", taints=[
+            {"key": "anything", "value": "x", "effect": "NoExecute"}]))
+        doc = make_pod("p", hbm=8)
+        doc["spec"]["tolerations"] = [{"operator": "Exists"}]
+        assert nodeutils.is_schedulable(node, Pod(doc))

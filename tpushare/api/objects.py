@@ -161,6 +161,22 @@ class Node(K8sObject):
     """A ``v1.Node`` view."""
 
     @property
+    def spec(self) -> dict:
+        return self.raw.get("spec") or {}
+
+    @property
+    def unschedulable(self) -> bool:
+        """``kubectl cordon`` sets ``spec.unschedulable``; kube-scheduler's
+        NodeUnschedulable plugin filters such nodes before any extender is
+        consulted, so OUR planners must apply the same rule when they scan
+        the fleet themselves (gang quorum pre-check)."""
+        return bool(self.spec.get("unschedulable"))
+
+    @property
+    def taints(self) -> list[dict]:
+        return self.spec.get("taints") or []
+
+    @property
     def status(self) -> dict:
         return self.raw.get("status") or {}
 

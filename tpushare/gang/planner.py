@@ -41,6 +41,7 @@ from tpushare.api.objects import Pod, binding_doc
 from tpushare.cache.nodeinfo import AllocationError
 from tpushare.k8s import events
 from tpushare.k8s.errors import ApiError, NotFoundError
+from tpushare.utils import node as nodeutils
 from tpushare.utils import const
 from tpushare.utils import pod as podutils
 
@@ -222,8 +223,20 @@ class GangPlanner:
             # Can't enumerate the cluster: fail open — the TTL rollback
             # still bounds the damage of a wrong guess.
             return True, ""
+        if not nodes:
+            # An empty listing is indistinguishable from a not-yet-synced
+            # informer (startup, relist). A truly empty cluster never
+            # reaches bind (filter has no nodes to pass), so treat this
+            # like the ApiError case: fail open, TTL bounds the damage.
+            return True, ""
         copies = 0
         for node in nodes:
+            if not nodeutils.is_schedulable(node, pod):
+                # Cordoned / untolerated-taint nodes never reach our
+                # filter verb (kube-scheduler excludes them first), so
+                # capacity there can never be bound — counting it would
+                # admit a gang doomed to squat until the TTL.
+                continue
             # peek first: the pre-check is advisory (TTL rollback bounds
             # a stale answer), so the cached ledger is good enough and
             # skipping the per-node apiserver freshness round-trip keeps

@@ -51,7 +51,9 @@ def make_node(name: str, chips: int = 4, hbm_per_chip: int = 16,
               topology: str = "2x2x1", tpu_type: str = "v5e",
               chip_hbm: list[int] | None = None,
               slice_id: str = "", slice_topology: str = "",
-              worker_index: int | None = None) -> dict:
+              worker_index: int | None = None,
+              unschedulable: bool = False,
+              taints: list[dict] | None = None) -> dict:
     caps = chip_hbm if chip_hbm is not None else [hbm_per_chip] * chips
     annotations = {
         const.ANN_NODE_CHIP_HBM: ",".join(str(c) for c in caps),
@@ -64,6 +66,11 @@ def make_node(name: str, chips: int = 4, hbm_per_chip: int = 16,
         annotations[const.ANN_NODE_SLICE_TOPOLOGY] = slice_topology
     if worker_index is not None:
         annotations[const.ANN_NODE_WORKER] = str(worker_index)
+    spec: dict = {}
+    if unschedulable:
+        spec["unschedulable"] = True
+    if taints:
+        spec["taints"] = list(taints)
     return {
         "apiVersion": "v1",
         "kind": "Node",
@@ -71,6 +78,7 @@ def make_node(name: str, chips: int = 4, hbm_per_chip: int = 16,
             "name": name,
             "annotations": annotations,
         },
+        **({"spec": spec} if spec else {}),
         "status": {
             "capacity": {
                 const.HBM_RESOURCE: str(sum(caps)),

@@ -64,6 +64,11 @@ class Inspect:
             "usedHBM": used_total,
             "chips": chips,
         }
+        # Cordon state matters to the operator reading this view: a
+        # "free" cordoned node is not actually placeable capacity (gang
+        # quorum skips it too).
+        if info.node.unschedulable:
+            doc["unschedulable"] = True
         # Position within a multi-host slice, when known: operators (and
         # the what-if CLI) can see which hosts of a slice are grid
         # neighbors — the adjacency gang placement optimizes for.

@@ -166,3 +166,54 @@ def get_tpu_type(node: Node) -> str:
     if "v4" in accel:
         return "v4"
     return ""
+
+
+def _tolerates(toleration: dict, taint: dict) -> bool:
+    """One ``v1.Toleration`` vs one ``v1.Taint``, upstream matching rules
+    (``pkg/apis/core/v1/helper.TolerationsTolerateTaint``): empty effect
+    tolerates every effect, empty key (with Exists) every key; Equal
+    compares values, Exists ignores them."""
+    effect = toleration.get("effect", "")
+    if effect and effect != taint.get("effect"):
+        return False
+    key = toleration.get("key", "")
+    operator = toleration.get("operator", "Equal")
+    if not key:
+        return operator == "Exists"
+    if key != taint.get("key"):
+        return False
+    if operator == "Exists":
+        return True
+    return toleration.get("value", "") == taint.get("value", "")
+
+
+def is_schedulable(node: Node, pod: Pod | None = None) -> bool:
+    """Would kube-scheduler even consider ``node`` for ``pod``?
+
+    Mirrors the NodeUnschedulable + TaintToleration filter plugins that
+    run BEFORE any extender webhook: cordoned nodes
+    (``spec.unschedulable``) and nodes with untolerated
+    NoSchedule/NoExecute taints never reach our filter verb, so fleet
+    scans WE initiate (the gang quorum pre-check in
+    :meth:`tpushare.gang.planner.GangPlanner.quorum_feasible`) must
+    apply the same exclusion — otherwise a gang is admitted against
+    capacity that can never bind and squats on reservations until the
+    TTL. The reference never scanned the fleet itself, so it never had
+    this hazard; it inherited the rule from kube-scheduler for free.
+    """
+    tolerations = (pod.spec.get("tolerations") or []) if pod else []
+    if node.unschedulable:
+        # A cordon is modeled upstream as the synthetic
+        # node.kubernetes.io/unschedulable:NoSchedule taint; only pods
+        # that explicitly tolerate it (DaemonSets in practice — never
+        # TPU workers) may still land on a cordoned node.
+        synthetic = {"key": "node.kubernetes.io/unschedulable",
+                     "effect": "NoSchedule"}
+        if not any(_tolerates(t, synthetic) for t in tolerations):
+            return False
+    for taint in node.taints:
+        if taint.get("effect") not in ("NoSchedule", "NoExecute"):
+            continue  # PreferNoSchedule never excludes
+        if not any(_tolerates(t, taint) for t in tolerations):
+            return False
+    return True
