@@ -253,3 +253,69 @@ def test_debug_routes_can_be_disabled(api):
             assert r.status == 200
     finally:
         server.shutdown()
+
+
+class TestSimulator:
+    """tools/simulate.py — the offline capacity planner replays a
+    scenario through the real stack; its report must match what the
+    live cluster would do."""
+
+    def _run(self, scenario):
+        import simulate
+        return simulate.simulate(scenario)
+
+    def test_example_scenario_end_to_end(self):
+        import simulate
+        import yaml
+        report = self._run(yaml.safe_load(simulate.EXAMPLE))
+        # The example is curated to showcase every verdict class:
+        # serve+batch+gang bound, gang committed via reconciliation,
+        # the rush pod blocked with a preemption plan.
+        assert report["bound"] == 34
+        assert report["held"] == 0
+        assert report["unschedulable"] == 1
+        rush = report["unschedulable_pods"][0]
+        assert rush["pod"] == "rush"
+        assert rush["would_preempt"]  # at least one node offers victims
+        # Gang members that were held at arrival are reported as bound.
+        ring = [p for p in report["placements"]
+                if p["pod"].startswith("ring")]
+        assert len(ring) == 4
+        assert sum(1 for p in ring if p.get("via") == "gang commit") == 3
+
+    def test_cordoned_node_excluded_from_candidates(self):
+        report = self._run({
+            "fleet": [
+                {"prefix": "open", "chips": 4, "hbm_per_chip": 16},
+                {"prefix": "cordoned", "chips": 4, "hbm_per_chip": 16,
+                 "unschedulable": True},
+            ],
+            "workload": [
+                {"count": 8, "name": "w", "hbm": 16},
+            ],
+        })
+        # Only the open node is usable: 4 chips x 16 GiB = 4 pods fit.
+        nodes = {n["name"]: n for n in report["nodes"]}
+        assert nodes["cordoned"]["usedHBM"] == 0
+        assert nodes["cordoned"]["unschedulable"] is True
+        assert nodes["open"]["usedHBM"] == 64
+        assert report["bound"] == 4 and report["unschedulable"] == 4
+        # Headline capacity counts only schedulable nodes; the cordoned
+        # node's free HBM is broken out, not sold as headroom.
+        assert report["total_hbm"] == 64
+        assert report["utilization_pct"] == 100.0
+        assert report["free_whole_chips"] == 0
+        assert report["cordoned_free_hbm"] == 64
+
+    def test_json_report_shape(self, tmp_path, capsys, monkeypatch):
+        import simulate
+        path = tmp_path / "s.yaml"
+        path.write_text("fleet:\n- {prefix: n, chips: 2, hbm_per_chip: 16}\n"
+                        "workload:\n- {name: p, hbm: 8}\n")
+        monkeypatch.setattr(sys, "argv",
+                            ["simulate.py", str(path), "--json"])
+        simulate.main()
+        import json
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["bound"] == 1
+        assert doc["nodes"][0]["pods"] == 1

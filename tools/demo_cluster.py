@@ -16,10 +16,9 @@ import json
 import sys
 import threading
 
-from tpushare.cmd.main import build_stack
+from tpushare.cmd.main import serve_stack, shutdown_stack
 from tpushare.k8s.builders import make_node, make_pod
 from tpushare.k8s.fake import FakeApiServer
-from tpushare.routes.server import ExtenderHTTPServer, serve_forever
 
 
 def main() -> None:
@@ -38,16 +37,7 @@ def main() -> None:
             f"{args.tpu_type}-{i}", chips=args.chips, hbm_per_chip=args.hbm,
             topology=args.topology, tpu_type=args.tpu_type))
 
-    stack = build_stack(api)
-    controller = stack.controller
-    controller.start(workers=2)
-    server = ExtenderHTTPServer(("127.0.0.1", args.port), stack.predicate,
-                                stack.binder, stack.inspect,
-                                prioritize=stack.prioritize,
-                                preempt=stack.preempt,
-                                admission=stack.admission,
-                                gang_planner=stack.binder.gang_planner)
-    serve_forever(server)
+    stack, server = serve_stack(api, ("127.0.0.1", args.port))
     print(f"extender listening on http://127.0.0.1:{args.port} with "
           f"{args.nodes} simulated {args.tpu_type} nodes "
           f"({args.chips} chips x {args.hbm} GiB)", flush=True)
@@ -102,8 +92,7 @@ def main() -> None:
                 print(f"usage: NAME HBM_GIB (got {line!r})", flush=True)
     except KeyboardInterrupt:
         pass
-    server.shutdown()
-    controller.stop()
+    shutdown_stack(stack, server)
 
 
 if __name__ == "__main__":

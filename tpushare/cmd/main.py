@@ -106,6 +106,32 @@ def build_stack(client, is_leader=None) -> Stack:
                  preempt, admission)
 
 
+def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2):
+    """Boot a fully-wired stack and HTTP server over ``client`` and
+    return ``(stack, server)`` — the shared harness for the offline
+    tools (demo cluster, capacity simulator). Wires EVERY verb,
+    including ``gang_planner`` (the gangs-pending gauge freezes
+    silently when it is omitted — see routes/server.py)."""
+    from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+
+    stack = build_stack(client)
+    stack.controller.start(workers=workers)
+    server = ExtenderHTTPServer(
+        address, stack.predicate, stack.binder, stack.inspect,
+        prioritize=stack.prioritize, preempt=stack.preempt,
+        admission=stack.admission,
+        gang_planner=stack.binder.gang_planner)
+    serve_forever(server)
+    return stack, server
+
+
+def shutdown_stack(stack, server) -> None:
+    """Tear down a :func:`serve_stack` harness in dependency order."""
+    server.shutdown()
+    stack.binder.gang_planner.stop()
+    stack.controller.stop()
+
+
 def main() -> None:
     level = os.environ.get("LOG_LEVEL", "info").upper()
     logging.basicConfig(
