@@ -13,9 +13,8 @@ import urllib.request
 import pytest
 
 from tests.conftest import make_node, make_pod
-from tpushare.cmd.main import build_stack
+from tpushare.cmd.main import serve_stack, shutdown_stack
 from tpushare.k8s.fake import FakeApiServer
-from tpushare.routes.server import ExtenderHTTPServer, serve_forever
 from tpushare.utils import const
 from tpushare.utils import pod as podutils
 
@@ -25,20 +24,12 @@ class Cluster:
 
     def __init__(self, api: FakeApiServer):
         self.api = api
-        stack = build_stack(api)
-        self.controller = stack.controller
-        self.controller.start(workers=2)
-        self.server = ExtenderHTTPServer(
-            ("127.0.0.1", 0), stack.predicate, stack.binder, stack.inspect,
-            prioritize=stack.prioritize, preempt=stack.preempt,
-            admission=stack.admission,
-            gang_planner=stack.binder.gang_planner)
-        serve_forever(self.server)
+        self.stack, self.server = serve_stack(api)
+        self.controller = self.stack.controller
         self.base = f"http://127.0.0.1:{self.server.server_address[1]}"
 
     def close(self):
-        self.server.shutdown()
-        self.controller.stop()
+        shutdown_stack(self.stack, self.server)
 
     # -- a minimal kube-scheduler: filter then bind ---------------------- #
 

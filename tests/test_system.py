@@ -1,0 +1,133 @@
+"""Whole-system test: extender + gang planner + per-host device plugins.
+
+The reference's end-to-end story spans two repos and a real cluster
+(scheduler extender binds, then the node's device plugin matches the
+pod by assume-time and flips ASSIGNED — reference
+``docs/designs/designs.md:84-104``); its only validation was demo
+videos. This test runs the ENTIRE protocol in-process: a 2-host gang is
+scheduled through the real HTTP extender (filter → bind per member,
+commit at quorum), then each host's device-plugin daemon — real gRPC
+over unix sockets, driven by a fake kubelet exactly as kubelet would —
+serves Allocate, injects the TPU env, and completes the two-phase
+``ASSIGNED false→true`` handshake the extender began.
+"""
+
+import time
+
+import pytest
+
+from tests.test_e2e import Cluster
+from tpushare.deviceplugin import discovery as disc
+from tpushare.deviceplugin.kubelet import (
+    FakeKubelet, run_node_daemon, socket_name)
+from tpushare.k8s.builders import make_node, make_pod
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.utils import const
+from tpushare.utils import pod as podutils
+
+HOSTS = ("host-a", "host-b")
+
+
+@pytest.fixture
+def system(tmp_path):
+    """Extender over HTTP + one device-plugin daemon per host, all
+    sharing one fake apiserver (the real system's shape: one control
+    plane, one kubelet+plugin pair per node)."""
+    api = FakeApiServer()
+    for host in HOSTS:
+        api.create_node(make_node(host, chips=4, hbm_per_chip=16,
+                                  topology="2x2x1", tpu_type="v5e"))
+    cluster = Cluster(api)
+    kubelets, daemons = {}, []
+    for host in HOSTS:
+        plugin_dir = str(tmp_path / host)
+        (tmp_path / host).mkdir()
+        kubelet = FakeKubelet(plugin_dir)
+        kubelet.start()
+        kubelets[host] = kubelet
+        daemons.extend(run_node_daemon(
+            host, api, disc.fake_inventory(chips=4, hbm_gib=16,
+                                           tpu_type="v5e"),
+            plugin_dir=plugin_dir, poll_interval=0.05))
+    yield api, cluster, kubelets
+    for s in daemons:
+        s.stop()
+    for kubelet in kubelets.values():
+        kubelet.stop()
+    cluster.close()
+
+
+def test_gang_then_device_plugin_allocate(system):
+    """A 2-host whole-chip gang goes from kube-scheduler wire calls to
+    per-host device grants: bind commits both members atomically, each
+    host's plugin matches ITS pod, injects the chip env, and flips
+    ASSIGNED — no cross-host confusion, ledger and inspect agree."""
+    api, cluster, kubelets = system
+    ann = {const.ANN_POD_GROUP: "ring", const.ANN_POD_GROUP_MIN: "2"}
+
+    # Member 1: held below quorum (bind returns the GangPending error;
+    # the scheduler would retry). Member 2 completes the quorum.
+    from tpushare.gang.planner import QUORUM_HOLD_MARKER
+    w0 = api.create_pod(make_pod("w0", chips=4, annotations=ann))
+    bound, detail = cluster.schedule(w0.raw)
+    assert not bound and QUORUM_HOLD_MARKER in str(detail)
+    w1 = api.create_pod(make_pod("w1", chips=4, annotations=ann))
+    bound, node1 = cluster.schedule(w1.raw)
+    assert bound
+
+    # Commit placed the two members on the two distinct hosts.
+    placed = {}
+    for name in ("w0", "w1"):
+        pod = api.get_pod("default", name)
+        assert pod.node_name in HOSTS
+        assert pod.annotations[const.ANN_ASSIGNED] == const.ASSIGNED_FALSE
+        placed[pod.node_name] = pod
+    assert set(placed) == set(HOSTS)
+
+    # Each host's kubelet now calls Allocate on ITS plugin — the grant
+    # must match the extender's plan for the local pod, not the peer's.
+    for host, pod in placed.items():
+        chip_ids = podutils.get_chip_ids_from_annotation(pod)
+        assert len(chip_ids) == 4  # whole host
+        ids = [f"tpushare-chip-{i:02d}" for i in chip_ids]
+        resp = kubelets[host].allocate(socket_name(const.CHIP_RESOURCE),
+                                       ids)
+        creq = resp.container_responses[0]
+        visible = creq.envs[const.ENV_TPU_VISIBLE_CHIPS]
+        assert sorted(int(c) for c in visible.split(",")) == chip_ids
+        # Whole-chip tenants get the device nodes, exclusively.
+        assert len(creq.devices) == 4
+        final = api.get_pod("default", pod.name)
+        assert final.annotations[const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+    # Control plane and node runtime agree afterwards: inspect shows
+    # both hosts fully used by their member.
+    doc = cluster.inspect()
+    for node in doc["nodes"]:
+        assert node["usedHBM"] == node["totalHBM"] == 64
+        names = {p["name"] for c in node["chips"] for p in c["pods"]}
+        assert names == {placed[node["name"]].name}
+
+
+def test_hbm_slice_two_phase_handshake(system):
+    """A lone HBM slice walks the same two-phase protocol: extender
+    writes ASSIGNED=false + assume-time, plugin matches by those
+    annotations, injects the mem-fraction env, flips true."""
+    api, cluster, kubelets = system
+    pod = api.create_pod(make_pod("slice", hbm=8))
+    bound, node = cluster.schedule(pod.raw)
+    assert bound
+    annotated = api.get_pod("default", "slice")
+    assert annotated.annotations[const.ANN_ASSIGNED] == const.ASSIGNED_FALSE
+    assert int(annotated.annotations[const.ANN_ASSUME_TIME]) <= time.time_ns()
+
+    chip = int(annotated.annotations[const.ANN_CHIP_IDX])
+    ids = [f"tpushare-hbm-{chip:02d}-{i:03d}" for i in range(8)]
+    resp = kubelets[node].allocate(socket_name(const.HBM_RESOURCE), ids)
+    creq = resp.container_responses[0]
+    assert creq.envs[const.ENV_CHIP_IDX] == str(chip)
+    # 8 GiB of a 16-GiB chip, scaled by the safety margin (0.9): the
+    # fraction several co-tenant JAX processes can safely premap.
+    assert creq.envs[const.ENV_XLA_MEM_FRACTION] == "0.45"
+    assert api.get_pod("default", "slice").annotations[
+        const.ANN_ASSIGNED] == const.ASSIGNED_TRUE

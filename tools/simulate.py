@@ -217,13 +217,21 @@ def simulate(scenario: dict) -> dict:
     return _report(inspect_doc, placements, held, unschedulable, latencies)
 
 
+class WireError(RuntimeError):
+    """A verb returned an unexpected HTTP failure — the replay cannot
+    produce a truthful report, so it aborts instead of guessing."""
+
+
 def _schedule_one(client: _Client, pod, candidates: list[str]) -> dict:
+    from tpushare.gang.planner import QUORUM_HOLD_MARKER
+
     if not candidates:
         return {"state": "unschedulable",
                 "reason": "no schedulable node (cordon/taints)"}
     status, result = client.post("/tpushare-scheduler/filter",
                                  {"Pod": pod.raw, "NodeNames": candidates})
-    assert status == 200, result
+    if status != 200:
+        raise WireError(f"filter HTTP {status}: {result}")
     passing = result.get("NodeNames") or []
     if not passing:
         # Representative rejection reason (they are per-node).
@@ -232,18 +240,19 @@ def _schedule_one(client: _Client, pod, candidates: list[str]) -> dict:
                 "reason": next(iter(reasons.values()), "no node fits")}
     status, ranked = client.post("/tpushare-scheduler/prioritize",
                                  {"Pod": pod.raw, "NodeNames": passing})
-    assert status == 200, ranked
+    if status != 200:
+        raise WireError(f"prioritize HTTP {status}: {ranked}")
     best = max(ranked, key=lambda e: e["Score"])["Host"]
     status, bound = client.post("/tpushare-scheduler/bind", {
         "PodName": pod.name, "PodNamespace": pod.namespace,
         "PodUID": pod.uid, "Node": best})
     if status != 200 or bound.get("Error"):
         # The wire carries only Error (the scheduler retries on 500);
-        # a gang hold is distinguished by the GangPending message. The
+        # a gang hold is distinguished by the GangPending marker. The
         # final reconciliation pass upgrades held members that commit
         # once the rest of their gang arrives.
         err = bound.get("Error", f"bind HTTP {status}")
-        if "pending quorum" in err:
+        if QUORUM_HOLD_MARKER in err:
             return {"state": "held", "pending": True, "node": best,
                     "reason": err}
         return {"state": "unschedulable", "reason": err}
@@ -360,7 +369,10 @@ def main() -> None:
         return
     if not args.scenario:
         ap.error("scenario file required (or --example)")
-    sys.path.insert(0, ".")
+    # Runnable from anywhere without pip-installing the package.
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     report = simulate(load_scenario(args.scenario))
     if args.as_json:
         print(json.dumps(report))

@@ -112,8 +112,6 @@ def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2):
     tools (demo cluster, capacity simulator). Wires EVERY verb,
     including ``gang_planner`` (the gangs-pending gauge freezes
     silently when it is omitted — see routes/server.py)."""
-    from tpushare.routes.server import ExtenderHTTPServer, serve_forever
-
     stack = build_stack(client)
     stack.controller.start(workers=workers)
     server = ExtenderHTTPServer(

@@ -48,6 +48,14 @@ from tpushare.utils import pod as podutils
 log = logging.getLogger(__name__)
 
 
+#: Substring every GangPending message carries. The wire format has no
+#: structured "pending" field (the reference's ExtenderBindingResult is
+#: Error-only), so out-of-process consumers (the capacity simulator, a
+#: retrying operator script) distinguish an expected hold from a real
+#: bind failure by this marker — change it here and nowhere else.
+QUORUM_HOLD_MARKER = "pending quorum"
+
+
 class GangPending(AllocationError):
     """Member reserved; group below quorum — scheduler should retry."""
 
@@ -324,7 +332,7 @@ class GangPlanner:
             else:
                 raise GangPending(
                     f"gang {group.name}: {reserved_n}/{group.minimum} "
-                    f"members reserved; pod held pending quorum")
+                    f"members reserved; pod held {QUORUM_HOLD_MARKER}")
 
         for member_pod, member_node in newly_committed:
             events.record(
