@@ -149,3 +149,23 @@ class TestAdmissionReviewWire:
         assert out["response"]["allowed"] is True
         out = adm.handle({})
         assert out["response"]["allowed"] is True
+
+
+class TestScoringAnnotation:
+    def test_invalid_scoring_value_rejected(self, api, v5e_node):
+        """An explicit tpushare.io/scoring typo is caught at CREATE —
+        the prioritizer would silently fall back to the fleet default,
+        which is exactly the kind of quiet misbehavior the webhook
+        exists to surface."""
+        pod = Pod(make_pod("p", hbm=8,
+                           annotations={const.ANN_SCORING: "binpak"}))
+        ok, reason = _admission(api).validate(pod)
+        assert not ok and "binpak" in reason and "binpack" in reason
+
+    def test_valid_scoring_values_pass(self, api, v5e_node):
+        adm = _admission(api)
+        for value in const.SCORING_POLICIES:
+            pod = Pod(make_pod("p", hbm=8,
+                               annotations={const.ANN_SCORING: value}))
+            ok, _ = adm.validate(pod)
+            assert ok, value

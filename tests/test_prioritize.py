@@ -310,3 +310,31 @@ class TestScoringPolicy:
         cache = SchedulerCache(api.get_node, api.list_pods)
         with pytest.raises(ValueError, match="unknown scoring policy"):
             Prioritize(cache, policy="tetris")
+
+    def test_per_pod_annotation_overrides_fleet_policy(self, api):
+        """One fleet, two intents: an inference pod annotated
+        tpushare.io/scoring=spread ranks the pristine host first while
+        an unannotated trainer under the binpack default still packs."""
+        cache = self._two_nodes(api)
+        binpack_fleet = Prioritize(cache)  # fleet default: binpack
+        infer = make_pod("infer", hbm=8,
+                         annotations={const.ANN_SCORING: "spread"})
+        trainer = make_pod("trainer", hbm=8)
+        s_infer = scores(binpack_fleet, infer, ["partial", "pristine"])
+        s_trainer = scores(binpack_fleet, trainer, ["partial", "pristine"])
+        assert s_infer["pristine"] > s_infer["partial"]
+        assert s_trainer["partial"] > s_trainer["pristine"]
+        # And the mirror: a spread fleet with a binpack-annotated pod.
+        spread_fleet = Prioritize(cache, policy="spread")
+        packer = make_pod("packer", hbm=8,
+                          annotations={const.ANN_SCORING: "binpack"})
+        s_packer = scores(spread_fleet, packer, ["partial", "pristine"])
+        assert s_packer["partial"] > s_packer["pristine"]
+
+    def test_unknown_annotation_value_falls_back(self, api):
+        cache = self._two_nodes(api)
+        prio = Prioritize(cache)
+        typo = make_pod("typo", hbm=8,
+                        annotations={const.ANN_SCORING: "binpak"})
+        s = scores(prio, typo, ["partial", "pristine"])
+        assert s["partial"] > s["pristine"]  # fleet default applied

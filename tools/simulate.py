@@ -37,6 +37,8 @@ Scenario schema (YAML or JSON)::
         priority: 1000           # pod priority            (optional)
         tolerations:             # v1.Toleration list      (optional)
           - {key: pool, operator: Exists}
+        annotations:             # extra pod annotations   (optional)
+          tpushare.io/scoring: spread
 
 Each pod is scheduled the way kube-scheduler would drive the extender:
 upstream cordon/taint filtering, then ``POST filter`` →
@@ -115,7 +117,9 @@ def _expand_workload(scenario: dict) -> list[dict]:
     for group in scenario.get("workload", []):
         count = int(group.get("count", 1))
         base = group["name"]
-        ann = {}
+        # Arbitrary pod annotations pass through, e.g.
+        # {tpushare.io/scoring: spread} to trial mixed scoring policies.
+        ann = dict(group.get("annotations") or {})
         if group.get("group"):
             ann[const.ANN_POD_GROUP] = str(group["group"])
             ann[const.ANN_POD_GROUP_MIN] = str(

@@ -117,6 +117,17 @@ class Admission:
                         f"{raw_min!r}; when set it must be an integer >= 1 "
                         "(omit it to default to 1)")
 
+        scoring = pod.annotations.get(const.ANN_SCORING)
+        if scoring is not None and scoring not in const.SCORING_POLICIES:
+            # The prioritizer falls back to the fleet default on unknown
+            # values (a typo must not break scoring when this webhook is
+            # absent), but with the webhook installed the typo is caught
+            # where the user can see it: at CREATE.
+            return False, (
+                f"annotation {const.ANN_SCORING}={scoring!r} is not a "
+                f"scoring policy; expected one of "
+                f"{', '.join(const.SCORING_POLICIES)}")
+
         max_chip, max_chips, nodes = self._fleet_shape()
         if nodes == 0:
             return True, ""  # fleet unknown: fail open

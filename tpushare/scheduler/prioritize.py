@@ -36,6 +36,7 @@ import logging
 
 from tpushare.api.extender import ExtenderArgs, HostPriority
 from tpushare.cache.cache import SchedulerCache
+from tpushare.utils import const
 from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
 
@@ -57,19 +58,39 @@ class Prioritize:
         Gang consolidation, ICI-compactness, and slice-affinity bonuses
         apply under BOTH policies: a gang wants its members together
         and its chips adjacent regardless of how lone pods spread."""
-        if policy not in ("binpack", "spread"):
+        if policy not in const.SCORING_POLICIES:
             raise ValueError(
                 f"unknown scoring policy {policy!r}; expected "
-                "'binpack' or 'spread'")
+                f"one of {const.SCORING_POLICIES}")
         self.cache = cache
         self.gang_planner = gang_planner
         self.policy = policy
+
+    def _policy_for(self, pod) -> str:
+        """Effective policy: the pod's ``tpushare.io/scoring`` annotation
+        when valid, else the fleet default — inference pods spread while
+        trainers bin-pack in one fleet. Unknown values fall back to the
+        default (the admission webhook rejects them at CREATE when
+        installed; without it, a typo must not break scoring)."""
+        override = pod.annotations.get(const.ANN_SCORING, "")
+        if override in const.SCORING_POLICIES:
+            return override
+        if override:
+            # debug, not warning: the scheduler re-runs prioritize every
+            # cycle for a pending pod, and repeating the same complaint
+            # for its whole lifetime is log spam (the webhook surfaces
+            # the typo loudly, at CREATE, exactly once).
+            log.debug("pod %s/%s: ignoring unknown %s=%r",
+                      pod.namespace, pod.name, const.ANN_SCORING,
+                      override)
+        return self.policy
 
     # ------------------------------------------------------------------ #
     # Per-node scoring
     # ------------------------------------------------------------------ #
 
-    def _score_hbm(self, info, req: int, gang_nodes: set[str]) -> int:
+    def _score_hbm(self, info, req: int, gang_nodes: set[str],
+                   policy: str) -> int:
         avail = info.get_available_hbm()
         fits = [(avail[i], info.chips[i].total_hbm)
                 for i in avail if avail[i] >= req]
@@ -80,7 +101,7 @@ class Prioritize:
         # binpack: waste == 0 -> 10; waste == full pristine chip -> 0.
         # spread: inverted — the emptiest fitting chip wins.
         fit = (waste / cap) if cap else 0.0
-        if self.policy == "binpack":
+        if policy == "binpack":
             fit = 1.0 - fit
         score = round(MAX_SCORE * fit)
         if gang_nodes and info.name in gang_nodes and score < MAX_SCORE:
@@ -88,7 +109,8 @@ class Prioritize:
         return max(0, min(MAX_SCORE, score))
 
     def _score_chips(self, info, req: int,
-                     member_slices: dict | None = None) -> int:
+                     member_slices: dict | None,
+                     policy: str) -> int:
         free = info.get_free_chips()
         if len(free) < req or info.chip_count == 0:
             return 0
@@ -96,7 +118,7 @@ class Prioritize:
         # binpack: exact pack -> 8, cracking a pristine host -> low.
         # spread: inverted — the emptiest host wins.
         fit = leftover / info.chip_count
-        if self.policy == "binpack":
+        if policy == "binpack":
             fit = 1.0 - fit
         score = round((MAX_SCORE - 2) * fit)
         chosen = info.topology.select_compact(free, req)
@@ -166,19 +188,22 @@ class Prioritize:
         req_chips = podutils.get_chips_from_pod_resource(pod)
         req_hbm = podutils.get_hbm_from_pod_resource(pod)
         return self._score_one(node_name, req_chips, req_hbm, gang_nodes,
-                               self._member_slices(gang_nodes))
+                               self._member_slices(gang_nodes),
+                               policy=self._policy_for(pod))
 
     def _score_one(self, node_name: str, req_chips: int, req_hbm: int,
                    gang_nodes: set[str],
-                   member_slices: dict | None = None) -> int:
+                   member_slices: dict | None,
+                   policy: str) -> int:
         info = self.cache.get_node_info(node_name)
         if info is None:
             return 0
         if req_chips > 0:
-            return self._score_chips(info, req_chips, member_slices)
+            return self._score_chips(info, req_chips, member_slices,
+                                     policy=policy)
         if req_hbm <= 0:
             return 0
-        return self._score_hbm(info, req_hbm, gang_nodes)
+        return self._score_hbm(info, req_hbm, gang_nodes, policy=policy)
 
     def handle(self, args: ExtenderArgs) -> list[HostPriority]:
         pod = args.pod
@@ -201,8 +226,10 @@ class Prioritize:
                 # on a slice already holding a member (ICI over DCN).
                 member_slices = self._member_slices(gang_nodes)
 
+        policy = self._policy_for(pod)
         out = [HostPriority(host=n, score=self._score_one(
-                   n, req_chips, req_hbm, gang_nodes, member_slices))
+                   n, req_chips, req_hbm, gang_nodes, member_slices,
+                   policy=policy))
                for n in names]
         log.debug("prioritize pod %s: %s", pod.key(),
                   {e.host: e.score for e in out})

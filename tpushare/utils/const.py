@@ -117,6 +117,16 @@ ANN_POD_GROUP_MIN = "tpushare.io/pod-group-min"
 #: (Job/JobSet) then restarts the WHOLE group, which re-gangs atomically.
 ANN_POD_GROUP_REAP = "tpushare.io/pod-group-reap"
 
+#: Per-pod scoring-policy override for the prioritize verb: "binpack"
+#: (tightest fit) or "spread" (emptiest fit). The fleet default comes
+#: from the extender's TPUSHARE_SCORING env; this annotation lets a
+#: latency-sensitive inference pod spread across chips while the batch
+#: trainers in the SAME fleet keep bin-packing.
+ANN_SCORING = "tpushare.io/scoring"
+
+#: Legal values for ANN_SCORING / TPUSHARE_SCORING.
+SCORING_POLICIES = ("binpack", "spread")
+
 # --------------------------------------------------------------------------
 # Environment variables injected into containers by the device plugin at
 # Allocate() time (counterpart of the reference's SHARED_GPU_MEM_* env
