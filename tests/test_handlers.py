@@ -210,3 +210,79 @@ class TestHTTPGolden:
         assert b"tpushare_leader 1.0" in body
         status, body = _get(f"{base}/debug/threads")
         assert b"tpushare-http" in body or b"MainThread" in body
+
+
+class TestDemandSignal:
+    """The autoscaler signal: pods failing the filter on EVERY node are
+    aggregated into tpushare_unschedulable_* gauges; a pod that fits
+    (or fits again after churn) drops out immediately."""
+
+    def test_unplaceable_demand_tracked_and_cleared(self, api, v5e_node):
+        _, pred, _, binder, _ = build_stack(api)
+        # 99 GiB fits no 16-GiB chip: unplaceable.
+        big = api.create_pod(make_pod("big", hbm=99, uid="u-big"))
+        pred.handle(ExtenderArgs(pod=big, node_names=["v5e-node-0"]))
+        assert pred.demand.snapshot() == (1, 99, 0)
+        # A 4-chip pod on a 4-chip busy fleet: also unplaceable.
+        api.create_pod(make_pod("fit", hbm=8, uid="u-fit"))
+        binder.handle(ExtenderBindingArgs(
+            pod_name="fit", pod_namespace="default", pod_uid="u-fit",
+            node="v5e-node-0"))
+        whole = api.create_pod(make_pod("whole", chips=4, uid="u-whole"))
+        pred.handle(ExtenderArgs(pod=whole, node_names=["v5e-node-0"]))
+        assert pred.demand.snapshot() == (2, 99, 4)
+        # The slice pod completes; the whole-chip pod's retry now passes
+        # -> its demand entry clears.
+        api.update_pod_status("default", "fit", "Succeeded")
+        pred.cache.remove_pod(api.get_pod("default", "fit"))
+        pred.handle(ExtenderArgs(pod=whole, node_names=["v5e-node-0"]))
+        assert pred.demand.snapshot() == (1, 99, 0)
+
+    def test_entries_expire_by_ttl(self, api, v5e_node):
+        import time
+
+        from tpushare.scheduler.predicate import DemandTracker, Predicate
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        pred = Predicate(cache, demand=DemandTracker(ttl=0.05))
+        big = api.create_pod(make_pod("big", hbm=99, uid="u1"))
+        pred.handle(ExtenderArgs(pod=big, node_names=["v5e-node-0"]))
+        assert pred.demand.snapshot()[0] == 1
+        time.sleep(0.08)
+        # Not refreshed within the TTL (pod deleted / stopped retrying):
+        # pruned on the next scrape.
+        assert pred.demand.snapshot() == (0, 0, 0)
+
+    def test_gauges_on_the_wire(self, http_stack):
+        api, base = http_stack
+        big = api.create_pod(make_pod("big", hbm=99, uid="u-big"))
+        _post(f"{base}/tpushare-scheduler/filter",
+              {"Pod": big.raw, "NodeNames": ["v5e-node-0"]})
+        status, body = _get(f"{base}/metrics")
+        assert b"tpushare_unschedulable_pods 1.0" in body
+        assert b"tpushare_unschedulable_demand_hbm_gib 99.0" in body
+
+    def test_informer_prune_retires_stale_demand(self, api, v5e_node):
+        """HA-safety: a pod bound by a PEER replica (or deleted by the
+        user) never produces a false unplaceable-demand page here — the
+        scrape re-checks entries against the informer's pod view."""
+        from tpushare.scheduler.predicate import DemandTracker, Predicate
+
+        def lookup(ns, name):
+            try:
+                return api.get_pod(ns, name)
+            except Exception:
+                return None
+
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        pred = Predicate(cache, demand=DemandTracker(pod_lookup=lookup))
+        gone = api.create_pod(make_pod("gone", hbm=99, uid="u-gone"))
+        bound = api.create_pod(make_pod("bound", hbm=99, uid="u-bound"))
+        for p in (gone, bound):
+            pred.handle(ExtenderArgs(pod=p, node_names=["v5e-node-0"]))
+        assert pred.demand.snapshot()[0] == 2
+        # Peer replica binds one; user deletes the other.
+        api.bind_pod({"metadata": {"name": "bound",
+                                   "namespace": "default"},
+                      "target": {"name": "v5e-node-0"}})
+        api.delete_pod("default", "gone")
+        assert pred.demand.snapshot() == (0, 0, 0)

@@ -41,7 +41,7 @@ from tpushare.routes.server import (ExtenderHTTPServer, enable_tls,
 from tpushare.scheduler.admission import Admission
 from tpushare.scheduler.bind import Bind
 from tpushare.scheduler.inspect import Inspect
-from tpushare.scheduler.predicate import Predicate
+from tpushare.scheduler.predicate import DemandTracker, Predicate
 from tpushare.scheduler.preempt import Preempt
 from tpushare.scheduler.prioritize import Prioritize
 
@@ -89,7 +89,11 @@ def build_stack(client, is_leader=None) -> Stack:
                        node_lister=controller.hub.nodes.list,
                        is_leader=is_leader)
     gang.start()  # housekeeping tick: gang expiry + bind retries
-    predicate = Predicate(controller.cache)
+    # Demand entries prune against the informer's pod view so an HA
+    # peer's bind (or a user's delete) retires the autoscaler signal
+    # on every replica, not just the one that saw the passing filter.
+    predicate = Predicate(controller.cache, demand=DemandTracker(
+        pod_lookup=controller.hub.get_pod))
     # TPUSHARE_SCORING=spread flips the fit scoring for fleets that
     # prefer fewer co-tenants per chip over packing density.
     prioritize = Prioritize(

@@ -96,6 +96,23 @@ GANGS_PENDING = Gauge(
     "Gangs holding reservations below quorum (stuck gangs -> alert)",
     registry=REGISTRY,
 )
+UNSCHED_PODS = Gauge(
+    "tpushare_unschedulable_pods",
+    "TPU pods currently failing the filter on every offered node — "
+    "demand the fleet cannot place. Sustained nonzero: add TPU nodes "
+    "(the stock cluster-autoscaler cannot see extender resources).",
+    registry=REGISTRY,
+)
+UNSCHED_HBM = Gauge(
+    "tpushare_unschedulable_demand_hbm_gib",
+    "Aggregate HBM (GiB) requested by currently-unplaceable TPU pods",
+    registry=REGISTRY,
+)
+UNSCHED_CHIPS = Gauge(
+    "tpushare_unschedulable_demand_chips",
+    "Aggregate whole chips requested by currently-unplaceable TPU pods",
+    registry=REGISTRY,
+)
 IS_LEADER = Gauge(
     "tpushare_leader",
     "1 when this replica binds (lease holder, or election off); 0 when "
@@ -124,10 +141,15 @@ def observe_cache(cache) -> None:
             HBM_USED.labels(node=info.name).set(used)
 
 
-def scrape(cache, gang_planner=None, leader=None) -> bytes:
+def scrape(cache, gang_planner=None, leader=None, demand=None) -> bytes:
     """Atomic observe+render for the /metrics handler."""
     with _SCRAPE_LOCK:
         observe_cache(cache)
+        if demand is not None:
+            pods, hbm, chips = demand.snapshot()
+            UNSCHED_PODS.set(pods)
+            UNSCHED_HBM.set(hbm)
+            UNSCHED_CHIPS.set(chips)
         if gang_planner is not None:
             # stats() is the cheap view (no member lists / TTL math) —
             # this runs under the scrape lock.

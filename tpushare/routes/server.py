@@ -186,7 +186,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_text(
                     metrics.scrape(self.server.inspect.cache,
                                    gang_planner=self.server.gang_planner,
-                                   leader=self.server.leader),
+                                   leader=self.server.leader,
+                                   demand=self.server.predicate.demand),
                     ctype="text/plain; version=0.0.4")
             elif path.startswith("/debug/") and not self.server.debug_routes:
                 self._send_json({"Error": "debug routes disabled"}, 404)

@@ -15,6 +15,8 @@ bind time.
 from __future__ import annotations
 
 import logging
+import threading
+import time
 
 from tpushare.api.extender import ExtenderArgs, ExtenderFilterResult
 from tpushare.cache.cache import SchedulerCache
@@ -24,11 +26,85 @@ from tpushare.utils import pod as podutils
 log = logging.getLogger(__name__)
 
 
+class DemandTracker:
+    """Unplaceable demand, as seen from the filter verb — the
+    cluster-autoscaler signal the reference never had.
+
+    The stock autoscaler cannot reason about a webhook's extended
+    resources: a pod rejected by OUR filter on every node looks, to the
+    autoscaler, like a pod the cluster shape already satisfies. This
+    tracker aggregates the pods currently failing everywhere (and what
+    they ask for) into gauges an autoscaler or operator can act on:
+    nonzero `tpushare_unschedulable_demand_*` for N minutes means the
+    fleet needs more TPU nodes, not more retries.
+
+    A pod passing filter on THIS replica clears its entry immediately.
+    That is not enough by itself: in the HA deployment every replica
+    answers filter behind one Service, so the pod's passing retry (or
+    its deletion) can land on a peer and this replica would page about
+    demand that is already running. Each scrape therefore re-checks
+    entries against the local informer's pod view (``pod_lookup``) —
+    a pod that is gone, re-created under a new UID, bound (by anyone),
+    or terminated is pruned on the spot, replica-independently. The
+    ``ttl`` is only the backstop for a missing lookup."""
+
+    def __init__(self, ttl: float = 900.0, pod_lookup=None):
+        self.ttl = ttl
+        #: Optional lister-style fetch ``(ns, name) -> Pod | None``.
+        self.pod_lookup = pod_lookup
+        self._lock = threading.Lock()
+        #: uid -> (hbm GiB, chips, (ns, name), last-seen monotonic)
+        self._entries: dict[str, tuple[int, int, tuple, float]] = {}
+
+    def record_unplaceable(self, pod) -> None:
+        hbm = podutils.get_hbm_from_pod_resource(pod)
+        chips = podutils.get_chips_from_pod_resource(pod)
+        with self._lock:
+            self._entries[pod.uid] = (hbm, chips,
+                                      (pod.namespace, pod.name),
+                                      time.monotonic())
+
+    def clear(self, uid: str) -> None:
+        with self._lock:
+            self._entries.pop(uid, None)
+
+    def _still_pending(self, uid: str, ns_name: tuple) -> bool:
+        """Is the pod still an unsatisfied demand, per the informer?"""
+        try:
+            pod = self.pod_lookup(*ns_name)
+        except Exception:
+            return True  # lookup trouble: keep the entry, TTL bounds it
+        return (pod is not None and pod.uid == uid
+                and not pod.node_name
+                and not podutils.is_complete_pod(pod))
+
+    def snapshot(self) -> tuple[int, int, int]:
+        """(pods, total HBM GiB, total chips) still unplaceable; prunes
+        expired and no-longer-pending entries as a side effect."""
+        now = time.monotonic()
+        with self._lock:
+            dead = [
+                uid
+                for uid, (_, _, ns_name, seen) in self._entries.items()
+                if now - seen > self.ttl
+                or (self.pod_lookup is not None
+                    and not self._still_pending(uid, ns_name))
+            ]
+            for uid in dead:
+                del self._entries[uid]
+            pods = len(self._entries)
+            hbm = sum(e[0] for e in self._entries.values())
+            chips = sum(e[1] for e in self._entries.values())
+        return pods, hbm, chips
+
+
 class Predicate:
     name = "tpushare-filter"
 
-    def __init__(self, cache: SchedulerCache):
+    def __init__(self, cache: SchedulerCache,
+                 demand: DemandTracker | None = None):
         self.cache = cache
+        self.demand = demand or DemandTracker()
 
     def filter_node(self, pod, node_name: str) -> tuple[bool, str]:
         """The per-node admission check (reference
@@ -63,6 +139,12 @@ class Predicate:
         if args.nodes is not None:
             by_name = {n.name: n for n in args.nodes}
             passed_nodes = [by_name[n] for n in passed_names if n in by_name]
+        if not passed_names and failed:
+            # Failed EVERY offered node: this demand needs capacity the
+            # fleet doesn't have — the autoscaler-visible signal.
+            self.demand.record_unplaceable(pod)
+        else:
+            self.demand.clear(pod.uid)
         log.debug(
             "filter pod %s: %d passed, %d failed",
             pod.key(), len(passed_names), len(failed),
