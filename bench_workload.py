@@ -26,6 +26,11 @@ Measures, on the one real chip:
    story and costs ~20% MFU in forward recompute), with the XLA
    attention path and with the Pallas flash path. MFU counts model
    FLOPs only (fwd + 2x bwd).
+3. **Scale-up train step** (`ModelConfig.large()`, flash only) — the
+   MXU-filling single-tenant shape; headline MFU.
+4. **Serving decode** (`workload.serving`): whole greedy requests
+   (prefill + scan-compiled KV-cache decode) on the flagship — the
+   HBM-slice co-tenant workload; decode tokens/s.
 
 Output: ONE JSON line (the `bench.py` contract), plus human-readable
 progress on stderr. `--gate` exits nonzero unless:
@@ -295,6 +300,46 @@ def bench_train(kind: str, allow_cpu: bool, *, cfg=None, batch: int = 16,
     return results
 
 
+def bench_decode(allow_cpu: bool) -> dict:
+    """Serving throughput: greedy KV-cache decode on the flagship (the
+    co-tenant-sized shape — decode servers are WHY chips get shared).
+    Times a compiled scan of decode steps, one scalar readback total."""
+    from tpushare.workload import model as M
+    from tpushare.workload import serving as S
+
+    cfg = dataclasses.replace(M.ModelConfig(), remat=False)
+    batch, prompt_len, steps, max_len = 8, 128, 64, 256
+    if allow_cpu:
+        cfg = M.ModelConfig().tiny()
+        batch, prompt_len, steps, max_len = 2, 8, 4, 16
+
+    key = jax.random.PRNGKey(0)
+    params = M.init_params(key, cfg)
+    tokens = jax.random.randint(key, (batch, prompt_len), 0,
+                                cfg.vocab_size)
+
+    @jax.jit
+    def run(params, tokens):
+        out = S.generate(params, tokens, cfg, n_new=steps,
+                         max_len=max_len)
+        return jnp.sum(out[:, -1]).astype(jnp.float32)
+
+    float(run(params, tokens))  # compile
+    # A full request is only ~3 ms — tiny against the ~100 ms tunnel
+    # RTT — so amortize over many queued requests or RTT jitter IS the
+    # measurement (5 iters swings the figure 2x between runs).
+    t = _time_scalar_fn(run, params, tokens, iters=40, reps=3)
+    # Subtract nothing for prefill: it is part of serving a request.
+    tokens_s = batch * steps / t
+    per_token_ms = (t / steps) * 1e3
+    return {
+        "batch": batch, "prompt_len": prompt_len, "new_tokens": steps,
+        "request_ms": round(t * 1e3, 2),
+        "decode_tokens_per_s": round(tokens_s),
+        "per_token_ms": round(per_token_ms, 3),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--gate", action="store_true",
@@ -323,6 +368,10 @@ def main() -> None:
                         cfg=dataclasses.replace(M.ModelConfig().large(),
                                                 remat=False),
                         batch=8, iters=8, sides=("flash",))
+
+    print("serving decode:", file=sys.stderr)
+    serving = bench_decode(args.allow_cpu)
+    print(f"  {serving}", file=sys.stderr)
 
     flash_mfu = train["flash"]["mfu"]
     large_mfu = large["flash"]["mfu"]
@@ -353,6 +402,7 @@ def main() -> None:
         "attention_fwd_bwd": attn,
         "train_step": train,
         "train_step_large": large,
+        "serving_decode": serving,
         "gates": gates,
     }
     print(json.dumps(doc))

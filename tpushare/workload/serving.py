@@ -1,0 +1,147 @@
+"""Inference serving: KV-cache prefill + single-token decode.
+
+The training side proves the chip can be SHARED; this is the workload
+that actually wants the slices: low-HBM inference co-tenants are the
+reference's headline use case (its demo packs three inference pods onto
+one GPU, reference ``samples/1-3.yaml`` + ``docs/userguide.md:56-77``).
+A decode step touches every weight once per generated token — it is
+HBM-bandwidth-bound, not MXU-bound — so several decode servers sharing
+one chip's HBM (each under a `tpushare.io/tpu-hbm` grant, spread by the
+`tpushare.io/scoring: spread` policy) is the economically-correct
+packing, and this module is the runtime they execute.
+
+TPU-first mechanics: the cache is a static-shape buffer of ``max_len``
+slots per layer (XLA requires static shapes under jit — growth happens
+by ``lax.dynamic_update_slice`` into a preallocated buffer, never by
+concatenation); the decode mask is a positional comparison against the
+static slot index, so one compiled step serves every position; prefill
+reuses the training forward's blocks (rotary, RMSNorm, fused qkv) while
+capturing each layer's K/V on the way through.
+
+Everything is exact: ``decode_step`` at position L reproduces the full
+forward's logits for the same prefix (tests assert it), because both
+paths run the same parameter math — the cache only changes WHEN the
+K/V were computed, not what they are.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tpushare.workload import model as M
+
+
+def init_cache(cfg: M.ModelConfig, batch: int, max_len: int) -> list[dict]:
+    """Preallocated per-layer KV slots, [B, max_len, H, D] each."""
+    shape = (batch, max_len, cfg.n_heads, cfg.head_dim)
+    zeros = jnp.zeros(shape, dtype=cfg.dtype)
+    return [{"k": zeros, "v": zeros} for _ in range(cfg.n_layers)]
+
+
+def cache_hbm_bytes(cfg: M.ModelConfig, batch: int, max_len: int) -> int:
+    """Sizing helper for the HBM grant: what the cache itself costs.
+    2 (K and V) x layers x B x L x H x D x itemsize."""
+    per = batch * max_len * cfg.n_heads * cfg.head_dim
+    return 2 * cfg.n_layers * per * jnp.dtype(cfg.dtype).itemsize
+
+
+def _qkv(block: dict, x: jax.Array, positions: jax.Array):
+    """The training block's qkv math (model.attention_delta), split out
+    so prefill/decode capture K/V between rotary and attention."""
+    h = M.rms_norm(x, block["attn_norm"])
+    qkv = jnp.einsum("bld,dthc->btlhc", h, block["wqkv"])
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    return M.rotary(q, positions), M.rotary(k, positions), v
+
+
+def prefill(params: dict, tokens: jax.Array, cache: list[dict],
+            attn_fn=None):
+    """Run the prompt through the model, filling ``cache[: L]``.
+
+    Returns ``(logits, cache)`` — logits [B, vocab] for the LAST prompt
+    position (the distribution the first generated token samples from).
+    """
+    if attn_fn is None:
+        attn_fn = M.causal_attention
+    B, L = tokens.shape
+    if L > cache[0]["k"].shape[1]:
+        raise ValueError(
+            f"prompt length {L} exceeds cache max_len "
+            f"{cache[0]['k'].shape[1]}")
+    positions = jnp.broadcast_to(jnp.arange(L), (B, L))
+    x = params["embed"][tokens]
+    new_cache = []
+    for block, slots in zip(params["blocks"], cache):
+        q, k, v = _qkv(block, x, positions)
+        new_cache.append({
+            "k": jax.lax.dynamic_update_slice(slots["k"], k, (0, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(slots["v"], v, (0, 0, 0, 0)),
+        })
+        out = attn_fn(q, k, v)
+        x = x + jnp.einsum("blhc,hcd->bld", out, block["wo"])
+        x = M.ffn_block(block, x)
+    x = M.rms_norm(x[:, -1], params["final_norm"])  # last position only
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    return logits, new_cache
+
+
+def decode_step(params: dict, cache: list[dict], token: jax.Array,
+                pos: jax.Array):
+    """One generated token: attend ``token`` (to be placed at ``pos``)
+    against the cached prefix, append its K/V, return the next-token
+    logits. Static shapes throughout — ``pos`` is a traced scalar, so
+    ONE compilation serves the whole generation loop.
+    """
+    B = token.shape[0]
+    positions = jnp.broadcast_to(pos, (B, 1))
+    x = params["embed"][token][:, None, :]  # [B, 1, d]
+    new_cache = []
+    for block, slots in zip(params["blocks"], cache):
+        q, k, v = _qkv(block, x, positions)
+        ck = jax.lax.dynamic_update_slice(slots["k"], k, (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(slots["v"], v, (0, pos, 0, 0))
+        new_cache.append({"k": ck, "v": cv})
+        # The training attention's offset form IS the decode mask:
+        # q_offset=pos vs slots 0..max_len gives pos >= slot — exactly
+        # "occupied slots only (incl. this token)". One definition of
+        # the attention math serves train and serve.
+        out = M.causal_attention(q, ck, cv, q_offset=pos)
+        x = x + jnp.einsum("blhc,hcd->bld", out, block["wo"])
+        x = M.ffn_block(block, x)
+    x = M.rms_norm(x[:, 0], params["final_norm"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    return logits, new_cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_new", "max_len"))
+def generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
+             n_new: int, max_len: int) -> jax.Array:
+    """Greedy generation: prompt [B, L] → [B, L + n_new] token ids.
+
+    Prefill once, then ``lax.scan`` over ``decode_step`` — the loop is
+    compiled control flow (no per-token retrace, no host round-trips),
+    which is what makes batch decode on a shared chip cheap.
+    """
+    B, L = tokens.shape
+    if L + n_new > max_len:
+        # dynamic_update_slice CLAMPS out-of-range indices — an
+        # overflowing write would silently corrupt slot max_len-1
+        # instead of failing. Shapes are static, so this is a
+        # trace-time check, free at runtime.
+        raise ValueError(
+            f"L + n_new = {L + n_new} exceeds cache max_len {max_len}")
+    cache = init_cache(cfg, B, max_len)
+    logits, cache = prefill(params, tokens, cache)
+
+    def step(carry, _):
+        cache, logits, pos = carry
+        token = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+        logits, cache = decode_step(params, cache, token, pos)
+        return (cache, logits, pos + 1), token
+
+    (_, _, _), out = jax.lax.scan(
+        step, (cache, logits, jnp.asarray(L)), length=n_new)
+    return jnp.concatenate([tokens, out.T], axis=1)
