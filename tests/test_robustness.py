@@ -181,3 +181,58 @@ class TestGangAtSliceScale:
                 assert len(chips) == 4
         finally:
             cluster.close()
+
+
+class TestWireFuzz:
+    """Adversarial wire input: whatever arrives on the webhook sockets,
+    the server must answer with a structured status and keep serving.
+    kube-scheduler retries on 5xx — a crash or a hung thread is the
+    only unacceptable outcome (the reference's checkBody wrote a 400
+    then kept processing the dead request, routes.go:32-37)."""
+
+    PATHS = ("/tpushare-scheduler/filter", "/tpushare-scheduler/bind",
+             "/tpushare-scheduler/prioritize",
+             "/tpushare-scheduler/preempt", "/tpushare-scheduler/validate")
+
+    def _post_raw(self, base, path, body: bytes):
+        req = urllib.request.Request(
+            f"{base}{path}", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status
+        except urllib.error.HTTPError as e:
+            e.read()
+            return e.code
+
+    def test_garbage_never_kills_the_server(self, api):
+        import random
+        rng = random.Random(0xFACE)
+        api.create_node(make_node("n0", chips=4, hbm_per_chip=16))
+        cluster = Cluster(api)
+        payloads = [
+            b"",                                   # empty body
+            b"{",                                  # truncated JSON
+            b"null", b"[]", b'"pod"', b"42",       # wrong top-level type
+            b'{"Pod": 5, "NodeNames": "x"}',       # wrong field types
+            b'{"Pod": {}, "NodeNames": [5, null]}',
+            b'{"NodeNameToMetaVictims": {"n0": 7}}',
+            b'{"request": []}',                    # admission wrong shape
+            json.dumps({"Pod": {"metadata": {"name": "x" * 4096}},
+                        "NodeNames": ["n0"] * 500}).encode(),
+            bytes(rng.randrange(256) for _ in range(512)),  # raw noise
+        ]
+        try:
+            for path in self.PATHS:
+                for body in payloads:
+                    status = self._post_raw(cluster.base, path, body)
+                    assert status in (200, 400, 404, 500), (path, body[:40])
+            # After the onslaught: still alive, still correct.
+            with urllib.request.urlopen(f"{cluster.base}/healthz") as r:
+                assert r.read().startswith(b"ok")
+            api.create_pod(make_pod("sane", hbm=8, uid="u-sane"))
+            bound, node = cluster.schedule(
+                api.get_pod("default", "sane").raw)
+            assert bound and node == "n0"
+        finally:
+            cluster.close()

@@ -26,6 +26,7 @@ from tests.conftest import make_node, make_pod
 from tpushare.api.extender import ExtenderArgs, ExtenderBindingArgs
 from tpushare.cache.cache import SchedulerCache
 from tpushare.cmd.main import build_stack
+from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
 
 
@@ -108,7 +109,13 @@ def test_randomized_churn_soak(api):
                 doc = make_pod(f"p{seq}", chips=rng.choice([1, 2, 4]))
             seq += 1
             pod = api.create_pod(doc)
-            names = [n.name for n in api.list_nodes()]
+            # kube-scheduler's upstream pass: cordoned nodes are never
+            # offered to the extender.
+            names = [n.name for n in api.list_nodes()
+                     if nodeutils.is_schedulable(n, pod)]
+            if not names:
+                api.delete_pod(pod.namespace, pod.name)
+                return
             rng.shuffle(names)
             res = pred.handle(ExtenderArgs.from_json(
                 {"Pod": pod.raw, "NodeNames": names}))
@@ -146,6 +153,16 @@ def test_randomized_churn_soak(api):
                 "NodeNameToMetaVictims": {
                     n.name: {"Pods": []} for n in api.list_nodes()},
             }))
+        elif op < 0.97:
+            # -- cordon churn: toggle spec.unschedulable -------------- #
+            # Exercises the node-document refresh path (resourceVersion
+            # bump -> info.node swap) under load; resident pods keep
+            # their grants — a cordon only stops NEW placements, so the
+            # ledger invariants must hold across the toggle.
+            node = rng.choice(api.list_nodes())
+            spec = node.raw.setdefault("spec", {})
+            spec["unschedulable"] = not spec.get("unschedulable", False)
+            api.update_node(node)
         else:
             # -- node flap: delete + re-register ---------------------- #
             node = rng.choice(api.list_nodes())

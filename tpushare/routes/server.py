@@ -125,17 +125,28 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(text)
 
     def _read_json(self) -> dict | None:
-        """Parse the request body; None (after a 400) when malformed."""
+        """Parse the request body; None (after a 400) when malformed.
+
+        Every webhook payload is a JSON OBJECT, so a non-dict top level
+        (including the literal ``null``, which json.loads parses to
+        None without raising — returning it bare would skip the 400 and
+        silently drop the connection) is a 400, not a handler crash."""
         try:
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length) if length else b""
             if not raw:
                 self._send_json({"Error": "empty request body"}, 400)
                 return None
-            return json.loads(raw)
+            doc = json.loads(raw)
         except (ValueError, json.JSONDecodeError) as e:
             self._send_json({"Error": f"malformed request body: {e}"}, 400)
             return None
+        if not isinstance(doc, dict):
+            self._send_json(
+                {"Error": "request body must be a JSON object, got "
+                          f"{type(doc).__name__}"}, 400)
+            return None
+        return doc
 
     def _serve_sampler(self, sampler, *, default_seconds: str,
                        default_hz: str,

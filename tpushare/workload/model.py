@@ -143,6 +143,23 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def qkv_proj(block: dict, x: jax.Array, positions: jax.Array):
+    """Normed fused-qkv projection + rotary on q/k — ONE definition of
+    the pre-attention math, shared by the training block and the
+    serving path's KV-cache capture (workload/serving.py): an edit here
+    (rotary base, norm eps, layout) propagates to both or the serving
+    exactness tests fail, never a silent divergence."""
+    h = rms_norm(x, block["attn_norm"])
+    qkv = jnp.einsum("bld,dthc->btlhc", h, block["wqkv"])
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+    return rotary(q, positions), rotary(k, positions), v
+
+
+def out_proj(block: dict, out: jax.Array) -> jax.Array:
+    """Attention-output projection (the other half shared with serving)."""
+    return jnp.einsum("blhc,hcd->bld", out, block["wo"])
+
+
 def attention_delta(block: dict, x: jax.Array, positions: jax.Array,
                     attn_fn) -> jax.Array:
     """The attention sublayer's PRE-RESIDUAL contribution. Split from
@@ -150,13 +167,9 @@ def attention_delta(block: dict, x: jax.Array, positions: jax.Array,
     head-sharded weights over the tp axis before adding — one
     definition of the math serves both the single-device block and the
     Megatron-style sharded stage."""
-    h = rms_norm(x, block["attn_norm"])
-    qkv = jnp.einsum("bld,dthc->btlhc", h, block["wqkv"])
-    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-    q = rotary(q, positions)
-    k = rotary(k, positions)
+    q, k, v = qkv_proj(block, x, positions)
     out = attn_fn(q, k, v)
-    return jnp.einsum("blhc,hcd->bld", out, block["wo"])
+    return out_proj(block, out)
 
 
 def attention_block(block: dict, x: jax.Array, positions: jax.Array,

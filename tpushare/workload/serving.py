@@ -48,15 +48,6 @@ def cache_hbm_bytes(cfg: M.ModelConfig, batch: int, max_len: int) -> int:
     return 2 * cfg.n_layers * per * jnp.dtype(cfg.dtype).itemsize
 
 
-def _qkv(block: dict, x: jax.Array, positions: jax.Array):
-    """The training block's qkv math (model.attention_delta), split out
-    so prefill/decode capture K/V between rotary and attention."""
-    h = M.rms_norm(x, block["attn_norm"])
-    qkv = jnp.einsum("bld,dthc->btlhc", h, block["wqkv"])
-    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-    return M.rotary(q, positions), M.rotary(k, positions), v
-
-
 def prefill(params: dict, tokens: jax.Array, cache: list[dict],
             attn_fn=None):
     """Run the prompt through the model, filling ``cache[: L]``.
@@ -75,13 +66,13 @@ def prefill(params: dict, tokens: jax.Array, cache: list[dict],
     x = params["embed"][tokens]
     new_cache = []
     for block, slots in zip(params["blocks"], cache):
-        q, k, v = _qkv(block, x, positions)
+        q, k, v = M.qkv_proj(block, x, positions)
         new_cache.append({
             "k": jax.lax.dynamic_update_slice(slots["k"], k, (0, 0, 0, 0)),
             "v": jax.lax.dynamic_update_slice(slots["v"], v, (0, 0, 0, 0)),
         })
         out = attn_fn(q, k, v)
-        x = x + jnp.einsum("blhc,hcd->bld", out, block["wo"])
+        x = x + M.out_proj(block, out)
         x = M.ffn_block(block, x)
     x = M.rms_norm(x[:, -1], params["final_norm"])  # last position only
     logits = (x @ params["embed"].T).astype(jnp.float32)
@@ -100,7 +91,7 @@ def decode_step(params: dict, cache: list[dict], token: jax.Array,
     x = params["embed"][token][:, None, :]  # [B, 1, d]
     new_cache = []
     for block, slots in zip(params["blocks"], cache):
-        q, k, v = _qkv(block, x, positions)
+        q, k, v = M.qkv_proj(block, x, positions)
         ck = jax.lax.dynamic_update_slice(slots["k"], k, (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(slots["v"], v, (0, pos, 0, 0))
         new_cache.append({"k": ck, "v": cv})
@@ -109,7 +100,7 @@ def decode_step(params: dict, cache: list[dict], token: jax.Array,
         # "occupied slots only (incl. this token)". One definition of
         # the attention math serves train and serve.
         out = M.causal_attention(q, ck, cv, q_offset=pos)
-        x = x + jnp.einsum("blhc,hcd->bld", out, block["wo"])
+        x = x + M.out_proj(block, out)
         x = M.ffn_block(block, x)
     x = M.rms_norm(x[:, 0], params["final_norm"])
     logits = (x @ params["embed"].T).astype(jnp.float32)
