@@ -88,3 +88,39 @@ def test_decode_one_compilation_serves_all_positions(setup):
     for pos in (7, 8, 9):
         _, cache = step(params, cache, tok, jnp.asarray(pos))
     assert traces == 1
+
+
+def test_tensor_parallel_generate_matches_single_device(setup):
+    """Serving scales the same way training does: shard the params over
+    a dp×tp mesh (GSPMD inserts the collectives — head-sharded qkv,
+    psum'd out/ffn projections) and generation must produce EXACTLY the
+    tokens the single-device path does."""
+    import numpy as np
+
+    from tpushare.workload import parallel as par
+
+    cfg, params, tokens = setup
+    if jax.device_count() < 4:
+        pytest.skip("needs the virtual multi-device mesh")
+    expect_logits, _ = S.prefill(params, tokens,
+                                 S.init_cache(cfg, 2, 16))
+
+    mesh = par.make_mesh(dp=2, tp=2, sp=1)
+    sharded = jax.device_put(params, par.param_shardings(mesh, params))
+    placed = jax.device_put(
+        tokens, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("dp", None)))
+    with mesh:
+        # Logits allclose, not token-exact: GSPMD's psum reduction
+        # order differs from the single-device contraction, so fp32
+        # logits can differ by ulps and a near-tie argmax could flip —
+        # numeric closeness is the real contract.
+        got_logits, _ = jax.jit(S.prefill)(sharded, placed,
+                                           S.init_cache(cfg, 2, 16))
+        assert jnp.allclose(np.asarray(got_logits),
+                            np.asarray(expect_logits), atol=1e-4)
+        got = S.generate(sharded, placed, cfg, n_new=4, max_len=16)
+    out = np.asarray(got)
+    assert out.shape == (2, 11)
+    assert (out[:, :7] == np.asarray(tokens)).all()
+    assert ((out >= 0) & (out < cfg.vocab_size)).all()
