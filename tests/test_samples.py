@@ -214,3 +214,57 @@ def test_full_loop_extender_to_device_plugin(api, tmp_path):
             s.stop()
         kubelet.stop()
         cluster.close()
+
+
+def test_sample_mixed_scoring_policies(api):
+    """samples/7.yaml: the spread-annotated inference pod ranks the
+    pristine node above the partially-used one while the unannotated
+    batch pod (fleet binpack default) ranks them the other way — two
+    intents, one fleet."""
+    from tpushare.api.extender import ExtenderArgs, ExtenderBindingArgs
+    from tpushare.api.objects import Pod
+    from tpushare.cmd.main import build_stack
+
+    with open(os.path.join(REPO, "samples", "7.yaml")) as f:
+        deps = {d["metadata"]["name"]: d
+                for d in yaml.safe_load_all(f) if d}
+    assert set(deps) == {"spread-inference", "binpack-batch"}
+    assert (deps["spread-inference"]["spec"]["template"]["metadata"]
+            ["annotations"][const.ANN_SCORING] == "spread")
+
+    api.create_node(make_node("partial", chips=4, hbm_per_chip=16))
+    api.create_node(make_node("pristine", chips=4, hbm_per_chip=16))
+    stack = build_stack(api)
+    seed = api.create_pod({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "seed", "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {
+            "limits": {const.HBM_RESOURCE: "8"}}}]},
+        "status": {"phase": "Pending"},
+    })
+    stack.binder.handle(ExtenderBindingArgs(
+        pod_name="seed", pod_namespace="default", pod_uid=seed.uid,
+        node="partial"))
+
+    def pod_from(dep_name: str, pod_name: str) -> Pod:
+        template = deps[dep_name]["spec"]["template"]
+        return Pod({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": pod_name, "namespace": "default",
+                                 "annotations": dict(
+                                     template["metadata"].get(
+                                         "annotations") or {})},
+                    "spec": template["spec"],
+                    "status": {"phase": "Pending"}})
+
+    def scores(pod):
+        out = stack.prioritize.handle(ExtenderArgs(
+            pod=pod, node_names=["partial", "pristine"]))
+        return {e.host: e.score for e in out}
+
+    try:
+        s_infer = scores(pod_from("spread-inference", "inf-0"))
+        s_batch = scores(pod_from("binpack-batch", "batch-0"))
+        assert s_infer["pristine"] > s_infer["partial"]
+        assert s_batch["partial"] > s_batch["pristine"]
+    finally:
+        stack.binder.gang_planner.stop()

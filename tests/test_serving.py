@@ -93,8 +93,9 @@ def test_decode_one_compilation_serves_all_positions(setup):
 def test_tensor_parallel_generate_matches_single_device(setup):
     """Serving scales the same way training does: shard the params over
     a dp×tp mesh (GSPMD inserts the collectives — head-sharded qkv,
-    psum'd out/ffn projections) and generation must produce EXACTLY the
-    tokens the single-device path does."""
+    psum'd out/ffn projections); sharded logits must match single-device
+    numerically (allclose — NOT token-exact: reduction order can flip an
+    argmax near-tie) and generation must run end to end."""
     import numpy as np
 
     from tpushare.workload import parallel as par

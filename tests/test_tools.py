@@ -319,3 +319,23 @@ class TestSimulator:
         doc = json.loads(capsys.readouterr().out)
         assert doc["bound"] == 1
         assert doc["nodes"][0]["pods"] == 1
+
+
+class TestCLIDemandSection:
+    def test_demand_shown_when_unplaceable(self, api, cluster, capsys):
+        import kubectl_inspect_tpushare as cli
+
+        # Make demand: a pod too big for the 2x16-GiB fleet, driven
+        # through the real filter so the tracker records it.
+        api.create_pod(make_pod("big", hbm=99, uid="u-big"))
+        bound, _ = cluster.schedule(make_pod("big", hbm=99, uid="u-big"))
+        assert not bound
+        assert cli.main(["--endpoint", cluster.base]) == 0
+        out = capsys.readouterr().out
+        assert "UNPLACEABLE DEMAND: 1 pod(s)" in out
+        assert "99 GiB HBM" in out
+
+    def test_no_demand_no_section(self, api, cluster, capsys):
+        import kubectl_inspect_tpushare as cli
+        assert cli.main(["--endpoint", cluster.base]) == 0
+        assert "UNPLACEABLE" not in capsys.readouterr().out

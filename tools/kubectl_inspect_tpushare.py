@@ -213,7 +213,40 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 1
     print(render(doc, details=args.details))
+    demand = fetch_demand(args.endpoint)
+    if demand:
+        print(demand)
     return 0
+
+
+def fetch_demand(endpoint: str) -> str:
+    """Unplaceable-demand summary from the extender's /metrics — the
+    operator-facing face of the autoscaler signal. Empty string when
+    there is no pending demand (or metrics are unreachable: the main
+    table already rendered, a metrics hiccup must not fail the CLI)."""
+    vals = {}
+    try:
+        with urllib.request.urlopen(f"{endpoint}/metrics",
+                                    timeout=5) as resp:
+            text = resp.read().decode()
+        for line in text.splitlines():
+            for key in ("tpushare_unschedulable_pods",
+                        "tpushare_unschedulable_demand_hbm_gib",
+                        "tpushare_unschedulable_demand_chips"):
+                if line.startswith(key + " "):
+                    vals[key] = float(line.split()[1])
+    except Exception:  # noqa: BLE001 - any hiccup (IncompleteRead,
+        return ""      # malformed line) must not fail the rendered CLI
+    pods = vals.get("tpushare_unschedulable_pods", 0)
+    if not pods:
+        return ""
+    return (f"\nUNPLACEABLE DEMAND: {int(pods)} pod(s) failing the "
+            f"filter on every node — "
+            f"{int(vals.get('tpushare_unschedulable_demand_hbm_gib', 0))} "
+            f"GiB HBM + "
+            f"{int(vals.get('tpushare_unschedulable_demand_chips', 0))} "
+            "chip(s) of missing capacity (add TPU nodes, or dry-run a "
+            "bigger fleet with tools/simulate.py)")
 
 
 if __name__ == "__main__":
