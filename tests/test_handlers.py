@@ -286,3 +286,33 @@ class TestDemandSignal:
                       "target": {"name": "v5e-node-0"}})
         api.delete_pod("default", "gone")
         assert pred.demand.snapshot() == (0, 0, 0)
+
+
+class TestNamespaceUsage:
+    def test_chargeback_counts_each_pod_once(self, api, v5e_node):
+        """A multi-chip pod repeats its full grant on every chip it
+        holds — the namespace rollup must not double-charge it."""
+        _, _, _, binder, inspect = build_stack(api)
+        api.create_pod(make_pod("slice", hbm=8, uid="u1"))
+        binder.handle(ExtenderBindingArgs(
+            pod_name="slice", pod_namespace="default", pod_uid="u1",
+            node="v5e-node-0"))
+        api.create_pod(make_pod("whole", chips=2, uid="u2",
+                                namespace="team-a"))
+        binder.handle(ExtenderBindingArgs(
+            pod_name="whole", pod_namespace="team-a", pod_uid="u2",
+            node="v5e-node-0"))
+        for ns, name in (("default", "slice"), ("team-a", "whole")):
+            api.update_pod_status(ns, name, "Running")
+        doc = inspect.handle()
+        by_ns = {n["namespace"]: n for n in doc["namespaces"]}
+        # 2 chips x 16 GiB charged ONCE, sorted heaviest first.
+        assert by_ns["team-a"] == {"namespace": "team-a",
+                                   "usedHBM": 32, "pods": 1}
+        assert by_ns["default"] == {"namespace": "default",
+                                    "usedHBM": 8, "pods": 1}
+        assert doc["namespaces"][0]["namespace"] == "team-a"
+
+    def test_empty_fleet_has_no_namespace_section(self, api, v5e_node):
+        _, _, _, _, inspect = build_stack(api)
+        assert "namespaces" not in inspect.handle()

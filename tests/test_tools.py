@@ -339,3 +339,54 @@ class TestCLIDemandSection:
         import kubectl_inspect_tpushare as cli
         assert cli.main(["--endpoint", cluster.base]) == 0
         assert "UNPLACEABLE" not in capsys.readouterr().out
+
+
+class TestDefragAdvisor:
+    def test_repack_reclaims_whole_chips(self, api):
+        """Churn leaves 8-GiB holes across chips; the advisor shows the
+        re-pack consolidating them into whole free chips and names the
+        pods that would move."""
+        import simulate
+
+        api.create_node(make_node("n0", chips=2, hbm_per_chip=16))
+        api.create_node(make_node("n1", chips=2, hbm_per_chip=16))
+        c = Cluster(api)
+        try:
+            # Fill all four chips with 2x8 GiB each...
+            for i in range(8):
+                doc = make_pod(f"p{i}", hbm=8, uid=f"u{i}")
+                api.create_pod(doc)
+                bound, where = c.schedule(doc)
+                assert bound, where
+            # ...then one slice per chip completes: four half-full
+            # chips, zero whole chips free, yet only 32 GiB is used.
+            for i in (0, 2, 4, 6):
+                api.update_pod_status("default", f"p{i}", "Succeeded")
+            assert c.controller.wait_idle(timeout=5)
+            doc = c.inspect()
+            assert all(ch["usedHBM"] == 8 for n in doc["nodes"]
+                       for ch in n["chips"])
+            report = simulate.defrag(doc)
+        finally:
+            c.close()
+        assert report["pods"] == 4
+        assert report["current_free_whole_chips"] == 0
+        assert report["repacked_free_whole_chips"] == 2
+        assert report["gain_whole_chips"] == 2
+        assert len(report["moves"]) >= 2  # consolidation requires moves
+        assert report["unplaced"] == []
+
+    def test_optimal_packing_reports_no_moves(self, api):
+        import simulate
+
+        api.create_node(make_node("n0", chips=2, hbm_per_chip=16))
+        c = Cluster(api)
+        try:
+            doc = make_pod("p0", hbm=16, uid="u0")
+            api.create_pod(doc)
+            assert c.schedule(doc)[0]
+            report = simulate.defrag(c.inspect())
+        finally:
+            c.close()
+        assert report["gain_whole_chips"] == 0
+        assert report["moves"] == []

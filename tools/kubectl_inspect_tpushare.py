@@ -75,6 +75,15 @@ def render(doc: dict, details: bool = False) -> str:
     lines.append("Allocated/Total TPU HBM (GiB) in Cluster:")
     lines.append(f"{used}/{total} ({pct:.0f}%)")
 
+    namespaces = doc.get("namespaces", [])
+    if namespaces:
+        lines.append("")
+        lines.append("BY NAMESPACE (chargeback):")
+        for ns in namespaces:
+            share = (100.0 * ns["usedHBM"] / used) if used else 0.0
+            lines.append(f"  {ns['namespace']}: {ns['usedHBM']} GiB "
+                         f"({share:.0f}%) across {ns['pods']} pod(s)")
+
     gangs = doc.get("gangs", [])
     if gangs:
         lines.append("")

@@ -100,6 +100,7 @@ def _expand_fleet(scenario: dict) -> list[dict]:
                 f"{prefix}-{i:02d}" if count > 1 else prefix,
                 chips=int(group.get("chips", 4)),
                 hbm_per_chip=int(group.get("hbm_per_chip", 16)),
+                chip_hbm=group.get("chip_hbm"),
                 topology=group.get("topology", "2x2x1"),
                 tpu_type=group.get("tpu_type", "v5e"),
                 slice_id=group.get("slice_id", ""),
@@ -358,6 +359,137 @@ def _print_human(report: dict) -> None:
         print(f"\ngang {g.get('name')}: {g}")
 
 
+def defrag(inspect_doc: dict) -> dict:
+    """Defragmentation advisor: what would re-packing the CURRENT fleet
+    buy, and which pods would have to move?
+
+    Live bin-packing is online — arrival order and churn fragment chips
+    no matter how good the per-decision policy is. This takes the
+    extender's inspect dump, re-schedules every resident pod from
+    scratch (best-fit-decreasing through the REAL filter → prioritize →
+    bind stack), and reports the achievable packing next to the current
+    one: free whole chips reclaimed (the scarce resource multi-chip
+    jobs starve for) and the move list. ADVISORY ONLY — nothing is
+    evicted; the operator decides whether the gain is worth the moves
+    (a kubectl delete on the listed pods re-packs them organically).
+    """
+    from tpushare.k8s.builders import make_pod
+    from tpushare.utils import const
+
+    current_nodes = inspect_doc.get("nodes", [])
+    if not current_nodes:
+        return {"error": "no nodes in inspect dump"}
+
+    residents: dict[tuple, dict] = {}
+    cur_free_chips = 0
+    for node in current_nodes:
+        for chip in node["chips"]:
+            if chip["usedHBM"] == 0 and not node.get("unschedulable"):
+                cur_free_chips += 1
+            for pod in chip["pods"]:
+                key = (pod["namespace"], pod["name"])
+                residents.setdefault(key, {
+                    "node": node["name"], "usedHBM": pod["usedHBM"],
+                    "chips": len(pod["chipIds"]),
+                    "chip_ids": tuple(sorted(pod["chipIds"])),
+                    # The dump carries the REAL request type and scoring
+                    # intent (inspect writes them), so no slice-vs-chip
+                    # heuristic is needed; dumps predating those fields
+                    # fall back to the capacity-equivalence guess.
+                    "whole": pod.get(
+                        "wholeChip",
+                        pod["usedHBM"] >= sum(
+                            c["totalHBM"] for c in node["chips"]
+                            if c["id"] in pod["chipIds"])),
+                    "scoring": pod.get("scoring", ""),
+                })
+
+    scenario_fleet = [{
+        "count": 1, "prefix": n["name"],
+        "chips": len(n["chips"]),
+        "chip_hbm": [c["totalHBM"] for c in n["chips"]],
+        "tpu_type": n.get("tpuType", "v5e"),
+        "topology": n.get("topology", "2x2x1"),
+        "slice_id": n.get("sliceId", ""),
+        "unschedulable": bool(n.get("unschedulable")),
+    } for n in current_nodes]
+
+    api = _fresh_api(scenario_fleet)
+    from tpushare.cmd.main import serve_stack, shutdown_stack
+    stack, server = serve_stack(api)
+    client = _Client(*server.server_address[:2])
+    failed = []
+    try:
+        order = sorted(residents.items(),
+                       key=lambda kv: -kv[1]["usedHBM"])
+        for (ns, name), rec in order:
+            ann = ({const.ANN_SCORING: rec["scoring"]}
+                   if rec["scoring"] else None)
+            if rec["whole"]:
+                doc = make_pod(name, chips=rec["chips"], namespace=ns,
+                               annotations=ann)
+            else:
+                doc = make_pod(name, hbm=rec["usedHBM"], namespace=ns,
+                               annotations=ann)
+            pod = api.create_pod(doc)
+            verdict = _schedule_one(
+                client, pod, [n["name"] for n in current_nodes
+                              if not n.get("unschedulable")])
+            if verdict["state"] != "bound":
+                failed.append(f"{ns}/{name}")
+        repack = client.get("/tpushare-scheduler/inspect")
+    finally:
+        client.close()
+        shutdown_stack(stack, server)
+
+    # Moves are CHIP-granular: consolidating two slices onto one chip of
+    # the same node still means deleting a pod, so an intra-node shuffle
+    # is a move too (a node-only diff would report gains with an empty
+    # move list).
+    new_map: dict[tuple, tuple] = {}
+    for n in repack["nodes"]:
+        for c in n["chips"]:
+            for pod in c["pods"]:
+                key = (pod["namespace"], pod["name"])
+                new_map[key] = (n["name"],
+                                tuple(sorted(pod["chipIds"])))
+    moves = []
+    for key, rec in residents.items():
+        after = new_map.get(key)
+        if after is None:
+            continue  # reported in unplaced
+        if after != (rec["node"], rec["chip_ids"]):
+            moves.append({"pod": f"{key[0]}/{key[1]}",
+                          "from": f"{rec['node']}"
+                                  f"[{','.join(map(str, rec['chip_ids']))}]",
+                          "to": f"{after[0]}"
+                                f"[{','.join(map(str, after[1]))}]"})
+
+    new_free = sum(1 for n in repack["nodes"]
+                   for c in n["chips"]
+                   if c["usedHBM"] == 0 and not n.get("unschedulable"))
+    return {
+        "current_free_whole_chips": cur_free_chips,
+        "repacked_free_whole_chips": new_free,
+        "gain_whole_chips": new_free - cur_free_chips,
+        "moves": moves,
+        "pods": len(residents),
+        # Non-empty means the advisory is unsound for those pods (e.g.
+        # a heterogeneous detail the dump can't express) — say so
+        # rather than under-report the fleet.
+        "unplaced": failed,
+    }
+
+
+def _fresh_api(fleet: list[dict]):
+    from tpushare.k8s.fake import FakeApiServer
+
+    api = FakeApiServer()
+    for doc in _expand_fleet({"fleet": fleet}):
+        api.create_node(doc)
+    return api
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(
         description="Replay a fleet/workload scenario through the real "
@@ -367,21 +499,65 @@ def main() -> None:
                     help="machine-readable report on stdout")
     ap.add_argument("--example", action="store_true",
                     help="print a starter scenario and exit")
+    ap.add_argument("--defrag", metavar="SRC",
+                    help="defrag advisory instead of a replay: SRC is an "
+                         "extender base URL (its live inspect is fetched) "
+                         "or a saved inspect-JSON file; reports what a "
+                         "from-scratch re-pack would reclaim and which "
+                         "pods would move (advisory only)")
     args = ap.parse_args()
     if args.example:
         print(EXAMPLE, end="")
         return
-    if not args.scenario:
-        ap.error("scenario file required (or --example)")
+    if not args.scenario and not args.defrag:
+        ap.error("scenario file required (or --example / --defrag)")
     # Runnable from anywhere without pip-installing the package.
     import os
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    if args.defrag:
+        import urllib.request
+        if args.defrag.startswith(("http://", "https://")):
+            with urllib.request.urlopen(
+                    f"{args.defrag}/tpushare-scheduler/inspect",
+                    timeout=10) as resp:
+                inspect_doc = json.loads(resp.read())
+        else:
+            with open(args.defrag) as f:
+                inspect_doc = json.load(f)
+        report = defrag(inspect_doc)
+        if args.as_json:
+            print(json.dumps(report))
+        else:
+            _print_defrag(report)
+        return
     report = simulate(load_scenario(args.scenario))
     if args.as_json:
         print(json.dumps(report))
     else:
         _print_human(report)
+
+
+def _print_defrag(report: dict) -> None:
+    if report.get("error"):
+        print(f"error: {report['error']}", file=sys.stderr)
+        raise SystemExit(2)
+    gain = report["gain_whole_chips"]
+    print(f"defrag advisory over {report['pods']} resident pod(s):")
+    print(f"  free whole chips: {report['current_free_whole_chips']} now "
+          f"-> {report['repacked_free_whole_chips']} after re-pack "
+          f"({'+' if gain >= 0 else ''}{gain})")
+    if not report["moves"]:
+        print("  already optimally packed — no moves would help")
+    else:
+        print(f"  {len(report['moves'])} move(s) would achieve it "
+              "(delete these pods and let their owners re-create them):")
+        for m in report["moves"]:
+            print(f"    {m['pod']}: {m['from']} -> {m['to']}")
+    if report["unplaced"]:
+        print(f"  WARNING: {len(report['unplaced'])} pod(s) did not fit "
+              f"the re-pack model: {', '.join(report['unplaced'])} — "
+              "advisory is unsound for them")
 
 
 if __name__ == "__main__":

@@ -13,6 +13,7 @@ the free HBM is.
 from __future__ import annotations
 
 from tpushare.cache.cache import SchedulerCache
+from tpushare.utils import const
 from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
 
@@ -36,7 +37,7 @@ class Inspect:
             for p in chip.snapshot_pods():
                 if not podutils.is_assigned_non_terminated(p):
                     continue  # reference inspect.go:49 filter
-                pods.append({
+                entry = {
                     "name": p.name,
                     "namespace": p.namespace,
                     # uid lets operator tooling (the what-if preempt CLI)
@@ -44,7 +45,18 @@ class Inspect:
                     "uid": p.uid,
                     "usedHBM": podutils.pod_used_hbm(p),
                     "chipIds": podutils.get_chip_ids_from_annotation(p),
-                })
+                    # Request type + scoring intent travel with the dump
+                    # so offline tooling (the defrag advisor) re-models
+                    # the pod EXACTLY — no slice-vs-chip heuristics on
+                    # heterogeneous fleets, no silently dropped spread
+                    # policy.
+                    "wholeChip":
+                        podutils.get_chips_from_pod_resource(p) > 0,
+                }
+                scoring = p.annotations.get(const.ANN_SCORING)
+                if scoring:
+                    entry["scoring"] = scoring
+                pods.append(entry)
             used = chip.get_used_hbm()
             used_total += used
             chips.append({
@@ -95,10 +107,33 @@ class Inspect:
                     built = self.cache.get_node_info(node.name)
                     if built is not None:
                         infos[built.name] = built
-        doc = {"nodes": [self._build_node(i)
-                         for _, i in sorted(infos.items())]}
+        nodes = [self._build_node(i) for _, i in sorted(infos.items())]
+        doc = {"nodes": nodes}
+        namespaces = self._namespace_usage(nodes)
+        if namespaces:
+            doc["namespaces"] = namespaces
         if self._gang_planner is not None:
             gangs = self._gang_planner.snapshot()
             if gangs:
                 doc["gangs"] = gangs
         return doc
+
+    @staticmethod
+    def _namespace_usage(nodes: list[dict]) -> list[dict]:
+        """Per-namespace HBM totals — the chargeback view. A pod's
+        ``usedHBM`` is its FULL grant (a multi-chip pod repeats it on
+        every chip it holds), so each pod is counted exactly once."""
+        usage: dict[str, dict] = {}
+        for node in nodes:
+            for chip in node["chips"]:
+                for pod in chip["pods"]:
+                    ns = usage.setdefault(
+                        pod["namespace"], {"usedHBM": 0, "seen": set()})
+                    key = (pod["namespace"], pod["name"])
+                    if key not in ns["seen"]:
+                        ns["seen"].add(key)
+                        ns["usedHBM"] += pod["usedHBM"]
+        return [{"namespace": ns, "usedHBM": u["usedHBM"],
+                 "pods": len(u["seen"])}
+                for ns, u in sorted(usage.items(),
+                                    key=lambda kv: -kv[1]["usedHBM"])]
