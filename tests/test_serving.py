@@ -125,3 +125,17 @@ def test_tensor_parallel_generate_matches_single_device(setup):
     assert out.shape == (2, 11)
     assert (out[:, :7] == np.asarray(tokens)).all()
     assert ((out >= 0) & (out < cfg.vocab_size)).all()
+
+
+def test_generate_attn_fn_passthrough(setup):
+    """Long-prompt serving uses flash prefill via the attn_fn hook; the
+    result must be identical regardless of which attention implements
+    prefill (off-TPU flash falls back to the XLA path — this pins the
+    PLUMBING; chipcheck/bench pin the kernel itself on real silicon)."""
+    from tpushare.workload import flash_attention as FA
+
+    cfg, params, tokens = setup
+    default = S.generate(params, tokens, cfg, n_new=3, max_len=16)
+    flashed = S.generate(params, tokens, cfg, n_new=3, max_len=16,
+                         attn_fn=FA.flash_attention)
+    assert (default == flashed).all()

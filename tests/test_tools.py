@@ -390,3 +390,71 @@ class TestDefragAdvisor:
             c.close()
         assert report["gain_whole_chips"] == 0
         assert report["moves"] == []
+
+    def test_gang_members_pinned_not_moved(self, api):
+        """Committed gang members are never proposed as defrag victims:
+        deleting one bricks the whole group. They stay pinned at their
+        placement and the repack packs around them."""
+        import simulate
+        from tpushare.utils import const
+
+        api.create_node(make_node("h0", chips=2, hbm_per_chip=16))
+        api.create_node(make_node("h1", chips=2, hbm_per_chip=16))
+        c = Cluster(api)
+        try:
+            ann = {const.ANN_POD_GROUP: "ring",
+                   const.ANN_POD_GROUP_MIN: "2"}
+            for i in range(2):
+                d = make_pod(f"g{i}", hbm=8, uid=f"ug{i}",
+                             annotations=ann)
+                api.create_pod(d)
+                c.schedule(d)  # member 0 held, member 1 commits
+            import time
+            time.sleep(0.05)
+            # A lone slice fragments the other chip.
+            d = make_pod("lone", hbm=8, uid="ul")
+            api.create_pod(d)
+            assert c.schedule(d)[0]
+            assert c.controller.wait_idle(timeout=5)
+            doc = c.inspect()
+            gang_pods = [p["name"] for n in doc["nodes"]
+                         for ch in n["chips"] for p in ch["pods"]
+                         if p.get("gang")]
+            assert sorted(set(gang_pods)) == ["g0", "g1"]
+            report = simulate.defrag(doc)
+        finally:
+            c.close()
+        assert sorted(report["pinned"]) == ["default/g0", "default/g1"]
+        for m in report["moves"]:
+            assert not m["pod"].startswith("default/g")
+
+    def test_tainted_node_capacity_not_offered(self, api):
+        """A NoSchedule-tainted node's free chips are not sold as
+        re-pack headroom, and its residents stay pinned."""
+        import simulate
+
+        api.create_node(make_node("open", chips=2, hbm_per_chip=16))
+        api.create_node(make_node("tainted", chips=2, hbm_per_chip=16,
+                                  taints=[{"key": "pool", "value": "x",
+                                           "effect": "NoSchedule"}]))
+        c = Cluster(api)
+        try:
+            tolerant = make_pod("tol", hbm=8, uid="ut")
+            tolerant["spec"]["tolerations"] = [
+                {"key": "pool", "operator": "Exists"}]
+            api.create_pod(tolerant)
+            # bind directly onto the tainted node (kube-scheduler would,
+            # given the toleration)
+            status, doc = c._post("/tpushare-scheduler/bind", {
+                "PodName": "tol", "PodNamespace": "default",
+                "PodUID": "ut", "Node": "tainted"})
+            assert status == 200, doc
+            assert c.controller.wait_idle(timeout=5)
+            report = simulate.defrag(c.inspect())
+        finally:
+            c.close()
+        assert report["pinned"] == ["default/tol"]
+        assert report["moves"] == []
+        # Only the open node's 2 chips count as free capacity.
+        assert report["current_free_whole_chips"] == 2
+        assert report["repacked_free_whole_chips"] == 2

@@ -163,15 +163,12 @@ def simulate(scenario: dict) -> dict:
     from tpushare.api.objects import Node
     from tpushare.cmd.main import serve_stack, shutdown_stack
     from tpushare.k8s.errors import NotFoundError
-    from tpushare.k8s.fake import FakeApiServer
     from tpushare.utils import node as nodeutils
 
     node_docs = _expand_fleet(scenario)
     if not node_docs:
         return {"error": "scenario has no fleet"}
-    api = FakeApiServer()
-    for doc in node_docs:
-        api.create_node(doc)
+    api = _fresh_api(scenario.get("fleet", []))
     stack, server = serve_stack(api)
     client = _Client(*server.server_address[:2])
 
@@ -380,11 +377,22 @@ def defrag(inspect_doc: dict) -> dict:
     if not current_nodes:
         return {"error": "no nodes in inspect dump"}
 
+    # A node is RESTRICTED when its capacity is conditional: cordoned,
+    # or tainted NoSchedule/NoExecute (which pods may land there depends
+    # on tolerations the dump doesn't carry). Its residents are PINNED —
+    # pre-placed exactly where they are so the repack packs around them
+    # — as are committed gang members: "delete and re-create" one member
+    # disrupts the whole group, so the advisor never proposes it.
+    def _restricted(n: dict) -> bool:
+        return bool(n.get("unschedulable")) or any(
+            t.get("effect") in ("NoSchedule", "NoExecute")
+            for t in n.get("taints") or [])
+
     residents: dict[tuple, dict] = {}
     cur_free_chips = 0
     for node in current_nodes:
         for chip in node["chips"]:
-            if chip["usedHBM"] == 0 and not node.get("unschedulable"):
+            if chip["usedHBM"] == 0 and not _restricted(node):
                 cur_free_chips += 1
             for pod in chip["pods"]:
                 key = (pod["namespace"], pod["name"])
@@ -392,6 +400,9 @@ def defrag(inspect_doc: dict) -> dict:
                     "node": node["name"], "usedHBM": pod["usedHBM"],
                     "chips": len(pod["chipIds"]),
                     "chip_ids": tuple(sorted(pod["chipIds"])),
+                    "chip_hbm": next(
+                        (c["totalHBM"] for c in node["chips"]
+                         if c["id"] in pod["chipIds"]), 0),
                     # The dump carries the REAL request type and scoring
                     # intent (inspect writes them), so no slice-vs-chip
                     # heuristic is needed; dumps predating those fields
@@ -402,6 +413,7 @@ def defrag(inspect_doc: dict) -> dict:
                             c["totalHBM"] for c in node["chips"]
                             if c["id"] in pod["chipIds"])),
                     "scoring": pod.get("scoring", ""),
+                    "pinned": bool(pod.get("gang")) or _restricted(node),
                 })
 
     scenario_fleet = [{
@@ -411,17 +423,44 @@ def defrag(inspect_doc: dict) -> dict:
         "tpu_type": n.get("tpuType", "v5e"),
         "topology": n.get("topology", "2x2x1"),
         "slice_id": n.get("sliceId", ""),
-        "unschedulable": bool(n.get("unschedulable")),
+        # Restricted capacity is never offered to the repack.
+        "unschedulable": _restricted(n),
     } for n in current_nodes]
 
     api = _fresh_api(scenario_fleet)
     from tpushare.cmd.main import serve_stack, shutdown_stack
+    from tpushare.utils import const as _c
     stack, server = serve_stack(api)
     client = _Client(*server.server_address[:2])
-    failed = []
+    failed, pinned = [], []
     try:
-        order = sorted(residents.items(),
-                       key=lambda kv: -kv[1]["usedHBM"])
+        # Pinned residents first: created pre-bound at their CURRENT
+        # placement (full annotation commit record + nodeName, exactly
+        # what a crash-rebuild reads), so the repack packs AROUND them
+        # instead of treating their chips as free.
+        for (ns, name), rec in residents.items():
+            if not rec["pinned"]:
+                continue
+            pinned.append(f"{ns}/{name}")
+            if rec["whole"]:
+                doc = make_pod(name, chips=rec["chips"], namespace=ns)
+            else:
+                doc = make_pod(name, hbm=rec["usedHBM"], namespace=ns)
+            doc["spec"]["nodeName"] = rec["node"]
+            doc["status"]["phase"] = "Running"
+            doc["metadata"]["annotations"].update({
+                _c.ANN_CHIP_IDX: ",".join(map(str, rec["chip_ids"])),
+                _c.ANN_HBM_POD: str(rec["usedHBM"]),
+                _c.ANN_HBM_CHIP: str(rec["chip_hbm"]),
+                _c.ANN_ASSIGNED: _c.ASSIGNED_TRUE,
+                _c.ANN_ASSUME_TIME: "0",
+            })
+            api.create_pod(doc)
+        stack.controller.wait_idle(timeout=10)
+
+        order = sorted(
+            ((k, r) for k, r in residents.items() if not r["pinned"]),
+            key=lambda kv: -kv[1]["usedHBM"])
         for (ns, name), rec in order:
             ann = ({const.ANN_SCORING: rec["scoring"]}
                    if rec["scoring"] else None)
@@ -434,7 +473,7 @@ def defrag(inspect_doc: dict) -> dict:
             pod = api.create_pod(doc)
             verdict = _schedule_one(
                 client, pod, [n["name"] for n in current_nodes
-                              if not n.get("unschedulable")])
+                              if not _restricted(n)])
             if verdict["state"] != "bound":
                 failed.append(f"{ns}/{name}")
         repack = client.get("/tpushare-scheduler/inspect")
@@ -456,8 +495,8 @@ def defrag(inspect_doc: dict) -> dict:
     moves = []
     for key, rec in residents.items():
         after = new_map.get(key)
-        if after is None:
-            continue  # reported in unplaced
+        if after is None or rec["pinned"]:
+            continue  # unplaced, or never considered movable
         if after != (rec["node"], rec["chip_ids"]):
             moves.append({"pod": f"{key[0]}/{key[1]}",
                           "from": f"{rec['node']}"
@@ -465,15 +504,22 @@ def defrag(inspect_doc: dict) -> dict:
                           "to": f"{after[0]}"
                                 f"[{','.join(map(str, after[1]))}]"})
 
+    restricted_names = {n["name"] for n in current_nodes
+                        if _restricted(n)}
     new_free = sum(1 for n in repack["nodes"]
                    for c in n["chips"]
-                   if c["usedHBM"] == 0 and not n.get("unschedulable"))
+                   if c["usedHBM"] == 0
+                   and n["name"] not in restricted_names)
     return {
         "current_free_whole_chips": cur_free_chips,
         "repacked_free_whole_chips": new_free,
         "gain_whole_chips": new_free - cur_free_chips,
         "moves": moves,
         "pods": len(residents),
+        # Pinned pods were never considered movable (gang members,
+        # residents of cordoned/tainted nodes) — the repack packed
+        # around them at their current placement.
+        "pinned": pinned,
         # Non-empty means the advisory is unsound for those pods (e.g.
         # a heterogeneous detail the dump can't express) — say so
         # rather than under-report the fleet.
@@ -554,6 +600,10 @@ def _print_defrag(report: dict) -> None:
               "(delete these pods and let their owners re-create them):")
         for m in report["moves"]:
             print(f"    {m['pod']}: {m['from']} -> {m['to']}")
+    if report["pinned"]:
+        print(f"  pinned (never moved): {len(report['pinned'])} pod(s) — "
+              "gang members and residents of cordoned/tainted nodes; "
+              "the re-pack packed around them")
     if report["unplaced"]:
         print(f"  WARNING: {len(report['unplaced'])} pod(s) did not fit "
               f"the re-pack model: {', '.join(report['unplaced'])} — "

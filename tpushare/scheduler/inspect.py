@@ -56,6 +56,9 @@ class Inspect:
                 scoring = p.annotations.get(const.ANN_SCORING)
                 if scoring:
                     entry["scoring"] = scoring
+                gang = p.annotations.get(const.ANN_POD_GROUP)
+                if gang:
+                    entry["gang"] = gang
                 pods.append(entry)
             used = chip.get_used_hbm()
             used_total += used
@@ -81,6 +84,11 @@ class Inspect:
         # quorum skips it too).
         if info.node.unschedulable:
             doc["unschedulable"] = True
+        if info.node.taints:
+            # Exported so offline tooling (defrag) knows this node's
+            # capacity is conditional — which pods can land here depends
+            # on tolerations the dump doesn't carry.
+            doc["taints"] = list(info.node.taints)
         # Position within a multi-host slice, when known: operators (and
         # the what-if CLI) can see which hosts of a slice are grid
         # neighbors — the adjacency gang placement optimizes for.

@@ -107,14 +107,20 @@ def decode_step(params: dict, cache: list[dict], token: jax.Array,
     return logits, new_cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "n_new", "max_len"))
+@partial(jax.jit, static_argnames=("cfg", "n_new", "max_len", "attn_fn"))
 def generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
-             n_new: int, max_len: int) -> jax.Array:
+             n_new: int, max_len: int, attn_fn=None) -> jax.Array:
     """Greedy generation: prompt [B, L] → [B, L + n_new] token ids.
 
     Prefill once, then ``lax.scan`` over ``decode_step`` — the loop is
     compiled control flow (no per-token retrace, no host round-trips),
     which is what makes batch decode on a shared chip cheap.
+
+    ``attn_fn`` is the PREFILL attention (decode always attends the
+    1-token query against the cache — there is no O(L²) score matrix to
+    avoid there). Pass ``flash_attention`` for long prompts: a 32k-token
+    prefill through the default XLA path materializes [B, H, L, L]
+    scores the chip cannot hold; the Pallas kernel streams them.
     """
     B, L = tokens.shape
     if L + n_new > max_len:
@@ -125,7 +131,7 @@ def generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
         raise ValueError(
             f"L + n_new = {L + n_new} exceeds cache max_len {max_len}")
     cache = init_cache(cfg, B, max_len)
-    logits, cache = prefill(params, tokens, cache)
+    logits, cache = prefill(params, tokens, cache, attn_fn=attn_fn)
 
     def step(carry, _):
         cache, logits, pos = carry
