@@ -458,3 +458,96 @@ class TestDefragAdvisor:
         # Only the open node's 2 chips count as free capacity.
         assert report["current_free_whole_chips"] == 2
         assert report["repacked_free_whole_chips"] == 2
+
+
+class TestDrainAdvisor:
+    def test_drain_fits_remaining_fleet(self, api):
+        import simulate
+
+        api.create_node(make_node("keep", chips=2, hbm_per_chip=16))
+        api.create_node(make_node("bye", chips=2, hbm_per_chip=16))
+        c = Cluster(api)
+        try:
+            for name, node in (("a", "keep"), ("b", "bye")):
+                d = make_pod(name, hbm=8, uid=f"u{name}")
+                api.create_pod(d)
+                status, doc = c._post("/tpushare-scheduler/bind", {
+                    "PodName": name, "PodNamespace": "default",
+                    "PodUID": f"u{name}", "Node": node})
+                assert status == 200, doc
+            assert c.controller.wait_idle(timeout=5)
+            report = simulate.defrag(c.inspect(), drain="bye")
+        finally:
+            c.close()
+        assert report["drained_node"] == "bye"
+        assert report["unplaced"] == []
+        assert len(report["moves"]) == 1
+        assert report["moves"][0]["pod"] == "default/b"
+        assert report["moves"][0]["to"].startswith("keep")
+        # The pod already on 'keep' is pinned, never proposed to move.
+        assert report["pinned"] == ["default/a"]
+
+    def test_drain_blocked_when_no_room(self, api):
+        import simulate
+
+        api.create_node(make_node("keep", chips=1, hbm_per_chip=16))
+        api.create_node(make_node("bye", chips=1, hbm_per_chip=16))
+        c = Cluster(api)
+        try:
+            for name, node, hbm in (("a", "keep", 12), ("b", "bye", 12)):
+                d = make_pod(name, hbm=hbm, uid=f"u{name}")
+                api.create_pod(d)
+                status, doc = c._post("/tpushare-scheduler/bind", {
+                    "PodName": name, "PodNamespace": "default",
+                    "PodUID": f"u{name}", "Node": node})
+                assert status == 200, doc
+            assert c.controller.wait_idle(timeout=5)
+            report = simulate.defrag(c.inspect(), drain="bye")
+        finally:
+            c.close()
+        # 12 GiB won't fit next to the 12 already on keep's only chip.
+        assert report["unplaced"] == ["default/b"]
+        assert report["moves"] == []
+
+    def test_drain_unknown_node_errors(self, api):
+        import simulate
+
+        api.create_node(make_node("n0", chips=1, hbm_per_chip=16))
+        c = Cluster(api)
+        try:
+            report = simulate.defrag(c.inspect(), drain="ghost")
+        finally:
+            c.close()
+        assert "not in the inspect dump" in report["error"]
+
+    def test_drain_blocked_by_gang_on_node(self, api):
+        """A committed gang member on the drained node is a BLOCKER —
+        the advisory must not claim the drain is safe."""
+        import simulate
+        from tpushare.utils import const
+
+        api.create_node(make_node("h0", chips=2, hbm_per_chip=16))
+        api.create_node(make_node("h1", chips=2, hbm_per_chip=16))
+        c = Cluster(api)
+        try:
+            ann = {const.ANN_POD_GROUP: "ring",
+                   const.ANN_POD_GROUP_MIN: "2"}
+            for i in range(2):
+                d = make_pod(f"g{i}", hbm=8, uid=f"ug{i}",
+                             annotations=ann)
+                api.create_pod(d)
+                c.schedule(d)
+            import time
+            time.sleep(0.05)
+            assert c.controller.wait_idle(timeout=5)
+            doc = c.inspect()
+            gang_node = next(
+                n["name"] for n in doc["nodes"]
+                for ch in n["chips"] for p in ch["pods"]
+                if p.get("gang"))
+            report = simulate.defrag(doc, drain=gang_node)
+        finally:
+            c.close()
+        assert report["blocking_gangs"]  # the drain is NOT safe
+        assert all(b.startswith("default/g")
+                   for b in report["blocking_gangs"])

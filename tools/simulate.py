@@ -168,7 +168,7 @@ def simulate(scenario: dict) -> dict:
     node_docs = _expand_fleet(scenario)
     if not node_docs:
         return {"error": "scenario has no fleet"}
-    api = _fresh_api(scenario.get("fleet", []))
+    api = _fresh_api(node_docs)
     stack, server = serve_stack(api)
     client = _Client(*server.server_address[:2])
 
@@ -356,7 +356,7 @@ def _print_human(report: dict) -> None:
         print(f"\ngang {g.get('name')}: {g}")
 
 
-def defrag(inspect_doc: dict) -> dict:
+def defrag(inspect_doc: dict, drain: str | None = None) -> dict:
     """Defragmentation advisor: what would re-packing the CURRENT fleet
     buy, and which pods would have to move?
 
@@ -369,6 +369,15 @@ def defrag(inspect_doc: dict) -> dict:
     jobs starve for) and the move list. ADVISORY ONLY — nothing is
     evicted; the operator decides whether the gain is worth the moves
     (a kubectl delete on the listed pods re-packs them organically).
+
+    ``drain`` flips the question to "can I drain node X?": everything
+    NOT on X is pinned where it is, X's capacity is withdrawn, and only
+    X's residents are re-packed onto the remaining fleet — the report's
+    ``unplaced`` are the pods that will go Pending if the drain
+    proceeds, and ``moves`` shows where the rest land. Gang members on
+    X are still pinned (drain-evicting one member bricks its group) and
+    surface in ``pinned`` so the operator sees the gang must be torn
+    down whole first.
     """
     from tpushare.k8s.builders import make_pod
     from tpushare.utils import const
@@ -376,6 +385,9 @@ def defrag(inspect_doc: dict) -> dict:
     current_nodes = inspect_doc.get("nodes", [])
     if not current_nodes:
         return {"error": "no nodes in inspect dump"}
+    if drain is not None and drain not in {n["name"]
+                                           for n in current_nodes}:
+        return {"error": f"node {drain!r} not in the inspect dump"}
 
     # A node is RESTRICTED when its capacity is conditional: cordoned,
     # or tainted NoSchedule/NoExecute (which pods may land there depends
@@ -392,7 +404,10 @@ def defrag(inspect_doc: dict) -> dict:
     cur_free_chips = 0
     for node in current_nodes:
         for chip in node["chips"]:
-            if chip["usedHBM"] == 0 and not _restricted(node):
+            if (chip["usedHBM"] == 0 and not _restricted(node)
+                    and node["name"] != drain):
+                # Drain mode asks about the REMAINING fleet, so the
+                # departing node's chips never count as headroom.
                 cur_free_chips += 1
             for pod in chip["pods"]:
                 key = (pod["namespace"], pod["name"])
@@ -413,7 +428,13 @@ def defrag(inspect_doc: dict) -> dict:
                             c["totalHBM"] for c in node["chips"]
                             if c["id"] in pod["chipIds"])),
                     "scoring": pod.get("scoring", ""),
-                    "pinned": bool(pod.get("gang")) or _restricted(node),
+                    # Defrag mode: gangs + restricted-node residents
+                    # stay put. Drain mode: everything stays put EXCEPT
+                    # the drained node's non-gang residents.
+                    "pinned": (bool(pod.get("gang")) or _restricted(node)
+                               if drain is None else
+                               bool(pod.get("gang"))
+                               or node["name"] != drain),
                 })
 
     scenario_fleet = [{
@@ -423,16 +444,17 @@ def defrag(inspect_doc: dict) -> dict:
         "tpu_type": n.get("tpuType", "v5e"),
         "topology": n.get("topology", "2x2x1"),
         "slice_id": n.get("sliceId", ""),
-        # Restricted capacity is never offered to the repack.
-        "unschedulable": _restricted(n),
+        # Restricted capacity is never offered to the repack; neither
+        # is the node being drained.
+        "unschedulable": _restricted(n) or n["name"] == drain,
     } for n in current_nodes]
 
-    api = _fresh_api(scenario_fleet)
+    api = _fresh_api(_expand_fleet({"fleet": scenario_fleet}))
     from tpushare.cmd.main import serve_stack, shutdown_stack
     from tpushare.utils import const as _c
     stack, server = serve_stack(api)
     client = _Client(*server.server_address[:2])
-    failed, pinned = [], []
+    failed, pinned, blocking_gangs = [], [], []
     try:
         # Pinned residents first: created pre-bound at their CURRENT
         # placement (full annotation commit record + nodeName, exactly
@@ -442,6 +464,11 @@ def defrag(inspect_doc: dict) -> dict:
             if not rec["pinned"]:
                 continue
             pinned.append(f"{ns}/{name}")
+            if drain is not None and rec["node"] == drain:
+                # A gang member on the node being drained: the drain
+                # cannot proceed pod-by-pod — the group must be torn
+                # down whole. This is a BLOCKER, not background pinning.
+                blocking_gangs.append(f"{ns}/{name}")
             if rec["whole"]:
                 doc = make_pod(name, chips=rec["chips"], namespace=ns)
             else:
@@ -456,7 +483,11 @@ def defrag(inspect_doc: dict) -> dict:
                 _c.ANN_ASSUME_TIME: "0",
             })
             api.create_pod(doc)
-        stack.controller.wait_idle(timeout=10)
+        if not stack.controller.wait_idle(timeout=30):
+            # An un-ledgered pinned pod would make the repack bind onto
+            # occupied chips — refuse to emit an unsound advisory.
+            return {"error": "controller did not quiesce while pinning "
+                             "residents; advisory aborted"}
 
         order = sorted(
             ((k, r) for k, r in residents.items() if not r["pinned"]),
@@ -473,7 +504,8 @@ def defrag(inspect_doc: dict) -> dict:
             pod = api.create_pod(doc)
             verdict = _schedule_one(
                 client, pod, [n["name"] for n in current_nodes
-                              if not _restricted(n)])
+                              if not _restricted(n)
+                              and n["name"] != drain])
             if verdict["state"] != "bound":
                 failed.append(f"{ns}/{name}")
         repack = client.get("/tpushare-scheduler/inspect")
@@ -505,7 +537,7 @@ def defrag(inspect_doc: dict) -> dict:
                                 f"[{','.join(map(str, after[1]))}]"})
 
     restricted_names = {n["name"] for n in current_nodes
-                        if _restricted(n)}
+                        if _restricted(n) or n["name"] == drain}
     new_free = sum(1 for n in repack["nodes"]
                    for c in n["chips"]
                    if c["usedHBM"] == 0
@@ -520,6 +552,8 @@ def defrag(inspect_doc: dict) -> dict:
         # residents of cordoned/tainted nodes) — the repack packed
         # around them at their current placement.
         "pinned": pinned,
+        **({"drained_node": drain,
+            "blocking_gangs": sorted(blocking_gangs)} if drain else {}),
         # Non-empty means the advisory is unsound for those pods (e.g.
         # a heterogeneous detail the dump can't express) — say so
         # rather than under-report the fleet.
@@ -527,11 +561,11 @@ def defrag(inspect_doc: dict) -> dict:
     }
 
 
-def _fresh_api(fleet: list[dict]):
+def _fresh_api(node_docs: list[dict]):
     from tpushare.k8s.fake import FakeApiServer
 
     api = FakeApiServer()
-    for doc in _expand_fleet({"fleet": fleet}):
+    for doc in node_docs:
         api.create_node(doc)
     return api
 
@@ -545,6 +579,11 @@ def main() -> None:
                     help="machine-readable report on stdout")
     ap.add_argument("--example", action="store_true",
                     help="print a starter scenario and exit")
+    ap.add_argument("--drain", metavar="NODE",
+                    help="with --defrag: ask whether NODE can be "
+                         "drained — only its residents are re-packed "
+                         "(onto the remaining fleet); 'unplaced' pods "
+                         "would go Pending")
     ap.add_argument("--defrag", metavar="SRC",
                     help="defrag advisory instead of a replay: SRC is an "
                          "extender base URL (its live inspect is fetched) "
@@ -557,6 +596,8 @@ def main() -> None:
         return
     if not args.scenario and not args.defrag:
         ap.error("scenario file required (or --example / --defrag)")
+    if args.drain and not args.defrag:
+        ap.error("--drain requires --defrag SRC")
     # Runnable from anywhere without pip-installing the package.
     import os
     sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -571,7 +612,7 @@ def main() -> None:
         else:
             with open(args.defrag) as f:
                 inspect_doc = json.load(f)
-        report = defrag(inspect_doc)
+        report = defrag(inspect_doc, drain=args.drain)
         if args.as_json:
             print(json.dumps(report))
         else:
@@ -589,6 +630,23 @@ def _print_defrag(report: dict) -> None:
         print(f"error: {report['error']}", file=sys.stderr)
         raise SystemExit(2)
     gain = report["gain_whole_chips"]
+    if report.get("drained_node"):
+        print(f"drain advisory for node {report['drained_node']}:")
+        blockers = report.get("blocking_gangs", [])
+        if blockers:
+            print(f"  BLOCKED: gang member(s) live on the node — the "
+                  f"group must be torn down whole before draining: "
+                  f"{', '.join(blockers)}")
+        if report["unplaced"]:
+            print(f"  BLOCKED: {len(report['unplaced'])} pod(s) have "
+                  f"nowhere to go and will sit Pending: "
+                  f"{', '.join(report['unplaced'])}")
+        if not blockers and not report["unplaced"]:
+            print("  safe: every movable resident fits the remaining "
+                  "fleet")
+        for m in report["moves"]:
+            print(f"    {m['pod']}: {m['from']} -> {m['to']}")
+        return
     print(f"defrag advisory over {report['pods']} resident pod(s):")
     print(f"  free whole chips: {report['current_free_whole_chips']} now "
           f"-> {report['repacked_free_whole_chips']} after re-pack "
