@@ -105,3 +105,46 @@ def test_ledger_incremental_matches_recompute():
             assert chip.get_used_hbm() == recomputed, f"chip {chip.idx}"
     finally:
         controller.stop()
+
+
+def test_fleet_scale_filter_prioritize_256_nodes():
+    """A 256-node fleet: the full webhook scan (filter all + prioritize
+    survivors) stays in interactive territory — the per-node cost is a
+    dict lookup + O(chips) arithmetic, so 4x the fleet must cost about
+    4x the 64-node scan, not worse."""
+    from tpushare.scheduler.predicate import Predicate
+    from tpushare.scheduler.prioritize import Prioritize
+
+    def scan_time(n_nodes: int) -> float:
+        api = FakeApiServer()
+        for i in range(n_nodes):
+            api.create_node(make_node(f"n-{i:03d}", chips=4,
+                                      hbm_per_chip=95,
+                                      topology="2x2x1", tpu_type="v5p"))
+        from tpushare.cache.cache import SchedulerCache
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        pred, prio = Predicate(cache), Prioritize(cache)
+        names = [f"n-{i:03d}" for i in range(n_nodes)]
+        pod = api.create_pod(make_pod("probe", hbm=24))
+        args = ExtenderArgs.from_json({"Pod": pod.raw,
+                                       "NodeNames": names})
+        pred.handle(args)  # warm: builds every ledger once
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            result = pred.handle(args)
+            ranked = prio.handle(ExtenderArgs.from_json(
+                {"Pod": pod.raw, "NodeNames": result.node_names}))
+            dt = time.perf_counter() - t0
+            best = dt if best is None or dt < best else best
+        assert len(ranked) == n_nodes
+        return best
+
+    t64, t256 = scan_time(64), scan_time(256)
+    # Linear-with-slack: 4x nodes may cost up to 10x (CI noise), never
+    # the quadratic blowup a per-scan rebuild would show.
+    assert t256 < max(t64 * 10, 0.25), (
+        f"fleet scan not linear: 64={t64*1e3:.2f}ms "
+        f"256={t256*1e3:.2f}ms")
+    # And in absolute terms the full 256-node scan stays interactive.
+    assert t256 < 1.0, f"256-node scan took {t256:.2f}s"
