@@ -143,13 +143,18 @@ class TestElector:
 
 
 class TestHABindGating:
-    def _server(self, api, elector):
-        stack = build_stack(api)
+    def _server(self, api, elector, *, gate_planner: bool = False):
+        """``gate_planner`` wires is_leader into the stack (the way
+        cmd/main does) so the gang planner's housekeeping is
+        leader-gated too."""
+        stack = build_stack(
+            api, is_leader=elector.is_leader if gate_planner else None)
         stack.controller.start(workers=2)
         server = ExtenderHTTPServer(("127.0.0.1", 0), stack.predicate,
                                     stack.binder, stack.inspect,
                                     prioritize=stack.prioritize,
-                                    leader=elector)
+                                    leader=elector,
+                                    gang_planner=stack.binder.gang_planner)
         serve_forever(server)
         base = f"http://127.0.0.1:{server.server_address[1]}"
         return stack, server, base
@@ -238,5 +243,88 @@ class TestHABindGating:
             server_b.shutdown()
             stack_b.binder.gang_planner.stop()
             stack_b.controller.stop()
+            a.stop()
+            b.stop()
+
+    def test_gang_handoff_across_failover(self, api):
+        """The round-2 hazard, end to end: a gang half-reserved by the
+        OLD leader is completed by the NEW one. An uncommitted
+        reservation's node choice lives only in the old leader's memory,
+        so the new leader conservatively RESETS the member (strips the
+        annotations, errors the bind) and the scheduler re-places it
+        fresh; the demoted replica's housekeeping is leader-gated so it
+        cannot race the new leader's placement."""
+        from tpushare.utils import const, pod as podutils
+
+        for i in range(2):
+            api.create_node(make_node(f"h{i}", chips=4, hbm_per_chip=95))
+        a = LeaderElector(api, "a", lease_duration=1.0, renew_period=0.05)
+        b = LeaderElector(api, "b", lease_duration=1.0, renew_period=0.05)
+        a.start()
+        assert _wait(a.is_leader)
+        b.start()
+
+        stack_a, server_a, base_a = self._server(api, a,
+                                                 gate_planner=True)
+        stack_b, server_b, base_b = self._server(api, b,
+                                                 gate_planner=True)
+        ann = {const.ANN_POD_GROUP: "ring", const.ANN_POD_GROUP_MIN: "2"}
+        try:
+            w0 = api.create_pod(make_pod("w0", chips=4, annotations=ann))
+            bind0 = {"PodName": "w0", "PodNamespace": "default",
+                     "PodUID": w0.uid, "Node": "h0"}
+            status, result = self._post(
+                base_a, "/tpushare-scheduler/bind", bind0)
+            assert status == 500 and "pending quorum" in result["Error"]
+            reserved = api.get_pod("default", "w0")
+            assert podutils.is_assumed(reserved)  # annotations written
+
+            a.stop()  # leader dies; its stack (and planner) stay alive
+            assert _wait(b.is_leader, timeout=5.0)
+
+            # kube-scheduler retries w0 against the new leader: the old
+            # leader's in-memory node choice is gone, so the member is
+            # RESET (annotations stripped, bind errored) rather than
+            # guessed at.
+            status, result = self._post(
+                base_b, "/tpushare-scheduler/bind", bind0)
+            assert status == 500
+            assert "stale reservation; reset" in result["Error"]
+            assert not podutils.is_assumed(api.get_pod("default", "w0"))
+
+            # The scheduler re-places it fresh: filter -> bind on B.
+            status, result = self._post(
+                base_b, "/tpushare-scheduler/filter",
+                {"Pod": api.get_pod("default", "w0").raw,
+                 "NodeNames": ["h0", "h1"]})
+            assert status == 200 and result["NodeNames"]
+            node0 = result["NodeNames"][0]
+            bind0["Node"] = node0
+            status, result = self._post(
+                base_b, "/tpushare-scheduler/bind", bind0)
+            assert status == 500 and "pending quorum" in result["Error"]
+            node1 = "h1" if node0 == "h0" else "h0"  # the other host
+            w1 = api.create_pod(make_pod("w1", chips=4, annotations=ann))
+            status, result = self._post(
+                base_b, "/tpushare-scheduler/bind",
+                {"PodName": "w1", "PodNamespace": "default",
+                 "PodUID": w1.uid, "Node": node1})
+            assert status == 200, result
+
+            assert _wait(lambda: bool(
+                api.get_pod("default", "w0").node_name), timeout=5.0)
+            final0 = api.get_pod("default", "w0")
+            final1 = api.get_pod("default", "w1")
+            assert {final0.node_name, final1.node_name} == {"h0", "h1"}
+            # Whole hosts granted, exactly once each.
+            for p_ in (final0, final1):
+                ids = p_.annotations[const.ANN_CHIP_IDX].split(",")
+                assert len(ids) == 4
+        finally:
+            for server, stack in ((server_a, stack_a),
+                                  (server_b, stack_b)):
+                server.shutdown()
+                stack.binder.gang_planner.stop()
+                stack.controller.stop()
             a.stop()
             b.stop()
