@@ -439,3 +439,63 @@ class TestCordonAwareQuorum:
         p = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
         with pytest.raises(GangPending):
             planner.bind_member(p, "host-0")  # reserved, not rejected
+
+
+class TestBoundMembersCountTowardQuorum:
+    def test_reset_member_rejoins_running_gang(self, api):
+        """Leader failover mid-commit: one member is already BOUND and
+        running, its sibling was reset and arrives as a fresh
+        reservation. Reservations alone never reach quorum again — the
+        bound sibling must count, so the fresh member commits
+        immediately instead of cycling reserve→TTL forever."""
+        from tpushare.utils import pod as podutils
+
+        api.create_node(make_node("h0", chips=4, hbm_per_chip=95))
+        api.create_node(make_node("h1", chips=4, hbm_per_chip=95))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60)
+
+        # Sibling bound by the previous leader: annotated + nodeName.
+        bound_doc = make_pod("w1", chips=4, annotations=dict(ANN),
+                             node_name="h1", phase="Running")
+        bound_doc["metadata"]["annotations"].update({
+            const.ANN_CHIP_IDX: "0,1,2,3",
+            const.ANN_HBM_POD: "380",
+            const.ANN_HBM_CHIP: "95",
+            const.ANN_ASSIGNED: const.ASSIGNED_TRUE,
+            const.ANN_ASSUME_TIME: "1",
+        })
+        bound = api.create_pod(bound_doc)
+        cache.add_or_update_pod(bound)
+
+        fresh = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        planner.bind_member(fresh, "h0")  # must COMMIT, not GangPending
+        final = api.get_pod("default", "w0")
+        assert final.node_name == "h0"
+        assert podutils.is_assumed(final)
+
+    def test_quorum_feasibility_credits_bound_members(self, api):
+        """A 1-host cluster whose only other host died: the running
+        member makes a min=2 gang feasible with just one free host."""
+        api.create_node(make_node("h0", chips=4, hbm_per_chip=95))
+        api.create_node(make_node("h1", chips=4, hbm_per_chip=95))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60)
+        bound_doc = make_pod("w1", chips=4, annotations=dict(ANN),
+                             node_name="h1", phase="Running")
+        bound_doc["metadata"]["annotations"].update({
+            const.ANN_CHIP_IDX: "0,1,2,3",
+            const.ANN_HBM_POD: "380",
+            const.ANN_HBM_CHIP: "95",
+            const.ANN_ASSIGNED: const.ASSIGNED_TRUE,
+            const.ANN_ASSUME_TIME: "1",
+        })
+        cache.add_or_update_pod(api.create_pod(bound_doc))
+        # Fleet now fits exactly ONE more whole-host member (h1 is
+        # occupied by the bound sibling) — feasible only because the
+        # bound member counts as satisfied demand.
+        fresh = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        # Commits; would raise AllocationError("...infeasible...") if
+        # the bound sibling were not credited as satisfied demand.
+        planner.bind_member(fresh, "h0")
+        assert api.get_pod("default", "w0").node_name == "h0"

@@ -194,6 +194,17 @@ class GangPlanner:
 
     # ------------------------------------------------------------------ #
 
+    def _bound_members(self, group: _Group, namespace: str) -> int:
+        """Group members already bound to a node (running or being
+        started) that no local reservation tracks — satisfied quorum
+        demand from a previous planner life (leader failover
+        mid-commit). O(known pods): call only when the outcome can
+        depend on it."""
+        return sum(
+            1 for p in self.cache.gang_members(namespace, group.name)
+            if p.node_name and p.uid not in group.reservations
+            and not podutils.is_complete_pod(p))
+
     def _get_group(self, pod: Pod) -> tuple[tuple[str, str], _Group]:
         group_name, minimum = podutils.get_pod_group(pod)
         minimum = max(minimum, 1)
@@ -222,7 +233,8 @@ class GangPlanner:
         and the rejected member passes on the scheduler's retry (a
         permanent all-members-rejected state implies per-member requests
         summing past cluster capacity, i.e. genuine infeasibility)."""
-        needed = group.minimum - len(group.reservations)
+        bound_n = self._bound_members(group, pod.namespace)
+        needed = group.minimum - len(group.reservations) - bound_n
         if needed <= 0:
             return True, ""
         try:
@@ -258,7 +270,8 @@ class GangPlanner:
                 return True, ""
         return False, (
             f"gang {group.name}: quorum {group.minimum} is infeasible — "
-            f"cluster currently fits {copies + len(group.reservations)} "
+            f"cluster currently fits "
+            f"{copies + len(group.reservations) + bound_n} "
             f"member(s); rejecting without reserving")
 
     def member_nodes(self, pod: Pod) -> set[str]:
@@ -318,6 +331,16 @@ class GangPlanner:
                              len(group.reservations), group.minimum)
 
             reserved_n = len(group.reservations)
+            if not group.committed and reserved_n < group.minimum:
+                # Members already BOUND count toward quorum even though
+                # no reservation exists for them: after a leader
+                # failover mid-commit, a reset member re-enters as a
+                # fresh reservation while its siblings are already
+                # running — reservations alone could never re-reach
+                # quorum and the member would cycle reserve→TTL-expire
+                # forever despite free capacity. The O(known-pods) scan
+                # runs only when the outcome can depend on it.
+                reserved_n += self._bound_members(group, key[0])
             if group.committed or reserved_n >= group.minimum:
                 newly_committed: list[tuple[Pod, str]] = []
                 if not group.committed:
@@ -325,8 +348,10 @@ class GangPlanner:
                     # racing expire_stale can never roll back a group
                     # that reached quorum; the apiserver writes (Events,
                     # binding POSTs) happen after release.
-                    log.info("gang %s/%s: quorum reached, committing %d "
-                             "bindings", key[0], group.name, reserved_n)
+                    log.info("gang %s/%s: quorum reached (%d/%d incl. "
+                             "already-bound members), committing %d "
+                             "binding(s)", key[0], group.name, reserved_n,
+                             group.minimum, len(group.reservations))
                     group.committed = True
                     newly_committed = list(group.reservations.values())
             else:
