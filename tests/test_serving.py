@@ -139,3 +139,44 @@ def test_generate_attn_fn_passthrough(setup):
     flashed = S.generate(params, tokens, cfg, n_new=3, max_len=16,
                          attn_fn=FA.flash_attention)
     assert (default == flashed).all()
+
+
+def test_sampling_temperature(setup):
+    """temperature=0 stays greedy; >0 samples reproducibly from the
+    explicit key (same key -> same tokens, different keys may differ)
+    and never leaves the vocabulary."""
+    cfg, params, tokens = setup
+    greedy = S.generate(params, tokens, cfg, n_new=4, max_len=16)
+    also_greedy = S.generate(params, tokens, cfg, n_new=4, max_len=16,
+                             temperature=0.0)
+    assert (greedy == also_greedy).all()
+
+    k1, k2 = jax.random.PRNGKey(1), jax.random.PRNGKey(2)
+    s1 = S.generate(params, tokens, cfg, n_new=4, max_len=16,
+                    temperature=1.0, key=k1)
+    s1_again = S.generate(params, tokens, cfg, n_new=4, max_len=16,
+                          temperature=1.0, key=k1)
+    s2 = S.generate(params, tokens, cfg, n_new=4, max_len=16,
+                    temperature=1.0, key=k2)
+    assert (s1 == s1_again).all()  # reproducible under one key
+    assert ((s1 >= 0) & (s1 < cfg.vocab_size)).all()
+    assert s1.shape == s2.shape == (2, 11)
+
+    with pytest.raises(ValueError, match="requires an explicit PRNG"):
+        S.generate(params, tokens, cfg, n_new=2, max_len=16,
+                   temperature=0.7)
+
+
+def test_temperature_is_traced_not_static(setup):
+    """Per-request temperatures must NOT retrace the generation scan —
+    one compilation serves 0.5 and 0.9 alike."""
+    cfg, params, tokens = setup
+    before = S._generate._cache_size()
+    for t in (0.5, 0.9, 1.3):
+        S.generate(params, tokens, cfg, n_new=2, max_len=16,
+                   temperature=t, key=jax.random.PRNGKey(0))
+    assert S._generate._cache_size() == before + 1
+
+    with pytest.raises(ValueError, match="must be >= 0"):
+        S.generate(params, tokens, cfg, n_new=2, max_len=16,
+                   temperature=-0.5, key=jax.random.PRNGKey(0))

@@ -107,10 +107,20 @@ def decode_step(params: dict, cache: list[dict], token: jax.Array,
     return logits, new_cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "n_new", "max_len", "attn_fn"))
 def generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
-             n_new: int, max_len: int, attn_fn=None) -> jax.Array:
-    """Greedy generation: prompt [B, L] → [B, L + n_new] token ids.
+             n_new: int, max_len: int, attn_fn=None,
+             temperature: float = 0.0, key: jax.Array | None = None
+             ) -> jax.Array:
+    """Generation: prompt [B, L] → [B, L + n_new] token ids.
+
+    ``temperature == 0`` (default) is greedy argmax; ``> 0`` samples
+    each token from ``softmax(logits / temperature)`` using ``key`` —
+    required then, because JAX has no implicit global seed and a
+    quietly-defaulted key would make "random" serving byte-identical
+    across requests. Temperature is a TRACED input (selected with
+    ``jnp.where`` inside the scan), so one compilation serves every
+    per-request temperature — a static temperature would retrace the
+    whole prefill+scan per distinct float.
 
     Prefill once, then ``lax.scan`` over ``decode_step`` — the loop is
     compiled control flow (no per-token retrace, no host round-trips),
@@ -122,6 +132,21 @@ def generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
     prefill through the default XLA path materializes [B, H, L, L]
     scores the chip cannot hold; the Pallas kernel streams them.
     """
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature} "
+                         "(a negative value would silently mean greedy)")
+    if temperature > 0 and key is None:
+        raise ValueError("temperature > 0 requires an explicit PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused by the greedy branch
+    return _generate(params, tokens, cfg, n_new, max_len, attn_fn,
+                     jnp.float32(temperature), key)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_new", "max_len", "attn_fn"))
+def _generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
+              n_new: int, max_len: int, attn_fn,
+              temperature: jax.Array, key: jax.Array) -> jax.Array:
     B, L = tokens.shape
     if L + n_new > max_len:
         # dynamic_update_slice CLAMPS out-of-range indices — an
@@ -133,12 +158,23 @@ def generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
     cache = init_cache(cfg, B, max_len)
     logits, cache = prefill(params, tokens, cache, attn_fn=attn_fn)
 
-    def step(carry, _):
-        cache, logits, pos = carry
-        token = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
-        logits, cache = decode_step(params, cache, token, pos)
-        return (cache, logits, pos + 1), token
+    def pick(logits, k):
+        # Both arms computed, jnp.where selects: the categorical draw
+        # on a [B, vocab] row is trivial next to the decode matmuls,
+        # and a lax.cond here would force its own retrace boundary.
+        scaled = logits / jnp.maximum(temperature, 1e-6)
+        sampled = jax.random.categorical(k, scaled, axis=-1)
+        greedy = jnp.argmax(logits, axis=-1)
+        return jnp.where(temperature > 0, sampled,
+                         greedy).astype(tokens.dtype)
 
-    (_, _, _), out = jax.lax.scan(
-        step, (cache, logits, jnp.asarray(L)), length=n_new)
+    def step(carry, _):
+        cache, logits, pos, k = carry
+        k, sub = jax.random.split(k)
+        token = pick(logits, sub)
+        logits, cache = decode_step(params, cache, token, pos)
+        return (cache, logits, pos + 1, k), token
+
+    (_, _, _, _), out = jax.lax.scan(
+        step, (cache, logits, jnp.asarray(L), key), length=n_new)
     return jnp.concatenate([tokens, out.T], axis=1)
