@@ -499,3 +499,41 @@ class TestBoundMembersCountTowardQuorum:
         # the bound sibling were not credited as satisfied demand.
         planner.bind_member(fresh, "h0")
         assert api.get_pod("default", "w0").node_name == "h0"
+
+    def test_replacement_member_rejoins_without_full_regang(self, api):
+        """Elastic recovery enabled by the bound-member credit: with the
+        reaper opted out (pod-group-reap=false), a Job's REPLACEMENT for
+        a dead member commits against its still-running siblings
+        immediately — no full gang teardown, no TTL squat."""
+        from tpushare.utils import pod as podutils
+
+        ann = {const.ANN_POD_GROUP: "train",
+               const.ANN_POD_GROUP_MIN: "2",
+               const.ANN_POD_GROUP_REAP: "false"}
+        api.create_node(make_node("h0", chips=4, hbm_per_chip=95))
+        api.create_node(make_node("h1", chips=4, hbm_per_chip=95))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        planner = GangPlanner(cache, api, ttl=60)
+
+        w0 = api.create_pod(make_pod("w0", chips=4, annotations=ann))
+        with pytest.raises(GangPending):
+            planner.bind_member(w0, "h0")
+        w1 = api.create_pod(make_pod("w1", chips=4, annotations=ann))
+        planner.bind_member(w1, "h1")  # commits both
+        assert api.get_pod("default", "w0").node_name == "h0"
+
+        # w0 dies (eviction, node trouble); reaper is opted out, so w1
+        # keeps running. The Job recreates w0 as w0-new.
+        dead = api.get_pod("default", "w0")
+        api.delete_pod("default", "w0")
+        cache.remove_pod(dead)
+
+        replacement = api.create_pod(
+            make_pod("w0-new", chips=4, annotations=ann))
+        # Fresh planner life (the old group table may or may not still
+        # exist in production; use a new planner to model the hard case)
+        fresh_planner = GangPlanner(cache, api, ttl=60)
+        fresh_planner.bind_member(replacement, "h0")  # commits at once
+        final = api.get_pod("default", "w0-new")
+        assert final.node_name == "h0"
+        assert podutils.is_assumed(final)

@@ -132,11 +132,18 @@ def generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
     prefill through the default XLA path materializes [B, H, L, L]
     scores the chip cannot hold; the Pallas kernel streams them.
     """
-    if temperature < 0:
-        raise ValueError(f"temperature must be >= 0, got {temperature} "
-                         "(a negative value would silently mean greedy)")
-    if temperature > 0 and key is None:
-        raise ValueError("temperature > 0 requires an explicit PRNG key")
+    if not isinstance(temperature, jax.core.Tracer):
+        # Value validation only at the concrete Python boundary; a
+        # caller who jits over generate() passes a tracer and takes
+        # responsibility for the value (the where-select inside treats
+        # any non-positive temperature as greedy).
+        if temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature} "
+                "(a negative value would silently mean greedy)")
+        if temperature > 0 and key is None:
+            raise ValueError(
+                "temperature > 0 requires an explicit PRNG key")
     if key is None:
         key = jax.random.PRNGKey(0)  # unused by the greedy branch
     return _generate(params, tokens, cfg, n_new, max_len, attn_fn,
