@@ -180,3 +180,30 @@ def test_temperature_is_traced_not_static(setup):
     with pytest.raises(ValueError, match="must be >= 0"):
         S.generate(params, tokens, cfg, n_new=2, max_len=16,
                    temperature=-0.5, key=jax.random.PRNGKey(0))
+
+
+def test_max_batch_for_grant(setup):
+    """Grant-to-capacity sizing: weight bytes come from the real init
+    tree (eval_shape — cannot drift), the cache arithmetic matches
+    init_cache, and the boundary behaviors (too-small grant -> 0) hold."""
+    cfg, params, _ = setup
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    real_bytes = M.param_count(params) * itemsize
+    # With headroom=1 and a grant of exactly params + N cache rows, the
+    # helper must return N.
+    per_seq = S.cache_hbm_bytes(cfg, batch=1, max_len=64)
+    grant_gib = (real_bytes + 5 * per_seq) / (1 << 30)
+    assert S.max_batch_for_grant(cfg, grant_gib, max_len=64,
+                                 headroom=1.0) == 5
+    # A grant smaller than the weights serves nothing.
+    assert S.max_batch_for_grant(cfg, real_bytes / 2 / (1 << 30),
+                                 max_len=64, headroom=1.0) == 0
+    # Flagship on a real 8-GiB slice: a sane, positive batch whose
+    # cache truly fits the budgeted bytes.
+    flagship = M.ModelConfig()
+    headroom = 0.8
+    got = S.max_batch_for_grant(flagship, 8, max_len=2048,
+                                headroom=headroom)
+    assert got > 0
+    assert (S.cache_hbm_bytes(flagship, got, 2048)
+            <= 8 * (1 << 30) * headroom)

@@ -185,3 +185,30 @@ def _generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
     (_, _, _, _), out = jax.lax.scan(
         step, (cache, logits, jnp.asarray(L), key), length=n_new)
     return jnp.concatenate([tokens, out.T], axis=1)
+
+
+def max_batch_for_grant(cfg: M.ModelConfig, grant_hbm_gib: float,
+                        max_len: int, headroom: float = 0.8) -> int:
+    """Largest decode batch that fits a tpushare HBM grant.
+
+    Closes the loop between the scheduler's grant and the serving
+    runtime: a co-tenant receives ``tpushare.io/hbm-pod`` GiB
+    (``jaxenv.read_grant().hbm_pod_gib``), pays for the weights once,
+    and then every concurrent sequence costs one KV-cache row.
+    ``headroom`` (default 0.8) reserves space for logits, activations,
+    and XLA scratch. Returns 0 when the grant cannot even hold the
+    weights — ask the scheduler for a bigger slice.
+    """
+    budget = grant_hbm_gib * (1 << 30) * headroom
+    # Weight bytes from the REAL init tree via eval_shape (allocation-
+    # free): a hand-maintained closed form would silently drift the day
+    # init_params gains a parameter, and an under-counted weight budget
+    # here is an OOM on the co-tenant slice.
+    abstract = jax.eval_shape(
+        lambda: M.init_params(jax.random.PRNGKey(0), cfg))
+    params_bytes = sum(l.size * l.dtype.itemsize
+                       for l in jax.tree_util.tree_leaves(abstract))
+    if params_bytes >= budget:
+        return 0
+    per_seq = cache_hbm_bytes(cfg, batch=1, max_len=max_len)
+    return int((budget - params_bytes) // per_seq)
