@@ -22,6 +22,8 @@ gang lifecycle has its own suite (tests/test_gang_lifecycle.py).
 
 import random
 
+import pytest
+
 from tests.conftest import make_node, make_pod
 from tpushare.api.extender import ExtenderArgs, ExtenderBindingArgs
 from tpushare.cache.cache import SchedulerCache
@@ -85,8 +87,13 @@ def _audit(cache, api):
     return audited
 
 
-def test_randomized_churn_soak(api):
-    rng = random.Random(0xC0FFEE)
+@pytest.mark.parametrize("seed", [0xC0FFEE, 0xBEEF, 0xD00D])
+def test_randomized_churn_soak(api, seed):
+    """Three independent op streams: each seed explores a different
+    interleaving of arrivals/completions/deletions/preempt-plans/
+    cordons/flaps — the audits (re-price + crash-rebuild, every 50 ops)
+    must hold on all of them, not just one lucky trajectory."""
+    rng = random.Random(seed)
     for i in range(6):
         api.create_node(make_node(f"n{i}", chips=4, hbm_per_chip=16,
                                   topology="2x2x1"))
