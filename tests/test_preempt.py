@@ -11,6 +11,8 @@ import json
 import urllib.error
 import urllib.request
 
+import pytest
+
 from tests.conftest import make_node, make_pod
 from tpushare.api.extender import (ExtenderPreemptionArgs,
                                    ExtenderPreemptionResult, Victims)
@@ -325,6 +327,7 @@ class TestVictimSelection:
             make_pod("q", chips=2, priority=100), {"n1": []}))
         assert cache.get_node_info("n1").get_available_hbm() == before
 
+    @pytest.mark.perf
     def test_preempt_scales_to_fleet(self, api):
         """A 64-node victim map plans in interactive time (the scheduler
         calls preempt synchronously on its scheduling thread)."""

@@ -8,6 +8,8 @@ cluster must filter as fast as an empty one.
 
 import time
 
+import pytest
+
 from tpushare.api.extender import ExtenderArgs
 from tpushare.cmd.main import build_stack
 from tpushare.k8s.builders import make_node, make_pod
@@ -22,6 +24,7 @@ def _filter_once(pred, api, pod_doc, node_names):
     return (time.perf_counter() - t0), result
 
 
+@pytest.mark.perf
 def test_filter_latency_flat_as_cluster_fills():
     api = FakeApiServer()
     nodes = 64
@@ -107,6 +110,7 @@ def test_ledger_incremental_matches_recompute():
         controller.stop()
 
 
+@pytest.mark.perf
 def test_fleet_scale_filter_prioritize_256_nodes():
     """A 256-node fleet: the full webhook scan (filter all + prioritize
     survivors) stays in interactive territory — the per-node cost is a

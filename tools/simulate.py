@@ -188,6 +188,7 @@ def simulate(scenario: dict) -> dict:
             verdict = _schedule_one(client, pod, candidates)
             latencies.append((time.perf_counter() - t0) * 1e3)
             verdict["pod"] = pod.name
+            verdict["namespace"] = pod.namespace
             if verdict.pop("state") == "bound":
                 placements.append(verdict)
             elif verdict.get("pending"):
@@ -204,12 +205,15 @@ def simulate(scenario: dict) -> dict:
         for bucket in (held, unschedulable):
             for verdict in bucket[:]:
                 try:
-                    final = api.get_pod("default", verdict["pod"])
+                    final = api.get_pod(verdict.get("namespace", "default"),
+                                        verdict["pod"])
                 except NotFoundError:
                     continue  # reaped (e.g. below-quorum gang cleanup)
                 if final.node_name:
                     bucket.remove(verdict)
                     placements.append({"pod": verdict["pod"],
+                                       "namespace": verdict.get(
+                                           "namespace", "default"),
                                        "node": final.node_name,
                                        "via": "gang commit"})
         inspect_doc = client.get("/tpushare-scheduler/inspect")
@@ -415,6 +419,12 @@ def defrag(inspect_doc: dict, drain: str | None = None) -> dict:
                     "node": node["name"], "usedHBM": pod["usedHBM"],
                     "chips": len(pod["chipIds"]),
                     "chip_ids": tuple(sorted(pod["chipIds"])),
+                    # First matching chip's capacity stands in for all of
+                    # the pod's chips. On a heterogeneous-HBM node a
+                    # multi-chip pod's other chips may differ — fine for
+                    # this advisory's packing math (whole-chip pods ignore
+                    # it; fractional pods are single-chip), but NOT valid
+                    # as a per-chip ANN_HBM_CHIP rebuild source.
                     "chip_hbm": next(
                         (c["totalHBM"] for c in node["chips"]
                          if c["id"] in pod["chipIds"]), 0),

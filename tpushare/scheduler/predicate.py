@@ -80,18 +80,31 @@ class DemandTracker:
 
     def snapshot(self) -> tuple[int, int, int]:
         """(pods, total HBM GiB, total chips) still unplaceable; prunes
-        expired and no-longer-pending entries as a side effect."""
+        expired and no-longer-pending entries as a side effect.
+
+        ``pod_lookup`` runs OUTSIDE the tracker lock, so the filter path's
+        ``record_unplaceable``/``clear`` never block behind a probe. The
+        metrics scrape lock is still held by our caller for the whole
+        snapshot — ``pod_lookup`` MUST stay a local-store read (the wired
+        informer lookup is); wiring the networked ApiClient here would
+        stall every ``/metrics`` scrape. Copy, probe unlocked, re-acquire
+        to delete — a pod re-recorded between the probe and the delete
+        wins via the ``seen`` timestamp check."""
         now = time.monotonic()
         with self._lock:
-            dead = [
-                uid
-                for uid, (_, _, ns_name, seen) in self._entries.items()
-                if now - seen > self.ttl
-                or (self.pod_lookup is not None
-                    and not self._still_pending(uid, ns_name))
-            ]
-            for uid in dead:
-                del self._entries[uid]
+            entries = dict(self._entries)
+        dead = {
+            uid: seen
+            for uid, (_, _, ns_name, seen) in entries.items()
+            if now - seen > self.ttl
+            or (self.pod_lookup is not None
+                and not self._still_pending(uid, ns_name))
+        }
+        with self._lock:
+            for uid, seen in dead.items():
+                cur = self._entries.get(uid)
+                if cur is not None and cur[3] == seen:
+                    del self._entries[uid]
             pods = len(self._entries)
             hbm = sum(e[0] for e in self._entries.values())
             chips = sum(e[1] for e in self._entries.values())

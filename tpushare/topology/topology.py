@@ -17,24 +17,10 @@ from dataclasses import dataclass
 from functools import lru_cache
 from itertools import combinations
 
-#: Per-chip HBM GiB by generation (public specs; used by discovery when the
-#: runtime does not report memory directly).
-CHIP_HBM_GIB = {
-    "v2": 8,
-    "v3": 16,
-    "v4": 32,
-    "v5e": 16,
-    "v5p": 95,
-    "v6e": 32,
-}
-
-#: Chips per host by generation (typical GKE machine shapes).
-DEFAULT_HOST_TOPOLOGY = {
-    "v4": "2x2x1",
-    "v5e": "2x2x1",
-    "v5p": "2x2x1",
-    "v6e": "2x2x1",
-}
+# Chip facts (per-chip HBM, chips per host, default host ICI shapes) live in
+# exactly one place: ``tpushare.deviceplugin.discovery`` (HBM_GIB_BY_TYPE,
+# CHIPS_PER_HOST, HOST_TOPOLOGY). This module is pure geometry — it consumes
+# topology *specs* and never guesses hardware facts of its own.
 
 
 def parse_topology(spec: str) -> tuple[int, ...]:
