@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: test chipcheck native bench bench-workload all
+.PHONY: test chipcheck cochipcheck native bench bench-workload all
 
 # CPU test suite (virtual 8-device mesh; kernels in interpreter mode).
 test:
@@ -11,6 +11,14 @@ test:
 # breakage; this can (VERDICT round-1 weakness 3).
 chipcheck:
 	python chipcheck.py
+
+# Co-tenancy proof — REQUIRES real TPU hardware. Two tenant processes
+# (train + decode) under injected HBM grants, a mid-flight overcommit
+# that must fail cleanly, the fraction-cap enforcement probe, and the
+# max_batch_for_grant estimator under real HBM pressure. Writes
+# COTENANCY_r04.json (VERDICT round-3 weakness 1).
+cochipcheck:
+	python cochipcheck.py
 
 # Native discovery shim (libtpudisc.so).
 native:

@@ -8,8 +8,20 @@ maps the device plugin's injected env onto the knobs JAX/libtpu honor:
 
 * ``TPU_VISIBLE_CHIPS`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` — restrict the
   process to its granted chip(s);
-* ``XLA_PYTHON_CLIENT_MEM_FRACTION`` — cap the premapped HBM pool to the
-  granted fraction, which is what makes co-tenancy of one chip safe.
+* ``XLA_PYTHON_CLIENT_MEM_FRACTION`` — request a premapped-HBM cap at the
+  granted fraction.
+
+**What is actually enforced** (measured on silicon — ``cochipcheck.py``,
+``COTENANCY_r04.json``): the fraction cap is advisory on TPU PJRT
+clients — a tenant allocating past its grant is NOT stopped by the
+runtime until it exceeds the *chip*, where it fails cleanly (a
+compile/alloc error confined to the offending process). Co-tenancy
+safety therefore rests on (1) the scheduler ledger, which never
+overcommits a chip's HBM across grants, and (2) cooperative sizing —
+``serving.max_batch_for_grant`` and friends — inside each tenant.
+Nothing in tpushare assumes the fraction env is enforced; it is set
+because runtimes that DO premap honor it, and because it documents the
+grant to the process itself.
 
 Call :func:`configure` BEFORE importing jax (it only sets env vars).
 """
