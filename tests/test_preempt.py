@@ -31,13 +31,14 @@ def _stack(api: FakeApiServer):
 
 
 def _resident(cache, name, node, chip_ids, hbm, priority=0, uid=None,
-              annotations=None):
+              annotations=None, labels=None):
     """Record an already-placed pod in the ledger, bypassing bind (tests
     control exact chip placement)."""
     pod = Pod(make_pod(name, hbm=hbm if len(chip_ids) == 1 else 0,
                        chips=0 if len(chip_ids) == 1 else len(chip_ids),
                        node_name=node, uid=uid or f"uid-{name}",
-                       priority=priority, annotations=annotations))
+                       priority=priority, annotations=annotations,
+                       labels=labels))
     pod = podutils.updated_pod_annotation_spec(pod, chip_ids, hbm, 16)
     assert cache.add_or_update_pod(pod)
     return pod
@@ -540,3 +541,150 @@ class TestPreemptHTTP:
                 assert e.code == 404
         finally:
             server.shutdown()
+
+
+class TestPDBRecount:
+    """NumPDBViolations is recomputed for the victim sets THIS handler
+    authors (round-3 verdict #4): gang-sibling expansion and ledger
+    victims change the set, so echoing the scheduler's count would bias
+    upstream ``pickOneNodeForPreemption`` toward nodes where our plan
+    actually disrupts more PDB-protected pods."""
+
+    GANG = {const.ANN_POD_GROUP: "ring", const.ANN_POD_GROUP_MIN: "2"}
+
+    @staticmethod
+    def _pdb(api, name, match_labels, allowed, namespace="default"):
+        return api.create_pdb({
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {"selector": {"matchLabels": dict(match_labels)}},
+            "status": {"disruptionsAllowed": allowed},
+        })
+
+    def _stack_with_pdbs(self, api):
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        return cache, Preempt(cache, pdb_lister=api.list_pdbs)
+
+    def test_pdb_on_gang_sibling_raises_count_and_flips_choice(self, api):
+        """The directive's exact scenario: on n1 the cheapest victim is
+        a gang member whose EXPANDED sibling is PDB-protected with no
+        disruptions left; on n2 a lone unprotected pod. The recount
+        reports 1 vs 0 — upstream minimizes violations, so the
+        scheduler now picks n2; the echoed counts (0, 0) would have
+        hidden the difference entirely."""
+        api.create_node(make_node("n1"))
+        api.create_node(make_node("n2"))
+        cache, handler = self._stack_with_pdbs(api)
+        # n1: two-member gang; the sibling carries the protected label.
+        _resident(cache, "m0", "n1", [0], 16, annotations=self.GANG)
+        _resident(cache, "m1", "n1", [1], 16, annotations=self.GANG,
+                  labels={"app": "protected-serve"})
+        _resident(cache, "hi2", "n1", [2], 16, priority=1000)
+        _resident(cache, "hi3", "n1", [3], 16, priority=1000)
+        # n2: a lone, unprotected victim.
+        _resident(cache, "lone", "n2", [0], 16)
+        _resident(cache, "hj1", "n2", [1], 16, priority=1000)
+        _resident(cache, "hj2", "n2", [2], 16, priority=1000)
+        _resident(cache, "hj3", "n2", [3], 16, priority=1000)
+        self._pdb(api, "serve-pdb", {"app": "protected-serve"}, allowed=0)
+
+        result = handler.handle(_args(
+            make_pod("p", hbm=16, priority=100), {"n1": [], "n2": []}))
+        # Gang closure names both members on n1.
+        assert sorted(result.node_victims["n1"]) == ["uid-m0", "uid-m1"]
+        assert result.node_victims["n2"] == ["uid-lone"]
+        # The recount sees the protected sibling; the wire echo (0) never
+        # would have — and the difference flips upstream's node choice.
+        assert result.pdb_violations["n1"] == 1
+        assert result.pdb_violations["n2"] == 0
+        pick = min(result.node_victims,
+                   key=lambda n: result.pdb_violations[n])
+        assert pick == "n2"
+
+    def test_budget_consumption_across_victims(self, api):
+        """Upstream semantics: each victim consumes one allowed
+        disruption; with one disruption allowed, the second matched
+        victim is the violation."""
+        api.create_node(make_node("n1"))
+        cache, handler = self._stack_with_pdbs(api)
+        _resident(cache, "a", "n1", [0], 16, annotations=self.GANG,
+                  labels={"tier": "web"})
+        _resident(cache, "b", "n1", [1], 16, annotations=self.GANG,
+                  labels={"tier": "web"})
+        _resident(cache, "hi2", "n1", [2], 16, priority=1000)
+        _resident(cache, "hi3", "n1", [3], 16, priority=1000)
+        self._pdb(api, "web-pdb", {"tier": "web"}, allowed=1)
+        result = handler.handle(_args(
+            make_pod("p", hbm=16, priority=100), {"n1": []}))
+        assert sorted(result.node_victims["n1"]) == ["uid-a", "uid-b"]
+        assert result.pdb_violations["n1"] == 1
+
+    def test_namespace_scoping_and_expressions(self, api):
+        """A PDB only guards its own namespace; matchExpressions are
+        honored (fail-closed on unknown operators)."""
+        from tpushare.api.objects import PodDisruptionBudget
+        pdb = PodDisruptionBudget({
+            "metadata": {"name": "x", "namespace": "prod"},
+            "spec": {"selector": {
+                "matchExpressions": [
+                    {"key": "tier", "operator": "In",
+                     "values": ["web", "api"]}]}},
+            "status": {"disruptionsAllowed": 0}})
+        web_prod = Pod(make_pod("w", hbm=1, namespace="prod",
+                                labels={"tier": "web"}))
+        web_dev = Pod(make_pod("w2", hbm=1, namespace="default",
+                               labels={"tier": "web"}))
+        db_prod = Pod(make_pod("d", hbm=1, namespace="prod",
+                               labels={"tier": "db"}))
+        assert pdb.matches(web_prod)
+        assert not pdb.matches(web_dev)   # other namespace
+        assert not pdb.matches(db_prod)   # not selected
+        weird = PodDisruptionBudget({
+            "metadata": {"name": "y", "namespace": "prod"},
+            "spec": {"selector": {"matchExpressions": [
+                {"key": "tier", "operator": "Gt", "values": ["1"]}]}},
+            "status": {"disruptionsAllowed": 0}})
+        assert not weird.matches(web_prod)  # unknown op: fail closed
+
+    def test_no_lister_echoes_scheduler_count(self, api):
+        """Without a PDB view the handler keeps the pre-round-4 echo
+        (never invents zeros it cannot justify)."""
+        api.create_node(make_node("n1"))
+        cache, handler = _stack(api)  # no pdb_lister
+        _resident(cache, "v", "n1", [0], 16)
+        for c in (1, 2, 3):
+            _resident(cache, f"hi{c}", "n1", [c], 16, priority=1000)
+        args = ExtenderPreemptionArgs.from_json({
+            "Pod": make_pod("p", hbm=16, priority=100),
+            "NodeNameToMetaVictims": {
+                "n1": {"Pods": [{"UID": "uid-v"}],
+                       "NumPDBViolations": 7}}})
+        result = handler.handle(args)
+        assert result.pdb_violations["n1"] == 7
+
+    def test_disrupted_pods_skipped(self, api):
+        """A victim already in status.disruptedPods (eviction in flight)
+        neither consumes budget nor counts as a violation — upstream
+        filterPodsWithPDBViolation semantics."""
+        api.create_node(make_node("n1"))
+        cache, handler = self._stack_with_pdbs(api)
+        _resident(cache, "a", "n1", [0], 16, annotations=self.GANG,
+                  labels={"tier": "web"})
+        _resident(cache, "b", "n1", [1], 16, annotations=self.GANG,
+                  labels={"tier": "web"})
+        _resident(cache, "hi2", "n1", [2], 16, priority=1000)
+        _resident(cache, "hi3", "n1", [3], 16, priority=1000)
+        api.create_pdb({
+            "metadata": {"name": "web-pdb", "namespace": "default"},
+            "spec": {"selector": {"matchLabels": {"tier": "web"}}},
+            # a's eviction is already in flight (disruptedPods), so it
+            # is skipped; b consumes the one allowed disruption — zero
+            # NEW violations. Counting a would burn the budget and
+            # wrongly report b as a violation.
+            "status": {"disruptionsAllowed": 1,
+                       "disruptedPods": {"a": "2026-07-30T00:00:00Z"}}})
+        result = handler.handle(_args(
+            make_pod("p", hbm=16, priority=100), {"n1": []}))
+        assert sorted(result.node_victims["n1"]) == ["uid-a", "uid-b"]
+        assert result.pdb_violations["n1"] == 0

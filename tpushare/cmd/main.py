@@ -103,7 +103,11 @@ def build_stack(client, is_leader=None) -> Stack:
                   pod_lister=controller.hub.get_pod)
     inspect = Inspect(controller.cache, client.list_nodes,
                       gang_planner=gang)
-    preempt = Preempt(controller.cache)
+    # The PDB lister feeds the preempt verb's violation recount (the
+    # victim sets WE author differ from the scheduler's nominations, so
+    # its NumPDBViolations would be stale for them).
+    preempt = Preempt(controller.cache,
+                      pdb_lister=controller.hub.pdbs.list)
     admission = Admission(controller.cache,
                           node_lister=controller.hub.nodes.list)
     return Stack(controller, predicate, prioritize, binder, inspect,

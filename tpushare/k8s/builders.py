@@ -15,7 +15,8 @@ def make_pod(name: str, hbm: int = 0, chips: int = 0,
              namespace: str = "default", node_name: str = "",
              annotations: dict | None = None, phase: str = "Pending",
              uid: str | None = None, priority: int | None = None,
-             container_hbm: list[int] | None = None) -> dict:
+             container_hbm: list[int] | None = None,
+             labels: dict | None = None) -> dict:
     """``container_hbm`` builds a multi-container pod (one container per
     entry); otherwise a single container carries the whole request."""
     if container_hbm is not None:
@@ -34,7 +35,8 @@ def make_pod(name: str, hbm: int = 0, chips: int = 0,
         "apiVersion": "v1",
         "kind": "Pod",
         "metadata": {"name": name, "namespace": namespace,
-                     "annotations": dict(annotations or {})},
+                     "annotations": dict(annotations or {}),
+                     **({"labels": dict(labels)} if labels else {})},
         "spec": {"containers": containers},
         "status": {"phase": phase},
     }

@@ -23,7 +23,7 @@ import urllib.error
 import urllib.request
 from urllib.parse import quote
 
-from tpushare.api.objects import Node, Pod
+from tpushare.api.objects import Node, Pod, PodDisruptionBudget
 from tpushare.k8s.errors import ApiError, ConflictError, NotFoundError
 
 log = logging.getLogger(__name__)
@@ -222,6 +222,13 @@ class ApiClient:
         doc = self._request("GET", "/api/v1/nodes")
         return [Node(item) for item in doc.get("items", [])]
 
+    def list_pdbs(self) -> list[PodDisruptionBudget]:
+        """All PodDisruptionBudgets (policy/v1) — the preempt verb's
+        violation recount input. Needs a ``poddisruptionbudgets``
+        list/watch RBAC rule (config/tpushare-schd-extender.yaml)."""
+        doc = self._request("GET", "/apis/policy/v1/poddisruptionbudgets")
+        return [PodDisruptionBudget(item) for item in doc.get("items", [])]
+
     def update_node(self, node: Node) -> Node:
         """PUT the node object itself — metadata (annotations) changes do
         not persist through the /status subresource."""
@@ -293,7 +300,9 @@ class ApiClient:
         stop = threading.Event()
         threads = []
         for kind, path in (("Pod", "/api/v1/pods"),
-                           ("Node", "/api/v1/nodes")):
+                           ("Node", "/api/v1/nodes"),
+                           ("PodDisruptionBudget",
+                            "/apis/policy/v1/poddisruptionbudgets")):
             t = threading.Thread(
                 target=self._watch_loop, args=(kind, path, q, stop),
                 name=f"tpushare-watch-{kind.lower()}", daemon=True)

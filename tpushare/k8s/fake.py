@@ -16,7 +16,7 @@ import itertools
 import queue
 import threading
 
-from tpushare.api.objects import Node, Pod
+from tpushare.api.objects import Node, Pod, PodDisruptionBudget
 from tpushare.k8s.errors import ConflictError, NotFoundError
 
 
@@ -41,6 +41,7 @@ class FakeApiServer:
         self._pods: dict[str, dict] = {}   # "ns/name" -> raw pod
         self._nodes: dict[str, dict] = {}  # name -> raw node
         self._leases: dict[str, dict] = {}  # "ns/name" -> raw lease
+        self._pdbs: dict[str, dict] = {}   # "ns/name" -> raw pdb
         self._rv = itertools.count(1)
         self._watchers: list[queue.Queue] = []
         self._uid = itertools.count(1)
@@ -242,3 +243,43 @@ class FakeApiServer:
             node = self._nodes.pop(name, None)
             if node is not None:
                 self._notify("Node", "DELETED", node)
+
+    # ------------------------------------------------------------------ #
+    # PodDisruptionBudgets (policy/v1)
+    # ------------------------------------------------------------------ #
+
+    def create_pdb(self, raw: dict) -> PodDisruptionBudget:
+        with self._lock:
+            pdb = _dcopy(raw)
+            meta = pdb.setdefault("metadata", {})
+            meta.setdefault("namespace", "default")
+            meta.setdefault("uid", f"uid-{next(self._uid)}")
+            key = f"{meta['namespace']}/{meta['name']}"
+            if key in self._pdbs:
+                raise ConflictError(reason=f"pdb {key} already exists")
+            self._bump(pdb)
+            self._pdbs[key] = pdb
+            self._notify("PodDisruptionBudget", "ADDED", pdb)
+            return PodDisruptionBudget(_dcopy(pdb))
+
+    def update_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        with self._lock:
+            key = f"{pdb.namespace}/{pdb.name}"
+            if key not in self._pdbs:
+                raise NotFoundError(reason=f"pdb {key} not found")
+            updated = _dcopy(pdb.raw)
+            self._bump(updated)
+            self._pdbs[key] = updated
+            self._notify("PodDisruptionBudget", "MODIFIED", updated)
+            return PodDisruptionBudget(_dcopy(updated))
+
+    def delete_pdb(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pdb = self._pdbs.pop(f"{namespace}/{name}", None)
+            if pdb is not None:
+                self._notify("PodDisruptionBudget", "DELETED", pdb)
+
+    def list_pdbs(self) -> list[PodDisruptionBudget]:
+        with self._lock:
+            return [PodDisruptionBudget(_dcopy(p))
+                    for p in self._pdbs.values()]

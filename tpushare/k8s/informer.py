@@ -13,11 +13,12 @@ from __future__ import annotations
 import logging
 import threading
 
-from tpushare.api.objects import Node, Pod
+from tpushare.api.objects import Node, Pod, PodDisruptionBudget
 
 log = logging.getLogger(__name__)
 
-_WRAPPERS = {"Pod": Pod, "Node": Node}
+_WRAPPERS = {"Pod": Pod, "Node": Node,
+             "PodDisruptionBudget": PodDisruptionBudget}
 
 
 class Store:
@@ -29,8 +30,8 @@ class Store:
 
     @staticmethod
     def key_of(obj) -> str:
-        if isinstance(obj, Pod):
-            return obj.key()
+        if isinstance(obj, (Pod, PodDisruptionBudget)):
+            return f"{obj.namespace}/{obj.name}"
         return obj.name
 
     def replace(self, objs) -> None:
@@ -67,7 +68,9 @@ class InformerHub:
         self.client = client
         self.pods = Store()
         self.nodes = Store()
-        self._handlers: dict[str, list] = {"Pod": [], "Node": []}
+        self.pdbs = Store()
+        self._handlers: dict[str, list] = {"Pod": [], "Node": [],
+                                           "PodDisruptionBudget": []}
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -89,6 +92,17 @@ class InformerHub:
         self._watch_queue = self.client.watch()
         self.pods.replace(self.client.list_pods())
         self.nodes.replace(self.client.list_nodes())
+        # PDBs are optional on the client surface (the preempt verb's
+        # violation recount needs them; everything else doesn't) —
+        # absence just means an empty lister.
+        list_pdbs = getattr(self.client, "list_pdbs", None)
+        if list_pdbs is not None:
+            try:
+                self.pdbs.replace(list_pdbs())
+            except Exception:  # pragma: no cover - RBAC may deny policy/v1
+                log.warning("PDB list failed; preempt PDB recount will "
+                            "see no budgets until the watch recovers",
+                            exc_info=True)
         self._synced.set()
         self._thread = threading.Thread(
             target=self._run, name="tpushare-informer", daemon=True)
@@ -116,7 +130,8 @@ class InformerHub:
                 wrapper = _WRAPPERS.get(kind)
                 if wrapper is None:
                     continue
-                store = self.pods if kind == "Pod" else self.nodes
+                store = {"Pod": self.pods, "Node": self.nodes,
+                         "PodDisruptionBudget": self.pdbs}[kind]
                 if event_type == "RELIST":
                     # Watch stream reconnected: diff the fresh LIST against
                     # the store and synthesize the events missed in the gap.

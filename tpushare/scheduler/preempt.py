@@ -65,8 +65,13 @@ log = logging.getLogger(__name__)
 class Preempt:
     name = "tpushare-preempt"
 
-    def __init__(self, cache: SchedulerCache):
+    def __init__(self, cache: SchedulerCache, pdb_lister=None):
         self.cache = cache
+        #: Zero-arg callable returning the current PodDisruptionBudgets
+        #: (wired to the informer's pdbs store). None = no PDB view:
+        #: the handler then echoes the scheduler's violation counts
+        #: (the pre-round-4 behavior) instead of recounting.
+        self.pdb_lister = pdb_lister
 
     # ------------------------------------------------------------------ #
     # Per-chip planning
@@ -327,6 +332,47 @@ class Preempt:
                     out.append(member)
         return out
 
+    def count_pdb_violations(self, victims: list[Pod]) -> int | None:
+        """How many of ``victims`` would violate a PodDisruptionBudget —
+        recomputed for the victim set THIS handler authored, not echoed
+        from the scheduler's (we replace and enlarge its set: gang
+        siblings, chip-ledger victims). Upstream
+        ``pickOneNodeForPreemption`` minimizes this number when picking
+        the node, so an undercount would steer eviction toward nodes
+        where the real blast radius is larger (round-3 verdict, #4).
+
+        Semantics follow upstream ``filterPodsWithPDBViolation``: each
+        victim consumes one allowed disruption from every budget that
+        selects it; a victim that hits ANY budget with no disruptions
+        left counts as one violation; a victim already listed in a
+        budget's ``status.disruptedPods`` (its eviction is in flight)
+        neither consumes that budget nor violates it. Returns None when
+        no PDB view is wired (caller falls back to echoing)."""
+        if self.pdb_lister is None:
+            return None
+        try:
+            pdbs = list(self.pdb_lister())
+        except Exception:  # pragma: no cover - lister trouble
+            log.warning("PDB lister failed; echoing scheduler counts",
+                        exc_info=True)
+            return None
+        remaining = [max(p.disruptions_allowed, 0) for p in pdbs]
+        violations = 0
+        for victim in victims:
+            hit = False
+            for i, pdb in enumerate(pdbs):
+                if not pdb.matches(victim):
+                    continue
+                if victim.name in pdb.disrupted_pods:
+                    continue  # already being disrupted: free either way
+                if remaining[i] > 0:
+                    remaining[i] -= 1
+                else:
+                    hit = True
+            if hit:
+                violations += 1
+        return violations
+
     @staticmethod
     def _dedup(pods: list[Pod]) -> list[Pod]:
         """A multi-chip victim shows up once per chip it pins; the
@@ -373,7 +419,23 @@ class Preempt:
             ours = [p.uid for p in plan]
             result.node_victims[name] = ours + [
                 u for u in nominated if u not in set(ours)]
-            result.pdb_violations[name] = victims.num_pdb_violations
+            # PDB violations for the set we RETURN (ours + nominated),
+            # not the set the scheduler sent. Nominated-only victims are
+            # resolved against this node's chip ledger; a CPU/memory
+            # victim outside the TPU ledger has no Pod object here to
+            # label-match, so it goes uncounted — the union rarely adds
+            # such pods (they were nominated FOR this pod's resources).
+            final_pods = list(plan)
+            if len(ours) < len(result.node_victims[name]):
+                by_uid = {p.uid: p
+                          for chip in info.chips.values()
+                          for p in chip.snapshot_pods()}
+                final_pods += [by_uid[u] for u in nominated
+                               if u not in set(ours) and u in by_uid]
+            recount = self.count_pdb_violations(final_pods)
+            result.pdb_violations[name] = (
+                victims.num_pdb_violations if recount is None
+                else recount)
         if result.node_victims:
             from tpushare.routes import metrics
             metrics.safe_inc(
