@@ -103,6 +103,7 @@ def test_randomized_churn_soak(api, seed):
         stack.binder, stack.inspect)
     controller.start(workers=4)
     bound: list[str] = []
+    binds: list[str] = []  # every successful bind, never popped
     seq = 0
     audits = 0
     def one_op():
@@ -138,6 +139,7 @@ def test_randomized_churn_soak(api, seed):
                 pod_uid=pod.uid, node=best))
             if not r.error:
                 bound.append(pod.name)
+                binds.append(pod.name)
         elif op < 0.78:
             # -- completion frees HBM --------------------------------- #
             name = bound.pop(rng.randrange(len(bound)))
@@ -191,4 +193,8 @@ def test_randomized_churn_soak(api, seed):
         controller.stop()
     assert audits >= 8
     # The stream must have actually exercised the interesting regimes.
-    assert seq > 150 and len(bound) > 0
+    # Count binds over the WHOLE run, not the still-bound set at the
+    # final tick: the op stream couples to bind timing (`or not bound`),
+    # so under heavy CI load a trajectory can legitimately end with
+    # every bound pod already completed/deleted.
+    assert seq > 150 and len(binds) > 50
