@@ -191,3 +191,37 @@ class TestSchedulerCache:
         cache.remove_pod(placed)
         assert not cache.known_pod(placed.uid)
         assert cache.get_node_info("v5e-node-0").get_available_hbm()[0] == 16
+
+
+class TestSpreadChipPick:
+    """The spread policy reaches the CHIP picker too (round-4): a pod
+    whose effective scoring is spread lands on the EMPTIEST fitting
+    chip — winning the emptiest node and then bin-packing onto its
+    fullest chip would defeat the policy."""
+
+    def _warm(self, api):
+        node = api.create_node(make_node("n", chip_hbm=[16, 16, 16, 16]))
+        info = NodeInfo(node)
+        p0 = Pod(make_pod("warm", hbm=10, node_name="n", uid="u0"))
+        p0 = podutils.updated_pod_annotation_spec(p0, [2], 10, 16)
+        info.add_or_update_pod(p0)
+        return info  # free = [16, 16, 6, 16]
+
+    def test_spread_annotation_picks_emptiest(self, api, monkeypatch):
+        monkeypatch.delenv("TPUSHARE_SCORING", raising=False)
+        info = self._warm(api)
+        pod = Pod(make_pod("p", hbm=4,
+                           annotations={const.ANN_SCORING: "spread"}))
+        assert info.pick_chips(pod) != [2]
+        # emptiest chips tie at 16; the neighbor tie-break decides among
+        # them, but never the 6-GiB chip binpack would take
+        assert info.pick_chips(Pod(make_pod("q", hbm=4))) == [2]
+
+    def test_spread_fleet_default_via_env(self, api, monkeypatch):
+        info = self._warm(api)
+        monkeypatch.setenv("TPUSHARE_SCORING", "spread")
+        assert info.pick_chips(Pod(make_pod("p", hbm=4))) != [2]
+        # per-pod binpack override beats the spread fleet default
+        pod = Pod(make_pod("q", hbm=4,
+                           annotations={const.ANN_SCORING: "binpack"}))
+        assert info.pick_chips(pod) == [2]

@@ -29,13 +29,18 @@ log = logging.getLogger(__name__)
 
 
 class SchedulerCache:
-    def __init__(self, node_getter, pod_lister):
+    def __init__(self, node_getter, pod_lister,
+                 default_scoring: str | None = None):
         """``node_getter(name) -> Node | None`` and
         ``pod_lister() -> list[Pod]`` abstract the informer listers the
         reference wired in (cache.go:30-38); tests pass a fake client's
-        bound methods."""
+        bound methods. ``default_scoring`` is the fleet scoring policy
+        handed to every ledger's chip picker — the SAME value the
+        prioritize verb uses, so cross-node and within-node placement
+        can never disagree on a pod's policy."""
         self._node_getter = node_getter
         self._pod_lister = pod_lister
+        self._default_scoring = default_scoring
         self._nodes: dict[str, NodeInfo] = {}
         self._known_pods: dict[str, Pod] = {}  # uid -> annotated pod
         #: name -> deletion epoch; bumped on every eviction so a lookup
@@ -108,7 +113,7 @@ class SchedulerCache:
                                 (info.chips[i] for i in sorted(info.chips))] != fresh_caps:
                 if info is not None:
                     log.info("rebuilding ledger for node %s (chip set changed)", name)
-                info = NodeInfo(node)
+                info = NodeInfo(node, self._default_scoring)
                 for pod in self._known_pods.values():
                     if pod.node_name == name and not podutils.is_complete_pod(pod):
                         info.add_or_update_pod(pod)

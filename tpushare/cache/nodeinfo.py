@@ -40,9 +40,12 @@ class AllocationError(Exception):
 class NodeInfo:
     """Aggregated allocation state of one TPU node."""
 
-    def __init__(self, node: Node):
+    def __init__(self, node: Node, default_scoring: str | None = None):
         self.name = node.name
         self.node = node
+        #: Fleet scoring default for the chip picker; None -> the env
+        #: fallback inside podutils.effective_scoring (standalone use).
+        self.default_scoring = default_scoring
         caps = nodeutils.get_chip_capacities(node)
         self.chips: dict[int, ChipInfo] = {
             i: ChipInfo(i, cap) for i, cap in enumerate(caps)
@@ -168,7 +171,11 @@ class NodeInfo:
         HBM pods: tightest fit — the chip with the *least* free HBM still
         ≥ the request (binpack maximizes whole-free chips, exactly the
         reference's policy); among equal fits, prefer the chip with the
-        fewest free ICI neighbors so compact regions stay whole.
+        fewest free ICI neighbors so compact regions stay whole. Pods
+        whose effective scoring is ``spread`` invert the fit — the
+        EMPTIEST fitting chip wins (fewest co-tenants for
+        latency-sensitive decode) — while keeping the same neighbor
+        tie-break so pristine compact regions are still cracked last.
 
         Chip pods: ICI-compact set of fully-free chips.
         """
@@ -195,10 +202,12 @@ class NodeInfo:
                 )
             fully_free = {i for i, v in avail.items()
                           if v >= self.chips[i].total_hbm}
+            spread = podutils.effective_scoring(
+                pod, default=self.default_scoring) == "spread"
             best = min(
                 sorted(fits),
                 key=lambda i: (
-                    fits[i],
+                    -fits[i] if spread else fits[i],
                     self.topology.free_neighbor_count(i, fully_free),
                     i,
                 ),

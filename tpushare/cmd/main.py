@@ -82,7 +82,14 @@ def build_stack(client, is_leader=None) -> Stack:
     retries so a demoted leader stops POSTing member bindings (its /bind
     route is already follower-gated by the HTTP layer), and the
     controller's gang reaper so only one replica issues deletions."""
-    controller = Controller(client, is_leader=is_leader)
+    # TPUSHARE_SCORING=spread flips the fit scoring for fleets that
+    # prefer fewer co-tenants per chip over packing density. ONE
+    # env read feeds both the prioritize verb and (via the controller's
+    # cache) every ledger's chip picker — the two granularities must
+    # never disagree on the fleet default.
+    scoring = os.environ.get("TPUSHARE_SCORING", "binpack")
+    controller = Controller(client, is_leader=is_leader,
+                            default_scoring=scoring)
     # Quorum pre-checks enumerate nodes from the informer store — no
     # apiserver LIST on the bind path.
     gang = GangPlanner(controller.cache, client,
@@ -94,11 +101,8 @@ def build_stack(client, is_leader=None) -> Stack:
     # on every replica, not just the one that saw the passing filter.
     predicate = Predicate(controller.cache, demand=DemandTracker(
         pod_lookup=controller.hub.get_pod))
-    # TPUSHARE_SCORING=spread flips the fit scoring for fleets that
-    # prefer fewer co-tenants per chip over packing density.
     prioritize = Prioritize(
-        controller.cache, gang_planner=gang,
-        policy=os.environ.get("TPUSHARE_SCORING", "binpack"))
+        controller.cache, gang_planner=gang, policy=scoring)
     binder = Bind(controller.cache, client, gang_planner=gang,
                   pod_lister=controller.hub.get_pod)
     inspect = Inspect(controller.cache, client.list_nodes,

@@ -32,11 +32,15 @@ log = logging.getLogger(__name__)
 
 class Controller:
     def __init__(self, client, hub: InformerHub | None = None,
-                 is_leader=None):
+                 is_leader=None, default_scoring: str | None = None):
         self.client = client
         self.hub = hub or InformerHub(client)
         self.queue = RateLimitedQueue()
-        self.cache = SchedulerCache(self._get_node, self._list_pods)
+        # default_scoring flows to every ledger's chip picker so
+        # within-node placement agrees with the prioritize verb's fleet
+        # policy (build_stack passes the same env-derived value to both).
+        self.cache = SchedulerCache(self._get_node, self._list_pods,
+                                    default_scoring=default_scoring)
         #: ``() -> bool`` — gates apiserver WRITES this controller
         #: originates (today: the gang reaper). Reads/ledger upkeep run
         #: on every replica; deletes from N replicas would multiply.

@@ -33,6 +33,7 @@ the registered weight):
 from __future__ import annotations
 
 import logging
+import statistics
 
 from tpushare.api.extender import ExtenderArgs, HostPriority
 from tpushare.cache.cache import SchedulerCache
@@ -71,11 +72,11 @@ class Prioritize:
         when valid, else the fleet default — inference pods spread while
         trainers bin-pack in one fleet. Unknown values fall back to the
         default (the admission webhook rejects them at CREATE when
-        installed; without it, a typo must not break scoring)."""
+        installed; without it, a typo must not break scoring). Shares
+        :func:`podutils.effective_scoring` with the within-node chip
+        picker so both granularities agree on what a pod's policy is."""
         override = pod.annotations.get(const.ANN_SCORING, "")
-        if override in const.SCORING_POLICIES:
-            return override
-        if override:
+        if override and override not in const.SCORING_POLICIES:
             # debug, not warning: the scheduler re-runs prioritize every
             # cycle for a pending pod, and repeating the same complaint
             # for its whole lifetime is log spam (the webhook surfaces
@@ -83,7 +84,7 @@ class Prioritize:
             log.debug("pod %s/%s: ignoring unknown %s=%r",
                       pod.namespace, pod.name, const.ANN_SCORING,
                       override)
-        return self.policy
+        return podutils.effective_scoring(pod, default=self.policy)
 
     # ------------------------------------------------------------------ #
     # Per-node scoring
@@ -96,14 +97,28 @@ class Prioritize:
                 for i in avail if avail[i] >= req]
         if not fits:
             return 0
-        free, cap = min(fits)  # tightest chip on this node
-        waste = free - req
-        # binpack: waste == 0 -> 10; waste == full pristine chip -> 0.
-        # spread: inverted — the emptiest fitting chip wins.
-        fit = (waste / cap) if cap else 0.0
         if policy == "binpack":
-            fit = 1.0 - fit
-        score = round(MAX_SCORE * fit)
+            # Representative chip = the one the node-local picker
+            # (NodeInfo.pick_chips) will take: the tightest fit.
+            # waste == 0 -> 10; waste == full pristine chip -> 0.
+            free, cap = min(fits)
+            waste = free - req
+            fit = 1.0 - ((waste / cap) if cap else 0.0)
+            score = round(MAX_SCORE * fit)
+        else:
+            # Spread: primary signal is the EMPTIEST fitting chip — the
+            # chip the picker will actually take (a node with any
+            # pristine chip hosts this pod with zero co-tenants, no
+            # matter how full its other chips are). Nodes tie on that
+            # constantly (every node with a pristine chip), so overall
+            # node emptiness breaks the tie and fans load across hosts;
+            # int() rather than round() keeps the secondary term from
+            # erasing itself at the top of the scale.
+            best = max((f - req) / c for f, c in fits if c)
+            emptiness = statistics.fmean(
+                avail[i] / info.chips[i].total_hbm
+                for i in avail if info.chips[i].total_hbm)
+            score = int(MAX_SCORE * (0.8 * best + 0.2 * emptiness))
         if gang_nodes and info.name in gang_nodes and score < MAX_SCORE:
             score += 1  # consolidate gang slices onto fewer hosts
         return max(0, min(MAX_SCORE, score))

@@ -157,6 +157,24 @@ def get_pod_group(pod: Pod) -> tuple[str, int]:
     return group, max(minimum, 0)
 
 
+def effective_scoring(pod: Pod, default: str | None = None) -> str:
+    """The pod's effective scoring policy: its ``tpushare.io/scoring``
+    annotation when valid, else ``default`` (or the fleet default from
+    ``TPUSHARE_SCORING``, falling back to binpack). ONE definition used
+    by both the cross-node prioritize verb and the within-node chip
+    picker, so 'spread' means fewer co-tenants at BOTH granularities —
+    a spread pod that wins the emptiest node but then bin-packs onto
+    that node's fullest chip would defeat the policy's entire point."""
+    import os
+
+    override = pod.annotations.get(const.ANN_SCORING, "")
+    if override in const.SCORING_POLICIES:
+        return override
+    if default is None:
+        default = os.environ.get("TPUSHARE_SCORING", "binpack")
+    return default if default in const.SCORING_POLICIES else "binpack"
+
+
 def pod_used_hbm(pod: Pod) -> int:
     """HBM this pod currently holds against a chip's capacity.
 
