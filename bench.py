@@ -108,10 +108,10 @@ class _Fleet:
                                            hbm_per_chip=chip_hbm,
                                            topology=topology,
                                            tpu_type=tpu_type))
-        # build_stack reads the fleet scoring default from env (the
-        # production knob) — callers that need a non-default policy set
-        # TPUSHARE_SCORING for the fleet's LIFETIME (bench_inference),
-        # since the chip picker reads it live.
+        # build_stack reads the fleet scoring default from env ONCE at
+        # construction and pins it through the cache into every ledger
+        # — callers needing a non-default policy export TPUSHARE_SCORING
+        # before building the fleet (bench_inference does).
         self.stack = build_stack(self.api)
         self.stack.controller.start(workers=4)
         # Materialize every node's ledger up front: a prod fleet's
@@ -293,17 +293,36 @@ INF_ARRIVALS = 18
 INF_TTL = (3, 6)
 
 
+def _place_scored(client, pod, names) -> str | None:
+    """The scored wire dance every inference placement uses: filter ->
+    prioritize -> bind to the top score. Returns the node, or None when
+    no node passes (ONE definition — the churn and override loops must
+    not drift)."""
+    _, res = client.post("/tpushare-scheduler/filter",
+                         {"Pod": pod.raw, "NodeNames": names})
+    cands = res["NodeNames"]
+    if not cands:
+        return None
+    _, ranked = client.post("/tpushare-scheduler/prioritize",
+                            {"Pod": pod.raw, "NodeNames": cands})
+    best = max(ranked, key=lambda e: e["Score"])["Host"]
+    client.post("/tpushare-scheduler/bind", {
+        "PodName": pod.name, "PodNamespace": pod.namespace,
+        "PodUID": pod.uid, "Node": best})
+    return best
+
+
 def bench_inference(policy: str, rounds: int, seed: int = 7) -> dict:
     """Run the decode-co-tenant churn under ``policy``; returns the
     steady-state tenancy/headroom picture from the inspect API."""
-    from tpushare.k8s.builders import make_pod
-
     import os
 
     rng = random.Random(seed)
-    # The fleet default must stay in env for the RUN, not just stack
-    # construction: the within-node chip picker reads it live (the
-    # production semantic — cmd/main's env is process-lifetime).
+    # TPUSHARE_SCORING must be exported BEFORE _Fleet construction:
+    # build_stack reads it once and pins it through Controller ->
+    # SchedulerCache -> NodeInfo, so the prioritize verb and every
+    # ledger's chip picker share one value (flipping the env after
+    # construction changes nothing).
     saved = os.environ.get("TPUSHARE_SCORING")
     os.environ["TPUSHARE_SCORING"] = policy
     try:
@@ -340,19 +359,9 @@ def _bench_inference_body(policy: str, rounds: int, rng) -> dict:
             seq += 1
             pod = api.create_pod(make_pod(name,
                                           hbm=rng.choice([2, 4, 6])))
-            _, res = client.post("/tpushare-scheduler/filter",
-                                 {"Pod": pod.raw, "NodeNames": names})
-            cands = res["NodeNames"]
-            if not cands:
+            if _place_scored(client, pod, names) is None:
                 api.delete_pod("default", name)
                 continue
-            _, ranked = client.post("/tpushare-scheduler/prioritize",
-                                    {"Pod": pod.raw,
-                                     "NodeNames": cands})
-            best = max(ranked, key=lambda e: e["Score"])["Host"]
-            _, _b = client.post("/tpushare-scheduler/bind", {
-                "PodName": name, "PodNamespace": "default",
-                "PodUID": pod.uid, "Node": best})
             live.append({"name": name,
                          "expires": rnd + rng.randint(*INF_TTL)})
         if rnd < measure_from:
@@ -384,18 +393,8 @@ def _bench_inference_body(policy: str, rounds: int, rng) -> dict:
         pod = api.create_pod(make_pod(
             name, hbm=2,
             annotations={_const.ANN_SCORING: other}))
-        _, res = client.post("/tpushare-scheduler/filter",
-                             {"Pod": pod.raw, "NodeNames": names})
-        if not res["NodeNames"]:
-            continue
-        _, ranked = client.post("/tpushare-scheduler/prioritize",
-                                {"Pod": pod.raw,
-                                 "NodeNames": res["NodeNames"]})
-        best = max(ranked, key=lambda e: e["Score"])["Host"]
-        client.post("/tpushare-scheduler/bind", {
-            "PodName": name, "PodNamespace": "default",
-            "PodUID": pod.uid, "Node": best})
-        override_names.append(name)
+        if _place_scored(client, pod, names) is not None:
+            override_names.append(name)
     fleet.stack.controller.wait_idle(timeout=10)
     with urllib.request.urlopen(
             f"{fleet.base}/tpushare-scheduler/inspect") as r:

@@ -68,8 +68,16 @@ PEAK_BF16_TFLOPS = {
 MFU_FLOOR = 0.30
 
 #: Floor for the scale-up shape (``ModelConfig.large()``: d_model 2048
-#: fills the MXU tiles): measured 0.69 on v5e; 0.55 leaves noise margin.
-MFU_LARGE_FLOOR = 0.55
+#: fills the MXU tiles). A round-4 shape sweep on v5e (reproduce with
+#: ``--sweep``) showed ~0.70 is a PLATEAU, not a config accident:
+#: baseline b8/L2048 0.698, batch 16 0.650, L=4096 0.673, d_model 4096
+#: (0.95B params) 0.701. It is not a bandwidth wall — at these shapes
+#: every matmul's arithmetic intensity (~1e3 FLOP/B bf16) sits far
+#: above v5e's ~240 FLOP/B ridge point — the residual ~30% is backward
+#: -pass scheduling and kernel efficiency XLA owns. 0.62 locks the
+#: plateau in with margin for tunnel-timing noise (single-shot swings
+#: ~5%; the old 0.55 floor predated the sweep).
+MFU_LARGE_FLOOR = 0.62
 
 
 def _require_tpu(allow_cpu: bool) -> str:
@@ -340,13 +348,118 @@ def bench_decode(allow_cpu: bool) -> dict:
     }
 
 
+def bench_decode_continuous(allow_cpu: bool) -> dict:
+    """Continuous-batching slot server at MIXED sequence lengths: 8
+    slots admitted with prompts from 32 to 1024 tokens (each admission
+    a separate prefill — the mid-flight path), then chunked decode
+    with every slot at a DIFFERENT position. The per-slot-position
+    decode is the capability ``generate``'s static batch lacks; this
+    measures what it costs."""
+    from tpushare.workload import model as M
+    from tpushare.workload import serving as S
+
+    cfg = dataclasses.replace(M.ModelConfig(), remat=False)
+    slots, chunk, max_len = 8, 64, 2048
+    prompt_lens = [32, 64, 128, 128, 256, 512, 768, 1024]
+    if allow_cpu:
+        cfg = M.ModelConfig().tiny()
+        slots, chunk, max_len = 2, 4, 32
+        prompt_lens = [4, 8]
+
+    key = jax.random.PRNGKey(0)
+    params = M.init_params(key, cfg)
+    state = S.init_server_state(cfg, slots, max_len)
+    for i, lp in enumerate(prompt_lens):
+        prompt = jax.random.randint(jax.random.fold_in(key, i), (lp,),
+                                    0, cfg.vocab_size)
+        state = S.admit(params, state, prompt, jnp.int32(i))
+
+    @jax.jit
+    def run(params, state):
+        st, emitted = S.serve_chunk(params, state, chunk)
+        return jnp.sum(emitted[-1]).astype(jnp.float32)
+
+    float(run(params, state))  # compile
+    t = _time_scalar_fn(run, params, state, iters=20, reps=3)
+    tokens_s = slots * chunk / t
+
+    # The honest baseline is static-batch DECODE-ONLY at the SAME cache
+    # length: every decode step reads the whole [slots, max_len] cache
+    # either way, so (a) the short-cache headline figure (max_len 256)
+    # would overstate the slot server's overhead ~10x, and (b) timing
+    # whole generate() would bill the baseline for cache init + prefill
+    # the slot-server side doesn't pay in its timed region. Prefill
+    # outside the clock; time a scan of shared-position decode steps.
+    static_len = min(128, max_len - chunk)
+    static_tokens = jax.random.randint(key, (slots, static_len), 0,
+                                       cfg.vocab_size)
+    base_cache = S.init_cache(cfg, slots, max_len)
+    logits0, base_cache = jax.jit(S.prefill)(params, static_tokens,
+                                             base_cache)
+
+    @jax.jit
+    def run_static(params, cache, logits):
+        def step(carry, _):
+            cache, logits, pos = carry
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits, cache = S.decode_step(params, cache, tok, pos)
+            return (cache, logits, pos + 1), None
+
+        (cache, logits, _), _ = jax.lax.scan(
+            step, (cache, logits, jnp.asarray(static_len)),
+            None, length=chunk)
+        return jnp.sum(jnp.argmax(logits, -1)).astype(jnp.float32)
+
+    float(run_static(params, base_cache, logits0))
+    ts = _time_scalar_fn(run_static, params, base_cache, logits0,
+                         iters=20, reps=3)
+    return {
+        "slots": slots, "chunk": chunk,
+        "prompt_lens": prompt_lens, "max_len": max_len,
+        "chunk_ms": round(t * 1e3, 2),
+        "decode_tokens_per_s": round(tokens_s),
+        "per_token_ms": round((t / chunk) * 1e3, 3),
+        "static_same_maxlen_tokens_per_s": round(slots * chunk / ts),
+        "admission_overhead_pct": round(100.0 * (t - ts) / ts, 1),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--gate", action="store_true",
                     help="enforce regression gates (nonzero exit)")
     ap.add_argument("--allow-cpu", action="store_true",
                     help="tiny smoke run off-chip (no gates, no claims)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="MFU shape sweep (batch/seq/width) around the "
+                         "large config — the measurement behind "
+                         "MFU_LARGE_FLOOR; on-chip, ~10 min, no gates")
     args = ap.parse_args()
+
+    if args.sweep:
+        kind = _require_tpu(args.allow_cpu)
+        _measure_rtt()
+        from tpushare.workload import model as M
+        base = dataclasses.replace(M.ModelConfig().large(), remat=False)
+        sweep = {}
+        for tag, cfg, batch in [
+            ("large_b8_l2048", base, 8),
+            ("large_b16", base, 16),
+            ("large_l4096_b4",
+             dataclasses.replace(base, max_seq_len=4096), 4),
+            ("xl_d4096_b8",
+             dataclasses.replace(base, d_model=4096, n_heads=32,
+                                 n_layers=4, d_ff=11264), 8),
+        ]:
+            r = bench_train(kind, args.allow_cpu, cfg=cfg, batch=batch,
+                            iters=6, sides=("flash",))
+            sweep[tag] = {"mfu": r["flash"]["mfu"],
+                          "tokens_per_s": r["flash"]["tokens_per_s"],
+                          "params": r["config"]["params"]}
+            print(f"  sweep[{tag}]: {sweep[tag]}", file=sys.stderr)
+        print(json.dumps({"metric": "mfu_shape_sweep", "device": kind,
+                          "sweep": sweep}))
+        return
 
     if args.allow_cpu:
         # The runtime image's sitecustomize force-registers the TPU
@@ -372,6 +485,9 @@ def main() -> None:
     print("serving decode:", file=sys.stderr)
     serving = bench_decode(args.allow_cpu)
     print(f"  {serving}", file=sys.stderr)
+    print("serving decode (continuous, mixed lengths):", file=sys.stderr)
+    continuous = bench_decode_continuous(args.allow_cpu)
+    print(f"  {continuous}", file=sys.stderr)
 
     flash_mfu = train["flash"]["mfu"]
     large_mfu = large["flash"]["mfu"]
@@ -403,6 +519,7 @@ def main() -> None:
         "train_step": train,
         "train_step_large": large,
         "serving_decode": serving,
+        "serving_continuous": continuous,
         "gates": gates,
     }
     print(json.dumps(doc))

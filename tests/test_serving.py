@@ -207,3 +207,104 @@ def test_max_batch_for_grant(setup):
     assert got > 0
     assert (S.cache_hbm_bytes(flagship, got, 2048)
             <= 8 * (1 << 30) * headroom)
+
+
+class TestContinuousAdmission:
+    """The slot server (continuous batching): requests admitted
+    MID-FLIGHT into recycled slots, per-slot positions, streams exact
+    vs solo generate — the capability generate's static batch lacks
+    (VERDICT round-3 #8)."""
+
+    def _solo(self, params, cfg, prompt, n_new, max_len):
+        out = S.generate(params, prompt[None, :], cfg, n_new=n_new,
+                         max_len=max_len)
+        return out[0, prompt.shape[0]:]
+
+    def test_two_slots_match_solo_generate(self, setup):
+        cfg, params, _ = setup
+        max_len, slots = 32, 4
+        key = jax.random.PRNGKey(9)
+        pa = jax.random.randint(key, (5,), 0, cfg.vocab_size)
+        pb = jax.random.randint(jax.random.fold_in(key, 1), (9,), 0,
+                                cfg.vocab_size)
+        st = S.init_server_state(cfg, slots, max_len)
+        st = S.admit(params, st, pa, jnp.int32(0))
+        st = S.admit(params, st, pb, jnp.int32(2))
+        # admit's first token must equal solo generate's first token
+        want_a = self._solo(params, cfg, pa, 6, max_len)
+        want_b = self._solo(params, cfg, pb, 6, max_len)
+        assert int(st["token"][0]) == int(want_a[0])
+        assert int(st["token"][2]) == int(want_b[0])
+        st, emitted = S.serve_chunk(params, st, 5)
+        got_a = [int(want_a[0])] + [int(t) for t in emitted[:, 0]]
+        got_b = [int(want_b[0])] + [int(t) for t in emitted[:, 2]]
+        assert got_a == [int(x) for x in want_a]
+        assert got_b == [int(x) for x in want_b]
+        # free slots emitted nothing
+        assert set(int(t) for t in emitted[:, 1]) == {-1}
+        assert set(int(t) for t in emitted[:, 3]) == {-1}
+
+    def test_mid_flight_admission_does_not_disturb(self, setup):
+        """Admit C while A decodes: A's continuation is bit-identical
+        to an undisturbed run, and C's stream matches its solo run."""
+        cfg, params, _ = setup
+        max_len = 32
+        key = jax.random.PRNGKey(11)
+        pa = jax.random.randint(key, (6,), 0, cfg.vocab_size)
+        pc = jax.random.randint(jax.random.fold_in(key, 2), (4,), 0,
+                                cfg.vocab_size)
+        want_a = self._solo(params, cfg, pa, 9, max_len)
+        want_c = self._solo(params, cfg, pc, 4, max_len)
+
+        st = S.init_server_state(cfg, 2, max_len)
+        st = S.admit(params, st, pa, jnp.int32(0))
+        st, em1 = S.serve_chunk(params, st, 4)       # A alone
+        st = S.admit(params, st, pc, jnp.int32(1))   # C joins mid-flight
+        st, em2 = S.serve_chunk(params, st, 4)       # A and C together
+        got_a = ([int(want_a[0])] + [int(t) for t in em1[:, 0]]
+                 + [int(t) for t in em2[:, 0]])
+        assert got_a == [int(x) for x in want_a]
+        got_c = [int(want_c[0])] + [int(t) for t in em2[:, 1]]
+        # C emitted its first 3 scan tokens after its admit token
+        assert got_c[:4] == [int(x) for x in want_c[:4]]
+
+    def test_slot_recycling(self, setup):
+        """Release A's slot and admit B into it: B's stream is exact —
+        stale cache rows from A are unreachable (pos masks them) and
+        overwritten as B advances."""
+        cfg, params, _ = setup
+        max_len = 24
+        key = jax.random.PRNGKey(13)
+        pa = jax.random.randint(key, (8,), 0, cfg.vocab_size)
+        pb = jax.random.randint(jax.random.fold_in(key, 3), (5,), 0,
+                                cfg.vocab_size)
+        st = S.init_server_state(cfg, 1, max_len)
+        st = S.admit(params, st, pa, jnp.int32(0))
+        st, _ = S.serve_chunk(params, st, 6)
+        st = S.release(st, 0)
+        assert not bool(st["active"][0])
+        st = S.admit(params, st, pb, jnp.int32(0))
+        st, emitted = S.serve_chunk(params, st, 5)
+        want_b = self._solo(params, cfg, pb, 6, max_len)
+        got_b = [int(want_b[0])] + [int(t) for t in emitted[:, 0]]
+        assert got_b == [int(x) for x in want_b]
+
+    def test_self_retirement_at_max_len(self, setup):
+        cfg, params, _ = setup
+        max_len = 8
+        prompt = jnp.array([1, 2, 3, 4, 5], jnp.int32)
+        st = S.init_server_state(cfg, 1, max_len)
+        st = S.admit(params, st, prompt, jnp.int32(0))  # pos = 5
+        st, emitted = S.serve_chunk(params, st, 6)
+        # legal writes at rows 5, 6, 7 -> three emissions, then retire
+        emitted = [int(t) for t in emitted[:, 0]]
+        assert all(t >= 0 for t in emitted[:3])
+        assert all(t == -1 for t in emitted[3:])
+        assert not bool(st["active"][0])
+
+    def test_admit_rejects_prompt_filling_cache(self, setup):
+        cfg, params, _ = setup
+        st = S.init_server_state(cfg, 1, 8)
+        prompt = jnp.arange(8, dtype=jnp.int32)  # Lp == max_len
+        with pytest.raises(ValueError, match="decode room"):
+            S.admit(params, st, prompt, jnp.int32(0))

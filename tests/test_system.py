@@ -131,3 +131,39 @@ def test_hbm_slice_two_phase_handshake(system):
     assert creq.envs[const.ENV_XLA_MEM_FRACTION] == "0.45"
     assert api.get_pod("default", "slice").annotations[
         const.ANN_ASSIGNED] == const.ASSIGNED_TRUE
+
+
+def test_per_level_log_files(tmp_path):
+    """LOG_DIR fans records into per-level files (each holding exactly
+    its level — the reference's beego AdapterMultiFile layout) while
+    the console keeps LOG_LEVEL; removing the handlers afterwards so
+    the suite's logging is undisturbed."""
+    import logging
+
+    from tpushare.cmd.main import configure_logging
+
+    root = logging.getLogger()
+    before = list(root.handlers)
+    before_level = root.level
+    try:
+        configure_logging("warning", str(tmp_path))
+        log = logging.getLogger("tpushare.logtest")
+        log.debug("d-mark")
+        log.info("i-mark")
+        log.warning("w-mark")
+        log.error("e-mark")
+        text = {p.name: p.read_text() for p in tmp_path.iterdir()}
+        assert "d-mark" in text["debug.log"]
+        assert "i-mark" in text["info.log"]
+        assert "w-mark" in text["warning.log"]
+        assert "e-mark" in text["error.log"]
+        # exact-level: no cross-contamination
+        assert "e-mark" not in text["warning.log"]
+        assert "d-mark" not in text["info.log"]
+        assert text["critical.log"] == ""
+    finally:
+        for h in list(root.handlers):
+            if h not in before:
+                root.removeHandler(h)
+                h.close()
+        root.setLevel(before_level)

@@ -12,6 +12,10 @@ Environment (reference cmd/main.go:23,92-98):
   ``THREADNESS`` was dead code, SURVEY.md §2 defect 1)
 * ``LOG_LEVEL``  — debug/info/warning (the reference's manifest set this
   but the code never read it, SURVEY.md §2 C16)
+* ``LOG_DIR``    — when set, ALSO fan log records into per-level files
+  (``debug.log`` … ``critical.log``, each holding exactly its level —
+  the reference's beego AdapterMultiFile layout, cmd/main.go:35-54).
+  Console stays at LOG_LEVEL; the files are full-fidelity.
 * ``DEBUG_ROUTES`` — set 0/false to disable the /debug/pprof suite
   (it shares the webhook NodePort and the profiler taxes the hot path)
 * ``LEADER_ELECT`` — set 1/true to join Lease-based leader election so
@@ -142,11 +146,51 @@ def shutdown_stack(stack, server) -> None:
     stack.controller.stop()
 
 
+def configure_logging(level_name: str | None = None,
+                      log_dir: str | None = None) -> None:
+    """Console logging always; with ``log_dir``, ALSO fan records into
+    per-level files (``debug.log`` catches everything at its level and
+    above-filtered, ``info.log``, ``warning.log``, ``error.log``) — the
+    reference's beego multi-file layout (``cmd/main.go:35-54``), which
+    operators grep by severity on the node. Console-only remains the
+    k8s-native default (stdout → container runtime → `kubectl logs`)."""
+    level = (level_name or os.environ.get("LOG_LEVEL", "info")).upper()
+    root_level = getattr(logging, level, logging.INFO)
+    fmt = logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s")
+    logging.basicConfig(level=root_level,
+                        format="%(asctime)s %(levelname)s %(name)s: "
+                               "%(message)s")
+    log_dir = log_dir if log_dir is not None else os.environ.get(
+        "LOG_DIR", "")
+    if not log_dir:
+        return
+    os.makedirs(log_dir, exist_ok=True)
+    root = logging.getLogger()
+    # Effective level must admit every file's records even when the
+    # console is quieter (beego wrote debug.log regardless of console
+    # verbosity; mirrored: LOG_DIR implies full-fidelity files).
+    root.setLevel(min(root_level, logging.DEBUG))
+    for handler in root.handlers:
+        if isinstance(handler, logging.StreamHandler) and not isinstance(
+                handler, logging.FileHandler):
+            handler.setLevel(root_level)  # console keeps LOG_LEVEL
+    # One file per severity, each holding EXACTLY that level — beego's
+    # AdapterMultiFile `separate` semantics (nvidia.error.log holds the
+    # errors, not three copies of every error across files).
+    for name, lvl in (("debug", logging.DEBUG), ("info", logging.INFO),
+                      ("warning", logging.WARNING),
+                      ("error", logging.ERROR),
+                      ("critical", logging.CRITICAL)):
+        fh = logging.FileHandler(os.path.join(log_dir, f"{name}.log"))
+        fh.setLevel(lvl)
+        fh.addFilter(lambda rec, lv=lvl: rec.levelno == lv)
+        fh.setFormatter(fmt)
+        root.addHandler(fh)
+
+
 def main() -> None:
-    level = os.environ.get("LOG_LEVEL", "info").upper()
-    logging.basicConfig(
-        level=getattr(logging, level, logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    configure_logging()
 
     port = int(os.environ.get("PORT", "39999"))
     workers = int(os.environ.get("WORKERS", "4"))

@@ -187,6 +187,147 @@ def _generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
     return jnp.concatenate([tokens, out.T], axis=1)
 
 
+# --------------------------------------------------------------------------
+# Continuous decode admission (per-slot positions + slot recycling)
+# --------------------------------------------------------------------------
+#
+# ``generate`` serves one static batch: every sequence starts together
+# and the whole batch retires together, so a 3-second request admitted
+# behind a 3-minute one waits out the difference as dead air. The slot
+# server below is the TPU-native continuous-batching shape (the
+# iteration-level scheduling of Orca/vLLM, minus a paged allocator —
+# cache rows ARE the pages at slot granularity, which is what XLA's
+# static shapes want):
+#
+# * State is a fixed [SLOTS, max_len] cache plus per-slot position,
+#   activity, and last-token vectors. Shapes never change; admission
+#   and retirement flip per-slot state, so ONE compiled step function
+#   serves every mix of in-flight requests.
+# * ``admit`` prefills a prompt into a free slot mid-flight — other
+#   slots' streams are untouched (tests pin exactness vs solo runs).
+# * ``serve_chunk`` advances every active slot by n tokens in one
+#   lax.scan (chunked iteration batching: the chunk amortizes host
+#   round-trips; a released slot is recyclable at the next chunk
+#   boundary).
+
+
+def init_server_state(cfg: M.ModelConfig, slots: int,
+                      max_len: int) -> dict:
+    """Fresh all-slots-free server state (a jit-friendly pytree)."""
+    return {
+        "cache": init_cache(cfg, slots, max_len),
+        "pos": jnp.zeros((slots,), jnp.int32),
+        "active": jnp.zeros((slots,), bool),
+        "token": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+@partial(jax.jit, static_argnames=("attn_fn",))
+def admit(params: dict, state: dict, prompt: jax.Array,
+          slot: jax.Array, attn_fn=None) -> dict:
+    """Prefill ``prompt`` [Lp] into ``slot`` (traced scalar) and mark it
+    active — a mid-flight admission. Distinct prompt LENGTHS compile
+    once each (bucket/pad prompts in the serving layer above to bound
+    retraces); distinct slots and contents reuse the compilation."""
+    if attn_fn is None:
+        attn_fn = M.causal_attention
+    Lp = prompt.shape[0]
+    max_len = state["cache"][0]["k"].shape[1]
+    if Lp >= max_len:
+        # Same silent-clamp hazard _generate guards against: pos would
+        # start at max_len and the first decode write would CLAMP into
+        # row max_len-1, corrupting the prompt's last K/V. Static
+        # shapes make this a free trace-time check.
+        raise ValueError(
+            f"prompt length {Lp} leaves no decode room in cache "
+            f"max_len {max_len} (need Lp < max_len)")
+    tokens = prompt[None, :]
+    positions = jnp.broadcast_to(jnp.arange(Lp), (1, Lp))
+    x = params["embed"][tokens]
+    cache = []
+    for block, slots_ in zip(params["blocks"], state["cache"]):
+        q, k, v = M.qkv_proj(block, x, positions)
+        cache.append({
+            "k": jax.lax.dynamic_update_slice(
+                slots_["k"], k, (slot, 0, 0, 0)),
+            "v": jax.lax.dynamic_update_slice(
+                slots_["v"], v, (slot, 0, 0, 0)),
+        })
+        out = attn_fn(q, k, v)
+        x = x + M.out_proj(block, out)
+        x = M.ffn_block(block, x)
+    x = M.rms_norm(x[:, -1], params["final_norm"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    first = jnp.argmax(logits[0], axis=-1).astype(state["token"].dtype)
+    return {
+        "cache": cache,
+        "pos": state["pos"].at[slot].set(Lp),
+        "active": state["active"].at[slot].set(True),
+        "token": state["token"].at[slot].set(first),
+    }
+
+
+def release(state: dict, slot) -> dict:
+    """Retire ``slot``; its cache rows are recycled by the next admit."""
+    return dict(state, active=state["active"].at[slot].set(False))
+
+
+def _slot_decode_step(params: dict, state: dict) -> tuple[dict, jax.Array]:
+    """One token for every ACTIVE slot, per-slot positions. Inactive
+    slots compute masked work (static shapes) but neither advance nor
+    emit."""
+    cache, pos, active = state["cache"], state["pos"], state["active"]
+    token = state["token"]
+    B = token.shape[0]
+    max_len = cache[0]["k"].shape[1]
+    x = params["embed"][token][:, None, :]          # [B, 1, d]
+    positions = pos[:, None]                        # per-slot rotary
+    write = jax.vmap(
+        lambda buf, val, p: jax.lax.dynamic_update_slice(
+            buf, val, (p, 0, 0)))
+    new_cache = []
+    for block, slots_ in zip(params["blocks"], cache):
+        q, k, v = M.qkv_proj(block, x, positions)
+        ck = write(slots_["k"], k, pos)
+        cv = write(slots_["v"], v, pos)
+        new_cache.append({"k": ck, "v": cv})
+        # Per-slot decode mask: slot b attends cache rows 0..pos[b].
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(q.shape[-1]))
+        mask = jnp.arange(max_len)[None, :] <= pos[:, None]   # [B, L]
+        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+        x = x + M.out_proj(block, out)
+        x = M.ffn_block(block, x)
+    x = M.rms_norm(x[:, 0], params["final_norm"])
+    logits = (x @ params["embed"].T).astype(jnp.float32)
+    nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
+    token = jnp.where(active, nxt, token)
+    emitted = jnp.where(active, token, -1)  # BEFORE self-retire: the
+    # token generated at the last legal position still counts.
+    # A slot whose next write would spill past max_len self-retires
+    # (dynamic_update_slice would CLAMP and corrupt the last row).
+    pos = jnp.where(active, pos + 1, pos)
+    active = active & (pos < max_len)
+    return {"cache": new_cache, "pos": pos, "active": active,
+            "token": token}, emitted
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def serve_chunk(params: dict, state: dict,
+                n_steps: int) -> tuple[dict, jax.Array]:
+    """Advance every active slot ``n_steps`` tokens in one compiled
+    scan. Returns (state, emitted [n_steps, SLOTS]) — emitted[t, b] is
+    slot b's token at chunk-step t, or -1 when the slot was inactive
+    (free, or self-retired at max_len)."""
+    def step(st, _):
+        return _slot_decode_step(params, st)
+
+    return jax.lax.scan(step, state, None, length=n_steps)
+
+
 def max_batch_for_grant(cfg: M.ModelConfig, grant_hbm_gib: float,
                         max_len: int, headroom: float = 0.8) -> int:
     """Largest decode batch that fits a tpushare HBM grant.
