@@ -308,3 +308,35 @@ class TestContinuousAdmission:
         prompt = jnp.arange(8, dtype=jnp.int32)  # Lp == max_len
         with pytest.raises(ValueError, match="decode room"):
             S.admit(params, st, prompt, jnp.int32(0))
+
+    def test_slot_server_with_tp_sharded_params(self, setup):
+        """The slot server runs under tensor-parallel (GSPMD) param
+        shardings — heads sharded over tp, per-slot scatter and masks
+        partitioned by XLA. Per this file's sharded-numerics contract
+        (see the tp generate test: allclose, NOT token-exact — psum
+        reduction order can flip an argmax near-tie), the assertions
+        here are structural: the admitted slot emits a valid greedy
+        stream, per-slot bookkeeping advances, free slots stay silent.
+        Slot-server MATH exactness is pinned by the unsharded tests
+        above."""
+        from tpushare.workload import parallel as par
+
+        cfg, params, _ = setup
+        if jax.device_count() < 4:
+            pytest.skip("needs the virtual multi-device mesh")
+        mesh = par.make_mesh(dp=1, tp=4, sp=1,
+                             devices=jax.devices()[:4])
+        placed = jax.device_put(params,
+                                par.param_shardings(mesh, params))
+        prompt = jax.random.randint(jax.random.PRNGKey(21), (6,), 0,
+                                    cfg.vocab_size)
+        with mesh:
+            st = S.init_server_state(cfg, 4, 32)
+            st = S.admit(placed, st, prompt, jnp.int32(0))
+            assert bool(st["active"][0]) and int(st["pos"][0]) == 6
+            assert 0 <= int(st["token"][0]) < cfg.vocab_size
+            st, em = S.serve_chunk(placed, st, 5)
+        assert int(st["pos"][0]) == 11  # 6 + 5 decode steps
+        assert all(0 <= int(t) < cfg.vocab_size for t in em[:, 0])
+        for free in (1, 2, 3):
+            assert set(int(t) for t in em[:, free]) == {-1}
