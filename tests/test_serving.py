@@ -340,3 +340,87 @@ class TestContinuousAdmission:
         assert all(0 <= int(t) < cfg.vocab_size for t in em[:, 0])
         for free in (1, 2, 3):
             assert set(int(t) for t in em[:, free]) == {-1}
+
+    def test_bucketed_admission_matches_unpadded(self, setup):
+        """One compilation serves every prompt length <= the bucket:
+        pad to the bucket, pass true_len — stream identical to the
+        unpadded admission (end-pads are causally invisible, pos starts
+        at true_len, first token reads position true_len-1)."""
+        cfg, params, _ = setup
+        max_len, bucket = 32, 16
+        prompt = jax.random.randint(jax.random.PRNGKey(31), (6,), 0,
+                                    cfg.vocab_size)
+        padded = jnp.concatenate(
+            [prompt, jnp.zeros((bucket - 6,), prompt.dtype)])
+
+        st_a = S.init_server_state(cfg, 2, max_len)
+        st_a = S.admit(params, st_a, prompt, jnp.int32(0))
+        st_a, em_a = S.serve_chunk(params, st_a, 5)
+
+        st_b = S.init_server_state(cfg, 2, max_len)
+        st_b = S.admit(params, st_b, padded, jnp.int32(0),
+                       true_len=jnp.int32(6))
+        assert int(st_b["pos"][0]) == 6
+        st_b, em_b = S.serve_chunk(params, st_b, 5)
+
+        assert int(st_a["token"][0]) == int(st_b["token"][0])
+        assert [int(t) for t in em_a[:, 0]] == [int(t)
+                                                for t in em_b[:, 0]]
+
+    def test_per_slot_temperature(self, setup):
+        """Mixed greedy/sampled decode in one compiled step: the
+        temperature-0 slot reproduces the all-greedy stream exactly;
+        the sampled slot stays in-vocab and varies across keys."""
+        cfg, params, _ = setup
+        max_len = 32
+        key = jax.random.PRNGKey(41)
+        pa = jax.random.randint(key, (5,), 0, cfg.vocab_size)
+        pb = jax.random.randint(jax.random.fold_in(key, 1), (5,), 0,
+                                cfg.vocab_size)
+
+        def run(temp, sample_key):
+            st = S.init_server_state(cfg, 2, max_len)
+            st = S.admit(params, st, pa, jnp.int32(0))
+            st = S.admit(params, st, pb, jnp.int32(1))
+            _, em = S.serve_chunk(params, st, 8, temperature=temp,
+                                  key=sample_key)
+            return [[int(t) for t in em[:, b]] for b in (0, 1)]
+
+        greedy = run(None, None)
+        temp = jnp.array([0.0, 5.0], jnp.float32)
+        mixed1 = run(temp, jax.random.PRNGKey(7))
+        mixed2 = run(temp, jax.random.PRNGKey(8))
+        assert mixed1[0] == greedy[0]       # temp-0 slot: exact greedy
+        assert all(0 <= t < cfg.vocab_size for t in mixed1[1])
+        # High temperature on a tiny random model: two keys agreeing on
+        # all 8 draws would be ~vocab^-8 luck.
+        assert mixed1[1] != mixed2[1]
+
+    def test_temperature_requires_key(self, setup):
+        cfg, params, _ = setup
+        st = S.init_server_state(cfg, 1, 16)
+        with pytest.raises(ValueError, match="PRNG key"):
+            S.serve_chunk(params, st, 2,
+                          temperature=jnp.array([1.0], jnp.float32))
+
+    def test_admit_validates_true_len(self, setup):
+        cfg, params, _ = setup
+        st = S.init_server_state(cfg, 1, 16)
+        prompt = jnp.arange(8, dtype=jnp.int32)
+        with pytest.raises(ValueError, match="outside"):
+            S.admit(params, st, prompt, jnp.int32(0),
+                    true_len=jnp.int32(0))
+        with pytest.raises(ValueError, match="outside"):
+            S.admit(params, st, prompt, jnp.int32(0),
+                    true_len=jnp.int32(9))
+
+    def test_serve_chunk_validates_temperature(self, setup):
+        cfg, params, _ = setup
+        st = S.init_server_state(cfg, 2, 16)
+        with pytest.raises(ValueError, match="per-slot"):
+            S.serve_chunk(params, st, 2, temperature=0.7,
+                          key=jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="negative"):
+            S.serve_chunk(params, st, 2,
+                          temperature=jnp.array([-1.0, 0.5]),
+                          key=jax.random.PRNGKey(0))

@@ -222,15 +222,20 @@ def init_server_state(cfg: M.ModelConfig, slots: int,
     }
 
 
-@partial(jax.jit, static_argnames=("attn_fn",))
 def admit(params: dict, state: dict, prompt: jax.Array,
-          slot: jax.Array, attn_fn=None) -> dict:
+          slot: jax.Array, attn_fn=None,
+          true_len: jax.Array | None = None) -> dict:
     """Prefill ``prompt`` [Lp] into ``slot`` (traced scalar) and mark it
-    active — a mid-flight admission. Distinct prompt LENGTHS compile
-    once each (bucket/pad prompts in the serving layer above to bound
-    retraces); distinct slots and contents reuse the compilation."""
-    if attn_fn is None:
-        attn_fn = M.causal_attention
+    active — a mid-flight admission.
+
+    Distinct prompt LENGTHS compile once each. To bound retraces, pad
+    prompts up to a bucket length and pass the REAL length as
+    ``true_len``: one compilation then serves every prompt ≤ the
+    bucket. End-padding is safe by construction — causal prefill means
+    real tokens never attend the pads, the slot's ``pos`` starts at
+    ``true_len`` so decode never reads a pad row before overwriting it,
+    and the first sampled token comes from position ``true_len - 1``,
+    not the pad tail."""
     Lp = prompt.shape[0]
     max_len = state["cache"][0]["k"].shape[1]
     if Lp >= max_len:
@@ -241,6 +246,31 @@ def admit(params: dict, state: dict, prompt: jax.Array,
         raise ValueError(
             f"prompt length {Lp} leaves no decode room in cache "
             f"max_len {max_len} (need Lp < max_len)")
+    if true_len is not None and not isinstance(true_len,
+                                               jax.core.Tracer):
+        # generate()'s boundary pattern: validate concrete values in
+        # the un-jitted wrapper — an out-of-range true_len inside the
+        # jit would silently clamp (index -1 → row 0; > Lp → attends
+        # never-written rows) instead of failing.
+        tl = int(true_len)
+        if not 1 <= tl <= Lp:
+            raise ValueError(
+                f"true_len {tl} outside [1, {Lp}] (the padded prompt's "
+                f"length) — a clamped index would silently corrupt the "
+                f"stream")
+    if true_len is None:
+        true_len = jnp.int32(Lp)
+    return _admit(params, state, prompt, slot, attn_fn,
+                  jnp.asarray(true_len, jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("attn_fn",))
+def _admit(params: dict, state: dict, prompt: jax.Array,
+           slot: jax.Array, attn_fn, true_len: jax.Array) -> dict:
+    if attn_fn is None:
+        attn_fn = M.causal_attention
+    real_len = true_len
+    Lp = prompt.shape[0]
     tokens = prompt[None, :]
     positions = jnp.broadcast_to(jnp.arange(Lp), (1, Lp))
     x = params["embed"][tokens]
@@ -256,12 +286,14 @@ def admit(params: dict, state: dict, prompt: jax.Array,
         out = attn_fn(q, k, v)
         x = x + M.out_proj(block, out)
         x = M.ffn_block(block, x)
-    x = M.rms_norm(x[:, -1], params["final_norm"])
-    logits = (x @ params["embed"].T).astype(jnp.float32)
+    last = jax.lax.dynamic_index_in_dim(x[0], real_len - 1, axis=0,
+                                        keepdims=False)
+    h = M.rms_norm(last[None, :], params["final_norm"])
+    logits = (h @ params["embed"].T).astype(jnp.float32)
     first = jnp.argmax(logits[0], axis=-1).astype(state["token"].dtype)
     return {
         "cache": cache,
-        "pos": state["pos"].at[slot].set(Lp),
+        "pos": state["pos"].at[slot].set(real_len),
         "active": state["active"].at[slot].set(True),
         "token": state["token"].at[slot].set(first),
     }
@@ -272,10 +304,14 @@ def release(state: dict, slot) -> dict:
     return dict(state, active=state["active"].at[slot].set(False))
 
 
-def _slot_decode_step(params: dict, state: dict) -> tuple[dict, jax.Array]:
+def _slot_decode_step(params: dict, state: dict,
+                      temperature: jax.Array | None = None,
+                      key: jax.Array | None = None
+                      ) -> tuple[dict, jax.Array]:
     """One token for every ACTIVE slot, per-slot positions. Inactive
     slots compute masked work (static shapes) but neither advance nor
-    emit."""
+    emit. ``temperature`` [SLOTS] samples per slot (0 = greedy for that
+    slot — mixed greedy/sampled batches in one compiled step)."""
     cache, pos, active = state["cache"], state["pos"], state["active"]
     token = state["token"]
     B = token.shape[0]
@@ -303,7 +339,16 @@ def _slot_decode_step(params: dict, state: dict) -> tuple[dict, jax.Array]:
         x = M.ffn_block(block, x)
     x = M.rms_norm(x[:, 0], params["final_norm"])
     logits = (x @ params["embed"].T).astype(jnp.float32)
-    nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
+    greedy = jnp.argmax(logits, axis=-1).astype(token.dtype)
+    if temperature is None:
+        nxt = greedy
+    else:
+        # Per-slot select (the generate() pattern, vectorized over
+        # slots): both arms are trivial next to the decode matmuls.
+        scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+        sampled = jax.random.categorical(key, scaled,
+                                         axis=-1).astype(token.dtype)
+        nxt = jnp.where(temperature > 0, sampled, greedy)
     token = jnp.where(active, nxt, token)
     emitted = jnp.where(active, token, -1)  # BEFORE self-retire: the
     # token generated at the last legal position still counts.
@@ -315,17 +360,61 @@ def _slot_decode_step(params: dict, state: dict) -> tuple[dict, jax.Array]:
             "token": token}, emitted
 
 
-@partial(jax.jit, static_argnames=("n_steps",))
-def serve_chunk(params: dict, state: dict,
-                n_steps: int) -> tuple[dict, jax.Array]:
+def serve_chunk(params: dict, state: dict, n_steps: int,
+                temperature: jax.Array | None = None,
+                key: jax.Array | None = None
+                ) -> tuple[dict, jax.Array]:
     """Advance every active slot ``n_steps`` tokens in one compiled
     scan. Returns (state, emitted [n_steps, SLOTS]) — emitted[t, b] is
     slot b's token at chunk-step t, or -1 when the slot was inactive
-    (free, or self-retired at max_len)."""
-    def step(st, _):
-        return _slot_decode_step(params, st)
+    (free, or self-retired at max_len).
 
-    return jax.lax.scan(step, state, None, length=n_steps)
+    ``temperature`` [SLOTS] enables PER-SLOT sampling (0 entries stay
+    greedy), with ``key`` required then — mixed greedy and sampled
+    requests decode in the same compiled step, mirroring ``generate``'s
+    traced-temperature design (a static per-request temperature would
+    retrace the server per distinct float). The admit-time first token
+    is always greedy today; sampled first tokens would need the key at
+    admission."""
+    if temperature is not None:
+        if key is None:
+            raise ValueError("temperature requires an explicit PRNG key")
+        slots = state["pos"].shape[0]
+        temperature = jnp.asarray(temperature, jnp.float32)
+        if temperature.shape != (slots,):
+            # A generate-style scalar here would fail deep inside the
+            # traced step with an index error; name the fix instead.
+            raise ValueError(
+                f"temperature must be a per-slot [{slots}] vector "
+                f"(0 entries stay greedy), got shape "
+                f"{temperature.shape}")
+        if not isinstance(temperature, jax.core.Tracer) and bool(
+                (temperature < 0).any()):
+            raise ValueError(
+                "negative temperature entries would silently mean "
+                "greedy; use 0 for greedy slots")
+    return _serve_chunk(params, state, n_steps, temperature, key)
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def _serve_chunk(params: dict, state: dict, n_steps: int,
+                 temperature: jax.Array | None,
+                 key: jax.Array | None) -> tuple[dict, jax.Array]:
+    if temperature is None:
+        def step(st, _):
+            return _slot_decode_step(params, st)
+
+        return jax.lax.scan(step, state, None, length=n_steps)
+
+    def step(carry, _):
+        st, k = carry
+        k, sub = jax.random.split(k)
+        st, emitted = _slot_decode_step(params, st, temperature, sub)
+        return (st, k), emitted
+
+    (state, _), emitted = jax.lax.scan(step, (state, key), None,
+                                       length=n_steps)
+    return state, emitted
 
 
 def max_batch_for_grant(cfg: M.ModelConfig, grant_hbm_gib: float,
