@@ -424,3 +424,45 @@ class TestContinuousAdmission:
             S.serve_chunk(params, st, 2,
                           temperature=jnp.array([-1.0, 0.5]),
                           key=jax.random.PRNGKey(0))
+
+    def test_bucket_at_max_len_admits_with_true_len(self, setup):
+        """A prompt padded all the way to max_len is legal when
+        true_len leaves decode room — the hazard depends on where pos
+        STARTS, not on the padded length."""
+        cfg, params, _ = setup
+        max_len = 16
+        prompt = jax.random.randint(jax.random.PRNGKey(51), (6,), 0,
+                                    cfg.vocab_size)
+        padded = jnp.concatenate(
+            [prompt, jnp.zeros((max_len - 6,), prompt.dtype)])
+        st = S.init_server_state(cfg, 1, max_len)
+        st = S.admit(params, st, padded, jnp.int32(0),
+                     true_len=jnp.int32(6))
+        st, em = S.serve_chunk(params, st, 4)
+        want = self._solo(params, cfg, prompt, 5, max_len)
+        got = [int(want[0])] + [int(t) for t in em[:, 0]]
+        assert got == [int(x) for x in want]
+        # but true_len itself must still leave room
+        with pytest.raises(ValueError, match="decode room"):
+            S.admit(params, st, padded, jnp.int32(0),
+                    true_len=jnp.int32(max_len))
+
+    def test_sampled_chunks_split_keys_differ(self, setup):
+        """The cross-chunk key discipline the docstring mandates: split
+        per chunk -> fresh noise; two chunks under SPLIT keys draw
+        different streams (reusing one key would replay them)."""
+        cfg, params, _ = setup
+        st = S.init_server_state(cfg, 1, 32)
+        prompt = jax.random.randint(jax.random.PRNGKey(61), (4,), 0,
+                                    cfg.vocab_size)
+        st = S.admit(params, st, prompt, jnp.int32(0))
+        temp = jnp.array([5.0], jnp.float32)
+        key = jax.random.PRNGKey(9)
+        key, k1 = jax.random.split(key)
+        st, em1 = S.serve_chunk(params, st, 6, temperature=temp, key=k1)
+        key, k2 = jax.random.split(key)
+        st, em2 = S.serve_chunk(params, st, 6, temperature=temp, key=k2)
+        assert all(0 <= int(t) < cfg.vocab_size for t in em2[:, 0])
+        # Same positions would replay identical noise under ONE key;
+        # split keys make a 6-draw collision ~vocab^-6 luck.
+        assert [int(t) for t in em1[:, 0]] != [int(t) for t in em2[:, 0]]

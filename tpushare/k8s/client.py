@@ -351,5 +351,17 @@ class ApiClient:
             except (ApiError, OSError, json.JSONDecodeError) as e:
                 if stop.is_set():
                     return
+                status = (e.status if isinstance(e, ApiError)
+                          else getattr(e, "code", None))  # HTTPError
+                if status in (403, 404):
+                    # The resource is denied (RBAC) or absent (old
+                    # apiserver without the group — e.g. policy/v1 for
+                    # the optional PDB watch). That won't heal in a
+                    # second; a 1 s retry loop would log-spam and load
+                    # the apiserver for the process's lifetime.
+                    log.warning("watch %s unavailable (%s); retrying "
+                                "in 60s", kind, e)
+                    stop.wait(60.0)
+                    continue
                 log.warning("watch %s dropped (%s); re-listing", kind, e)
                 stop.wait(1.0)

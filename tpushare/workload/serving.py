@@ -238,14 +238,18 @@ def admit(params: dict, state: dict, prompt: jax.Array,
     not the pad tail."""
     Lp = prompt.shape[0]
     max_len = state["cache"][0]["k"].shape[1]
-    if Lp >= max_len:
+    if Lp > max_len:
+        raise ValueError(
+            f"prompt length {Lp} exceeds cache max_len {max_len}")
+    if true_len is None and Lp >= max_len:
         # Same silent-clamp hazard _generate guards against: pos would
         # start at max_len and the first decode write would CLAMP into
-        # row max_len-1, corrupting the prompt's last K/V. Static
-        # shapes make this a free trace-time check.
+        # row max_len-1, corrupting the prompt's last K/V. (A bucketed
+        # admission may legally pad UP TO max_len — the hazard depends
+        # on where pos STARTS, i.e. true_len, checked below.)
         raise ValueError(
             f"prompt length {Lp} leaves no decode room in cache "
-            f"max_len {max_len} (need Lp < max_len)")
+            f"max_len {max_len} (need Lp < max_len, or pass true_len)")
     if true_len is not None and not isinstance(true_len,
                                                jax.core.Tracer):
         # generate()'s boundary pattern: validate concrete values in
@@ -258,6 +262,10 @@ def admit(params: dict, state: dict, prompt: jax.Array,
                 f"true_len {tl} outside [1, {Lp}] (the padded prompt's "
                 f"length) — a clamped index would silently corrupt the "
                 f"stream")
+        if tl >= max_len:
+            raise ValueError(
+                f"true_len {tl} leaves no decode room in cache "
+                f"max_len {max_len}")
     if true_len is None:
         true_len = jnp.int32(Lp)
     return _admit(params, state, prompt, slot, attn_fn,
@@ -269,7 +277,6 @@ def _admit(params: dict, state: dict, prompt: jax.Array,
            slot: jax.Array, attn_fn, true_len: jax.Array) -> dict:
     if attn_fn is None:
         attn_fn = M.causal_attention
-    real_len = true_len
     Lp = prompt.shape[0]
     tokens = prompt[None, :]
     positions = jnp.broadcast_to(jnp.arange(Lp), (1, Lp))
@@ -286,14 +293,14 @@ def _admit(params: dict, state: dict, prompt: jax.Array,
         out = attn_fn(q, k, v)
         x = x + M.out_proj(block, out)
         x = M.ffn_block(block, x)
-    last = jax.lax.dynamic_index_in_dim(x[0], real_len - 1, axis=0,
+    last = jax.lax.dynamic_index_in_dim(x[0], true_len - 1, axis=0,
                                         keepdims=False)
     h = M.rms_norm(last[None, :], params["final_norm"])
     logits = (h @ params["embed"].T).astype(jnp.float32)
     first = jnp.argmax(logits[0], axis=-1).astype(state["token"].dtype)
     return {
         "cache": cache,
-        "pos": state["pos"].at[slot].set(real_len),
+        "pos": state["pos"].at[slot].set(true_len),
         "active": state["active"].at[slot].set(True),
         "token": state["token"].at[slot].set(first),
     }
@@ -373,8 +380,11 @@ def serve_chunk(params: dict, state: dict, n_steps: int,
     greedy), with ``key`` required then — mixed greedy and sampled
     requests decode in the same compiled step, mirroring ``generate``'s
     traced-temperature design (a static per-request temperature would
-    retrace the server per distinct float). The admit-time first token
-    is always greedy today; sampled first tokens would need the key at
+    retrace the server per distinct float). Standard JAX key
+    discipline applies ACROSS chunks: split the key per call
+    (``key, sub = jax.random.split(key)``) — reusing one key replays
+    the same per-step noise every chunk. The admit-time first token is
+    always greedy today; sampled first tokens would need the key at
     admission."""
     if temperature is not None:
         if key is None:
