@@ -466,3 +466,65 @@ class TestContinuousAdmission:
         # Same positions would replay identical noise under ONE key;
         # split keys make a 6-draw collision ~vocab^-6 luck.
         assert [int(t) for t in em1[:, 0]] != [int(t) for t in em2[:, 0]]
+
+    def test_sampled_admission_first_token(self, setup):
+        """temperature at admit samples the FIRST token with generate's
+        semantics: 0 stays greedy (exact vs default admit); > 0 is
+        reproducible under one key and in-vocab."""
+        cfg, params, _ = setup
+        prompt = jax.random.randint(jax.random.PRNGKey(71), (5,), 0,
+                                    cfg.vocab_size)
+        st0 = S.init_server_state(cfg, 1, 16)
+        greedy = S.admit(params, st0, prompt, jnp.int32(0))
+        also = S.admit(params, st0, prompt, jnp.int32(0),
+                       temperature=0.0)
+        assert int(greedy["token"][0]) == int(also["token"][0])
+        k = jax.random.PRNGKey(3)
+        s1 = S.admit(params, st0, prompt, jnp.int32(0),
+                     temperature=5.0, key=k)
+        s1b = S.admit(params, st0, prompt, jnp.int32(0),
+                      temperature=5.0, key=k)
+        assert int(s1["token"][0]) == int(s1b["token"][0])
+        assert 0 <= int(s1["token"][0]) < cfg.vocab_size
+        with pytest.raises(ValueError, match="PRNG key"):
+            S.admit(params, st0, prompt, jnp.int32(0), temperature=0.7)
+        with pytest.raises(ValueError, match=">= 0"):
+            S.admit(params, st0, prompt, jnp.int32(0), temperature=-1.0)
+
+    def test_traced_true_len_at_max_len_is_inert_not_corrupt(self, setup):
+        """A traced true_len bypasses the wrapper's concrete checks; a
+        no-decode-room value must yield an INERT slot (emits nothing),
+        never a clamped write over the prompt's last K/V row."""
+        cfg, params, _ = setup
+        max_len = 8
+        prompt = jnp.arange(8, dtype=jnp.int32)  # Lp == max_len
+
+        @jax.jit
+        def admit_traced(st, tl):
+            return S._admit(params, st, prompt, jnp.int32(0), None,
+                            tl, jnp.float32(0.0),
+                            jax.random.PRNGKey(0))
+
+        st = admit_traced(S.init_server_state(cfg, 1, max_len),
+                          jnp.int32(max_len))
+        assert not bool(st["active"][0])  # inert, not corrupting
+        st2, em = S.serve_chunk(params, st, 3)
+        assert set(int(t) for t in em[:, 0]) == {-1}
+        # a legal traced true_len admits normally through the same jit
+        st3 = admit_traced(S.init_server_state(cfg, 1, max_len),
+                           jnp.int32(4))
+        assert bool(st3["active"][0]) and int(st3["pos"][0]) == 4
+
+    def test_traced_temperature_requires_key(self, setup):
+        cfg, params, _ = setup
+        st = S.init_server_state(cfg, 1, 16)
+        prompt = jnp.arange(4, dtype=jnp.int32)
+
+        with pytest.raises(ValueError, match="traced temperature"):
+            jax.jit(lambda t: S.admit(params, st, prompt, jnp.int32(0),
+                                      temperature=t))(jnp.float32(0.5))
+        tokens = jnp.arange(8, dtype=jnp.int32).reshape(2, 4)
+        with pytest.raises(ValueError, match="traced temperature"):
+            jax.jit(lambda t: S.generate(params, tokens, cfg, n_new=2,
+                                         max_len=16,
+                                         temperature=t))(jnp.float32(0.5))

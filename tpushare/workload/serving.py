@@ -132,7 +132,14 @@ def generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
     prefill through the default XLA path materializes [B, H, L, L]
     scores the chip cannot hold; the Pallas kernel streams them.
     """
-    if not isinstance(temperature, jax.core.Tracer):
+    if isinstance(temperature, jax.core.Tracer):
+        if key is None:
+            # A fixed default key would make every request's "random"
+            # stream byte-identical; the traced-temperature caller
+            # cannot be value-checked, but the missing key can.
+            raise ValueError(
+                "traced temperature requires an explicit PRNG key")
+    else:
         # Value validation only at the concrete Python boundary; a
         # caller who jits over generate() passes a tracer and takes
         # responsibility for the value (the where-select inside treats
@@ -224,7 +231,9 @@ def init_server_state(cfg: M.ModelConfig, slots: int,
 
 def admit(params: dict, state: dict, prompt: jax.Array,
           slot: jax.Array, attn_fn=None,
-          true_len: jax.Array | None = None) -> dict:
+          true_len: jax.Array | None = None,
+          temperature: float = 0.0,
+          key: jax.Array | None = None) -> dict:
     """Prefill ``prompt`` [Lp] into ``slot`` (traced scalar) and mark it
     active — a mid-flight admission.
 
@@ -235,7 +244,12 @@ def admit(params: dict, state: dict, prompt: jax.Array,
     real tokens never attend the pads, the slot's ``pos`` starts at
     ``true_len`` so decode never reads a pad row before overwriting it,
     and the first sampled token comes from position ``true_len - 1``,
-    not the pad tail."""
+    not the pad tail.
+
+    ``temperature``/``key`` sample the admitted request's FIRST token
+    (``generate``'s semantics: 0 = greedy; > 0 needs the key; traced,
+    so per-request temperatures share one compilation) — the rest of
+    its stream samples per-slot via ``serve_chunk``'s vector."""
     Lp = prompt.shape[0]
     max_len = state["cache"][0]["k"].shape[1]
     if Lp > max_len:
@@ -266,18 +280,46 @@ def admit(params: dict, state: dict, prompt: jax.Array,
             raise ValueError(
                 f"true_len {tl} leaves no decode room in cache "
                 f"max_len {max_len}")
+    if isinstance(temperature, jax.core.Tracer):
+        if key is None:
+            # A fixed default key would make every request's "random"
+            # first token byte-identical — raise rather than sample
+            # deterministically behind the caller's back.
+            raise ValueError(
+                "traced temperature requires an explicit PRNG key")
+    else:
+        if temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature} "
+                "(a negative value would silently mean greedy)")
+        if temperature > 0 and key is None:
+            raise ValueError(
+                "temperature > 0 requires an explicit PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused by the greedy branch
     if true_len is None:
         true_len = jnp.int32(Lp)
     return _admit(params, state, prompt, slot, attn_fn,
-                  jnp.asarray(true_len, jnp.int32))
+                  jnp.asarray(true_len, jnp.int32),
+                  jnp.float32(temperature), key)
 
 
 @partial(jax.jit, static_argnames=("attn_fn",))
 def _admit(params: dict, state: dict, prompt: jax.Array,
-           slot: jax.Array, attn_fn, true_len: jax.Array) -> dict:
+           slot: jax.Array, attn_fn, true_len: jax.Array,
+           temperature: jax.Array, key: jax.Array) -> dict:
     if attn_fn is None:
         attn_fn = M.causal_attention
     Lp = prompt.shape[0]
+    max_len = state["cache"][0]["k"].shape[1]
+    # A TRACED true_len bypasses the wrapper's concrete checks; defend
+    # structurally instead of corrupting: clamp into the prompt, and
+    # admit a no-decode-room request INERT (active=False — it emits
+    # nothing and its slot is immediately recyclable) rather than let
+    # the first decode write clamp into row max_len-1 over the
+    # prompt's last K/V.
+    true_len = jnp.clip(true_len, 1, Lp)
+    has_room = true_len < max_len
     tokens = prompt[None, :]
     positions = jnp.broadcast_to(jnp.arange(Lp), (1, Lp))
     x = params["embed"][tokens]
@@ -297,11 +339,15 @@ def _admit(params: dict, state: dict, prompt: jax.Array,
                                         keepdims=False)
     h = M.rms_norm(last[None, :], params["final_norm"])
     logits = (h @ params["embed"].T).astype(jnp.float32)
-    first = jnp.argmax(logits[0], axis=-1).astype(state["token"].dtype)
+    greedy = jnp.argmax(logits[0], axis=-1)
+    sampled = jax.random.categorical(
+        key, logits[0] / jnp.maximum(temperature, 1e-6), axis=-1)
+    first = jnp.where(temperature > 0, sampled,
+                      greedy).astype(state["token"].dtype)
     return {
         "cache": cache,
         "pos": state["pos"].at[slot].set(true_len),
-        "active": state["active"].at[slot].set(True),
+        "active": state["active"].at[slot].set(has_room),
         "token": state["token"].at[slot].set(first),
     }
 
@@ -383,9 +429,8 @@ def serve_chunk(params: dict, state: dict, n_steps: int,
     retrace the server per distinct float). Standard JAX key
     discipline applies ACROSS chunks: split the key per call
     (``key, sub = jax.random.split(key)``) — reusing one key replays
-    the same per-step noise every chunk. The admit-time first token is
-    always greedy today; sampled first tokens would need the key at
-    admission."""
+    the same per-step noise every chunk. The admitted request's FIRST
+    token samples at admission (``admit``'s temperature/key)."""
     if temperature is not None:
         if key is None:
             raise ValueError("temperature requires an explicit PRNG key")
