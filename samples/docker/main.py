@@ -23,6 +23,10 @@ grant = jaxenv.configure()  # must precede `import jax`
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+# Opt into the usage contract: heartbeat memory_stats so the node's
+# grant watchdog can verify used-vs-granted (no-op outside tpushare).
+jaxenv.start_usage_reporter()
+
 
 def main() -> None:
     if grant is None:

@@ -647,6 +647,24 @@ class TestPDBRecount:
             "status": {"disruptionsAllowed": 0}})
         assert not weird.matches(web_prod)  # unknown op: fail closed
 
+    def test_empty_selector_matches_nothing(self):
+        """Nil-or-empty selectors match NOTHING — the upstream
+        scheduler's filterPodsWithPDBViolation short-circuits on
+        selector.Empty(), and our recount mirrors the scheduler's
+        count, not the eviction API's select-all reading (round-4
+        advisor finding)."""
+        from tpushare.api.objects import PodDisruptionBudget
+        pod = Pod(make_pod("w", hbm=1, namespace="prod",
+                           labels={"tier": "web"}))
+        for sel in (None, {}, {"matchLabels": {}},
+                    {"matchLabels": {}, "matchExpressions": []}):
+            spec = {} if sel is None else {"selector": sel}
+            pdb = PodDisruptionBudget({
+                "metadata": {"name": "x", "namespace": "prod"},
+                "spec": spec,
+                "status": {"disruptionsAllowed": 0}})
+            assert not pdb.matches(pod), f"selector={sel!r}"
+
     def test_no_lister_echoes_scheduler_count(self, api):
         """Without a PDB view the handler keeps the pre-round-4 echo
         (never invents zeros it cannot justify)."""

@@ -338,3 +338,14 @@ class TestScoringPolicy:
                         annotations={const.ANN_SCORING: "binpak"})
         s = scores(prio, typo, ["partial", "pristine"])
         assert s["partial"] > s["pristine"]  # fleet default applied
+
+    def test_spread_zero_capacity_chips_score_zero(self, api):
+        """A degenerate node whose fitting chips all report
+        total_hbm == 0 must score 0 under spread, not 500 the verb
+        (round-4 advisor finding: max()/fmean() over empty input)."""
+        api.create_node(make_node("weird", chips=2, hbm_per_chip=0))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        spread = Prioritize(cache, policy="spread")
+        pod = make_pod("p", hbm=0)
+        s = scores(spread, pod, ["weird"])
+        assert s["weird"] == 0

@@ -414,6 +414,39 @@ class TestContinuousAdmission:
             S.admit(params, st, prompt, jnp.int32(0),
                     true_len=jnp.int32(9))
 
+    def test_admit_validates_slot(self, setup):
+        """An out-of-range concrete slot is refused at the boundary: the
+        scatter bookkeeping would silently DROP while the cache writes
+        clamp into the last slot's K/V (round-4 advisor finding)."""
+        cfg, params, _ = setup
+        st = S.init_server_state(cfg, 2, 16)
+        prompt = jnp.arange(4, dtype=jnp.int32)
+        with pytest.raises(ValueError, match="slot"):
+            S.admit(params, st, prompt, jnp.int32(2))
+        with pytest.raises(ValueError, match="slot"):
+            S.admit(params, st, prompt, jnp.int32(-1))
+
+    def test_admit_clamps_traced_slot(self, setup):
+        """A TRACED out-of-range slot bypasses the wrapper; the jit
+        clamps it so scatter and cache writes agree on ONE in-range
+        slot (slot 1's stream is corrupted deterministically rather
+        than bookkeeping and cache diverging)."""
+        cfg, params, _ = setup
+        st = S.init_server_state(cfg, 2, 16)
+        prompt = jnp.arange(4, dtype=jnp.int32)
+
+        @jax.jit
+        def admit_traced(state, slot):
+            return S._admit(params, state, prompt, slot, None,
+                            jnp.int32(4), jnp.float32(0.0),
+                            jax.random.PRNGKey(0))
+
+        out = admit_traced(st, jnp.int32(7))
+        # Clamped to slot 1: its bookkeeping and cache BOTH moved.
+        assert bool(out["active"][1])
+        assert int(out["pos"][1]) == 4
+        assert not bool(out["active"][0])
+
     def test_serve_chunk_validates_temperature(self, setup):
         cfg, params, _ = setup
         st = S.init_server_state(cfg, 2, 16)

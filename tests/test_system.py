@@ -167,3 +167,38 @@ def test_per_level_log_files(tmp_path):
                 root.removeHandler(h)
                 h.close()
         root.setLevel(before_level)
+
+
+def test_configure_logging_idempotent(tmp_path):
+    """A second configure_logging call must not fan duplicate records
+    into the per-level files, and must not touch a host app's
+    pre-existing handlers (round-4 advisor finding)."""
+    import logging
+
+    from tpushare.cmd.main import configure_logging
+
+    root = logging.getLogger()
+    before = list(root.handlers)
+    before_level = root.level
+    host_handler = logging.StreamHandler()
+    host_handler.setLevel(logging.ERROR)
+    root.addHandler(host_handler)
+    try:
+        configure_logging("info", str(tmp_path))
+        configure_logging("info", str(tmp_path))  # reconfigure
+        log = logging.getLogger("tpushare.logtest2")
+        log.info("once-mark")
+        text = (tmp_path / "info.log").read_text()
+        assert text.count("once-mark") == 1  # no duplicate handlers
+        # The host app's handler keeps its own level untouched.
+        assert host_handler.level == logging.ERROR
+        # And a log-dir-less reconfigure removes the file handlers.
+        configure_logging("info", "")
+        assert not any(getattr(h, "_tpushare_level_file", False)
+                       for h in root.handlers)
+    finally:
+        for h in list(root.handlers):
+            if h not in before:
+                root.removeHandler(h)
+                h.close()
+        root.setLevel(before_level)

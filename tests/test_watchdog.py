@@ -1,0 +1,289 @@
+"""Grant-watchdog tests: usage heartbeats → gauges, Events, attribution,
+annotations, and opt-in eviction.
+
+The watchdog is the "verify" half of the trust + verify enforcement
+story (the fraction cap is measured-unenforced on TPU PJRT —
+COTENANCY_r04.json): these tests pin the full plugin/metric/Event path
+the round-4 verdict asked for (reference counterpart: the device
+plugin's runtime-contract role, docs/designs/designs.md:53-61).
+"""
+
+import json
+import time
+
+import pytest
+
+from tpushare.deviceplugin.watchdog import (
+    GIB, GrantWatchdog, REASON_EVICTED, REASON_OVERRUN, REASON_STARVED)
+from tpushare.k8s import events
+from tpushare.k8s.builders import make_pod
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.utils import const
+
+
+def _tenant(name, hbm, chip_ids, uid=None, node="host-a",
+            hbm_chip=16, phase="Running"):
+    """An ASSIGNED HBM-slice tenant resident on ``node``."""
+    return make_pod(
+        name, hbm=hbm, node_name=node, phase=phase, uid=uid or f"uid-{name}",
+        annotations={
+            const.ANN_CHIP_IDX: ",".join(str(c) for c in chip_ids),
+            const.ANN_HBM_POD: str(hbm),
+            const.ANN_HBM_CHIP: str(hbm_chip),
+            const.ANN_ASSIGNED: const.ASSIGNED_TRUE,
+            const.ANN_ASSUME_TIME: str(time.time_ns()),
+        })
+
+
+def _beat(tmp_path, uid, gib, peak_gib=None, ts=None):
+    doc = {"bytes_in_use": int(gib * GIB),
+           "peak_bytes": int((peak_gib if peak_gib is not None
+                              else gib) * GIB),
+           "ts": time.time() if ts is None else ts}
+    # per-pod subdirectory: the only piece of the usage dir a tenant
+    # can write (Allocate mounts usage_dir/<uid> alone)
+    (tmp_path / uid).mkdir(exist_ok=True)
+    (tmp_path / uid / "usage.json").write_text(json.dumps(doc))
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+def _watchdog(api, tmp_path, **kw):
+    return GrantWatchdog("host-a", api, usage_dir=str(tmp_path), **kw)
+
+
+def _event_reasons(api, name):
+    return [e["reason"] for _, e in api.events
+            if e["involvedObject"]["name"] == name]
+
+
+def test_within_grant_publishes_gauges_and_annotation(api, tmp_path):
+    api.create_pod(_tenant("good", 8, [0]))
+    _beat(tmp_path, "uid-good", 5.0)
+    wd = _watchdog(api, tmp_path)
+    doc = wd.sweep()
+    assert doc["overruns"] == []
+    [t] = doc["tenants"]
+    assert t["used_gib"] == 5.0 and t["granted_gib"] == 8
+    assert not t["overrun"]
+    g = wd.registry.get_sample_value(
+        "tpushare_hbm_used_gib",
+        {"namespace": "default", "pod": "good", "node": "host-a"})
+    assert g == 5.0
+    assert wd.registry.get_sample_value(
+        "tpushare_grant_overrun",
+        {"namespace": "default", "pod": "good", "node": "host-a"}) == 0
+    # used-vs-granted is apiserver-visible (inspect/kubectl read this)
+    pod = api.get_pod("default", "good")
+    assert pod.annotations[const.ANN_HBM_USED] == "5.0"
+    assert const.ANN_OVERRUN not in pod.annotations
+    assert events.flush()
+    assert _event_reasons(api, "good") == []
+
+
+def test_overrunner_named_and_innocent_attributed(api, tmp_path):
+    """The round-4 verdict's core demand: the overrunner is NAMED, and
+    the innocent co-tenant's (future) failure is attributed to it."""
+    api.create_pod(_tenant("hog", 4, [0]))
+    api.create_pod(_tenant("innocent", 7, [0]))
+    api.create_pod(_tenant("elsewhere", 7, [1]))  # other chip: no blame
+    _beat(tmp_path, "uid-hog", 10.0, peak_gib=11.0)
+    _beat(tmp_path, "uid-innocent", 6.0)
+    _beat(tmp_path, "uid-elsewhere", 6.0)
+    wd = _watchdog(api, tmp_path)
+    doc = wd.sweep()
+    [over] = doc["overruns"]
+    assert over["pod"] == "hog" and over["used_gib"] == 10.0
+    assert wd.registry.get_sample_value(
+        "tpushare_grant_overrun",
+        {"namespace": "default", "pod": "hog", "node": "host-a"}) == 1
+    assert events.flush()
+    assert _event_reasons(api, "hog") == [REASON_OVERRUN]
+    hog_ev = [e for _, e in api.events if e["reason"] == REASON_OVERRUN][0]
+    assert "10.0" in hog_ev["message"] and "4 GiB" in hog_ev["message"]
+    assert hog_ev["type"] == "Warning"
+    # the innocent co-tenant on chip 0 is told WHO is eating its HBM
+    assert _event_reasons(api, "innocent") == [REASON_STARVED]
+    starved = [e for _, e in api.events
+               if e["reason"] == REASON_STARVED][0]
+    assert "default/hog" in starved["message"]
+    # a tenant on another chip is not blamed/notified
+    assert _event_reasons(api, "elsewhere") == []
+    assert api.get_pod("default", "hog").annotations[
+        const.ANN_OVERRUN] == const.ASSIGNED_TRUE
+
+
+def test_overrun_event_fires_on_edge_only(api, tmp_path):
+    api.create_pod(_tenant("hog", 4, [0]))
+    _beat(tmp_path, "uid-hog", 10.0)
+    wd = _watchdog(api, tmp_path)
+    wd.sweep()
+    wd.sweep()  # still overrunning: no duplicate Warning
+    assert events.flush()
+    assert _event_reasons(api, "hog") == [REASON_OVERRUN]
+    # recovery clears the flag; a NEW overrun is a new episode
+    _beat(tmp_path, "uid-hog", 3.0)
+    wd.sweep()
+    assert const.ANN_OVERRUN not in api.get_pod(
+        "default", "hog").annotations
+    _beat(tmp_path, "uid-hog", 9.0)
+    wd.sweep()
+    assert events.flush()
+    assert _event_reasons(api, "hog") == [REASON_OVERRUN, REASON_OVERRUN]
+
+
+def test_stale_heartbeat_is_no_data(api, tmp_path):
+    """A dead process's last heartbeat says nothing about the chip NOW —
+    it must neither flag overrun nor keep a gauge alive."""
+    api.create_pod(_tenant("gone", 4, [0]))
+    _beat(tmp_path, "uid-gone", 10.0, ts=time.time() - 600)
+    wd = _watchdog(api, tmp_path)
+    doc = wd.sweep()
+    assert doc["overruns"] == []
+    [t] = doc["tenants"]
+    assert t["used_gib"] is None
+    assert wd.registry.get_sample_value(
+        "tpushare_hbm_used_gib",
+        {"namespace": "default", "pod": "gone", "node": "host-a"}) is None
+
+
+def test_stale_heartbeat_clears_stale_annotations(api, tmp_path):
+    """When the heartbeat dies, the pod's last usage/overrun claims are
+    withdrawn — inspect must not show a phantom overrun forever while
+    the Prometheus series is gone."""
+    api.create_pod(_tenant("hog", 4, [0]))
+    _beat(tmp_path, "uid-hog", 10.0)
+    wd = _watchdog(api, tmp_path, stale_after=0.5)
+    wd.sweep()
+    assert api.get_pod("default", "hog").annotations[
+        const.ANN_OVERRUN] == const.ASSIGNED_TRUE
+    time.sleep(0.6)  # heartbeat goes stale
+    wd.sweep()
+    ann = api.get_pod("default", "hog").annotations
+    assert const.ANN_OVERRUN not in ann
+    assert const.ANN_HBM_USED not in ann
+
+
+def test_opt_in_eviction_after_consecutive_sweeps(api, tmp_path):
+    api.create_pod(_tenant("hog", 4, [0]))
+    _beat(tmp_path, "uid-hog", 10.0)
+    wd = _watchdog(api, tmp_path, evict_after=3)
+    wd.sweep()
+    wd.sweep()
+    assert api.get_pod("default", "hog") is not None
+    # a dip resets the CONSECUTIVE counter (transient spikes don't kill)
+    _beat(tmp_path, "uid-hog", 3.0)
+    wd.sweep()
+    _beat(tmp_path, "uid-hog", 10.0)
+    for _ in range(3):
+        doc = wd.sweep()
+    assert doc["evicted"] == ["uid-hog"]
+    assert events.flush()
+    assert REASON_EVICTED in _event_reasons(api, "hog")
+    with pytest.raises(Exception):
+        api.get_pod("default", "hog")
+
+
+def test_default_policy_never_evicts(api, tmp_path):
+    api.create_pod(_tenant("hog", 4, [0]))
+    _beat(tmp_path, "uid-hog", 10.0)
+    wd = _watchdog(api, tmp_path)  # evict_after=0: observe only
+    for _ in range(10):
+        doc = wd.sweep()
+    assert doc["evicted"] == []
+    assert api.get_pod("default", "hog") is not None
+
+
+def test_series_gc_on_pod_removal(api, tmp_path):
+    api.create_pod(_tenant("brief", 8, [0]))
+    _beat(tmp_path, "uid-brief", 5.0)
+    wd = _watchdog(api, tmp_path)
+    wd.sweep()
+    assert wd.registry.get_sample_value(
+        "tpushare_hbm_used_gib",
+        {"namespace": "default", "pod": "brief", "node": "host-a"}) == 5.0
+    api.delete_pod("default", "brief")
+    wd.sweep()
+    assert wd.registry.get_sample_value(
+        "tpushare_hbm_used_gib",
+        {"namespace": "default", "pod": "brief", "node": "host-a"}) is None
+
+
+def test_render_exposition_format(api, tmp_path):
+    api.create_pod(_tenant("good", 8, [0]))
+    _beat(tmp_path, "uid-good", 5.0)
+    wd = _watchdog(api, tmp_path)
+    wd.sweep()
+    text = wd.render().decode()
+    assert "tpushare_hbm_used_gib" in text
+    assert 'pod="good"' in text
+
+
+def test_inspect_surfaces_used_vs_granted(api, tmp_path):
+    """The operator-facing join: watchdog annotation → inspect output."""
+    from tpushare.cache.cache import SchedulerCache
+    from tpushare.scheduler.inspect import Inspect
+    from tpushare.k8s.builders import make_node
+
+    api.create_node(make_node("host-a"))
+    api.create_pod(_tenant("hog", 4, [0]))
+    _beat(tmp_path, "uid-hog", 10.0)
+    _watchdog(api, tmp_path).sweep()
+    cache = SchedulerCache(api.get_node, api.list_pods)
+    cache.add_or_update_pod(api.get_pod("default", "hog"))
+    doc = Inspect(cache).handle("host-a")
+    [entry] = [p for c in doc["nodes"][0]["chips"] for p in c["pods"]]
+    assert entry["usedHBM"] == 4            # the ledger's priced grant
+    assert entry["reportedUsedHBM"] == "10.0"  # what the tenant admits
+    assert entry["overrun"] is True
+
+
+def test_allocate_injects_usage_contract(api, tmp_path):
+    """Allocate hands the tenant its heartbeat path + the dir mount."""
+    from tests.test_deviceplugin import _plugin
+
+    plugin = _plugin(api)
+    plugin.usage_dir = str(tmp_path)
+    t0 = time.time_ns()
+    api.create_pod(make_pod(
+        "slice", hbm=8, node_name="host-a", uid="uid-slice",
+        annotations={
+            const.ANN_CHIP_IDX: "0", const.ANN_HBM_POD: "8",
+            const.ANN_HBM_CHIP: "16",
+            const.ANN_ASSIGNED: const.ASSIGNED_FALSE,
+            const.ANN_ASSUME_TIME: str(t0)}))
+    alloc = plugin.allocate_hbm(["x"] * 8)
+    pod_dir = tmp_path / "uid-slice"
+    assert alloc.envs[const.ENV_USAGE_FILE] == str(pod_dir / "usage.json")
+    # only the pod's OWN subdir is mounted — a shared-dir mount would
+    # let a tenant forge its neighbors' heartbeats
+    assert alloc.mounts == ((str(pod_dir), str(pod_dir), False),)
+    assert pod_dir.is_dir()
+    # and the gRPC framing carries the mount to kubelet
+    from tpushare.deviceplugin.kubelet import _to_pb_allocation
+    resp = _to_pb_allocation(alloc)
+    [m] = list(resp.mounts)
+    assert m.host_path == str(pod_dir) and not m.read_only
+
+
+def test_jaxenv_write_usage(tmp_path, monkeypatch):
+    """Tenant-side heartbeat: snapshot → atomic file the watchdog reads
+    (snapshot stubbed: the CPU backend exposes no memory_stats)."""
+    from tpushare.runtime import jaxenv
+
+    target = tmp_path / "u" / "uid-x.json"
+    monkeypatch.setattr(
+        jaxenv, "usage_snapshot",
+        lambda: {"bytes_in_use": 3 * GIB, "peak_bytes": 4 * GIB,
+                 "ts": time.time(), "pid": 1})
+    env = {const.ENV_USAGE_FILE: str(target)}
+    snap = jaxenv.write_usage(environ=env)
+    assert snap["bytes_in_use"] == 3 * GIB
+    on_disk = json.loads(target.read_text())
+    assert on_disk["peak_bytes"] == 4 * GIB
+    # outside a tpushare pod: clean no-op
+    assert jaxenv.write_usage(environ={}) is None
+    assert jaxenv.start_usage_reporter(environ={}) is None

@@ -229,14 +229,20 @@ class PodDisruptionBudget(K8sObject):
     def matches(self, pod: Pod) -> bool:
         """Namespace + label-selector match. ``matchLabels`` and the
         ``In``/``NotIn``/``Exists``/``DoesNotExist`` operators of
-        ``matchExpressions`` are supported; a selector that is entirely
-        absent matches nothing (k8s treats an empty PDB selector as
-        select-all IN ITS NAMESPACE — mirrored here)."""
+        ``matchExpressions`` are supported. A nil-or-empty selector
+        matches NOTHING: the upstream scheduler's
+        filterPodsWithPDBViolation short-circuits on
+        ``selector.Empty()``, and since our recount exists to mirror
+        *that* count (not the eviction API's select-all-in-namespace
+        reading), we follow the scheduler's semantics so extender-
+        processed nodes are scored identically to the rest."""
         if pod.namespace != self.namespace:
             return False
         selector = self.spec.get("selector")
-        if selector is None:
-            return False  # no selector field at all: matches nothing
+        if not selector or (
+            not selector.get("matchLabels") and not selector.get("matchExpressions")
+        ):
+            return False  # nil-or-empty selector: matches nothing (scheduler semantics)
         labels = pod.labels
         for k, v in (selector.get("matchLabels") or {}).items():
             if labels.get(k) != v:

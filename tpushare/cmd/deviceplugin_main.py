@@ -14,6 +14,13 @@ Environment:
 * ``DEVICE_PLUGIN_PATH`` — kubelet plugin dir, default
   ``/var/lib/kubelet/device-plugins``.
 * ``TPU_ACCELERATOR_TYPE`` — discovery hint on Cloud TPU VMs.
+* ``TPUSHARE_USAGE_DIR``  — tenant heartbeat dir (hostPath), default
+  ``/var/run/tpushare/usage``; empty disables the grant watchdog.
+* ``TPUSHARE_EVICT_OVERRUN`` — "true" escalates persistent grant
+  overruns (3 consecutive sweeps) to pod eviction; default observe-only.
+* ``METRICS_PORT``        — serve the watchdog's Prometheus registry
+  (``tpushare_hbm_used_gib`` / ``tpushare_grant_overrun``) on this
+  port; 0/unset disables.
 """
 
 from __future__ import annotations
@@ -28,7 +35,9 @@ from tpushare.cmd.main import setup_signals
 from tpushare.deviceplugin import discovery
 from tpushare.deviceplugin.kubelet import (
     DEVICE_PLUGIN_PATH, KUBELET_SOCKET, run_node_daemon)
+from tpushare.deviceplugin.watchdog import GrantWatchdog
 from tpushare.k8s.client import ApiClient, ClusterConfig
+from tpushare.utils import const
 
 log = logging.getLogger(__name__)
 
@@ -58,8 +67,26 @@ def main() -> None:
     stop = threading.Event()
     setup_signals(stop)
 
+    usage_dir = os.environ.get("TPUSHARE_USAGE_DIR",
+                               const.USAGE_DIR_DEFAULT)
     servers = run_node_daemon(node_name, client, inventory,
-                              plugin_dir=plugin_dir)
+                              plugin_dir=plugin_dir, usage_dir=usage_dir)
+    watchdog = None
+    if usage_dir:
+        os.makedirs(usage_dir, exist_ok=True)
+        evict = (os.environ.get("TPUSHARE_EVICT_OVERRUN", "")
+                 .lower() == "true")
+        watchdog = GrantWatchdog(
+            node_name, client, usage_dir=usage_dir,
+            evict_after=int(os.environ.get(
+                "TPUSHARE_EVICT_AFTER_SWEEPS", "3")) if evict else 0)
+        threading.Thread(target=watchdog.run, args=(stop,),
+                         name="tpushare-grant-watchdog",
+                         daemon=True).start()
+        metrics_port = int(os.environ.get("METRICS_PORT", "0"))
+        if metrics_port:
+            from prometheus_client import start_http_server
+            start_http_server(metrics_port, registry=watchdog.registry)
     kubelet_sock = os.path.join(plugin_dir, KUBELET_SOCKET)
     kubelet_ino = _inode(kubelet_sock)
     while not stop.wait(3.0):
@@ -77,7 +104,8 @@ def main() -> None:
                 for server in servers:
                     server.stop()
                 servers = run_node_daemon(node_name, client, inventory,
-                                          plugin_dir=plugin_dir)
+                                          plugin_dir=plugin_dir,
+                                          usage_dir=usage_dir)
 
     for server in servers:
         server.stop()

@@ -158,23 +158,44 @@ def configure_logging(level_name: str | None = None,
     root_level = getattr(logging, level, logging.INFO)
     fmt = logging.Formatter(
         "%(asctime)s %(levelname)s %(name)s: %(message)s")
+    root = logging.getLogger()
+    had_handlers = bool(root.handlers)
     logging.basicConfig(level=root_level,
                         format="%(asctime)s %(levelname)s %(name)s: "
                                "%(message)s")
+    if not had_handlers:
+        # basicConfig just installed the console handler — tag it so a
+        # re-configure can recognize it as ours. A host app's or test
+        # runner's pre-existing handlers are never touched.
+        for handler in root.handlers:
+            handler._tpushare_console = True
     log_dir = log_dir if log_dir is not None else os.environ.get(
         "LOG_DIR", "")
+    # Idempotency: drop any per-level file handlers a previous call
+    # added before (re-)adding, so repeated configure_logging() calls
+    # (tests, embedding apps) never fan duplicates into the files.
+    for handler in list(root.handlers):
+        if getattr(handler, "_tpushare_level_file", False):
+            root.removeHandler(handler)
+            handler.close()
     if not log_dir:
         return
     os.makedirs(log_dir, exist_ok=True)
-    root = logging.getLogger()
     # Effective level must admit every file's records even when the
     # console is quieter (beego wrote debug.log regardless of console
     # verbosity; mirrored: LOG_DIR implies full-fidelity files).
     root.setLevel(min(root_level, logging.DEBUG))
     for handler in root.handlers:
-        if isinstance(handler, logging.StreamHandler) and not isinstance(
-                handler, logging.FileHandler):
+        if getattr(handler, "_tpushare_console", False):
             handler.setLevel(root_level)  # console keeps LOG_LEVEL
+        elif (isinstance(handler, logging.StreamHandler)
+              and not isinstance(handler, logging.FileHandler)
+              and handler.level == logging.NOTSET):
+            # A host app's NOTSET stream handler would suddenly emit
+            # DEBUG once we drop the root level for the files — clamp
+            # it to LOG_LEVEL. Handlers with an explicitly-set level
+            # are left alone (the round-4 advisor's complaint).
+            handler.setLevel(root_level)
     # One file per severity, each holding EXACTLY that level — beego's
     # AdapterMultiFile `separate` semantics (nvidia.error.log holds the
     # errors, not three copies of every error across files).
@@ -186,6 +207,7 @@ def configure_logging(level_name: str | None = None,
         fh.setLevel(lvl)
         fh.addFilter(lambda rec, lv=lvl: rec.levelno == lv)
         fh.setFormatter(fmt)
+        fh._tpushare_level_file = True
         root.addHandler(fh)
 
 

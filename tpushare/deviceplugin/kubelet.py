@@ -139,6 +139,10 @@ def _to_pb_allocation(alloc) -> pb.ContainerAllocateResponse:
     for host_path, container_path in alloc.devices:
         resp.devices.add(host_path=host_path, container_path=container_path,
                          permissions="rw")
+    for host_path, container_path, read_only in getattr(
+            alloc, "mounts", ()):
+        resp.mounts.add(host_path=host_path, container_path=container_path,
+                        read_only=read_only)
     for k, v in alloc.annotations.items():
         resp.annotations[k] = v
     return resp
@@ -287,11 +291,13 @@ class PluginServer:
 def run_node_daemon(node_name: str, client, inventory,
                     plugin_dir: str = DEVICE_PLUGIN_PATH,
                     kubelet_socket: str | None = None,
-                    poll_interval: float = 5.0) -> list[PluginServer]:
+                    poll_interval: float = 5.0,
+                    usage_dir: str = const.USAGE_DIR_DEFAULT,
+                    ) -> list[PluginServer]:
     """Full node bootstrap: annotate the node, then advertise both
     resources (the daemon entrypoint wires discovery into this)."""
     plugin = TPUSharePlugin(node_name, client, inventory,
-                            state_dir=plugin_dir)
+                            state_dir=plugin_dir, usage_dir=usage_dir)
     plugin.annotate_node()
     servers = []
     for resource in (const.HBM_RESOURCE, const.CHIP_RESOURCE):

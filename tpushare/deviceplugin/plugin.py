@@ -63,6 +63,9 @@ class ContainerAllocation:
     envs: dict[str, str]
     devices: tuple[tuple[str, str], ...]  # (host_path, container_path)
     annotations: dict[str, str]
+    #: (host_path, container_path, read_only) — the usage-heartbeat dir
+    #: rides in here so the tenant can write where the watchdog reads.
+    mounts: tuple[tuple[str, str, bool], ...] = ()
 
 
 class AllocateError(Exception):
@@ -74,11 +77,15 @@ class TPUSharePlugin:
 
     def __init__(self, node_name: str, client, inventory: HostInventory,
                  headroom: float | None = None,
-                 state_dir: str | None = None):
+                 state_dir: str | None = None,
+                 usage_dir: str = const.USAGE_DIR_DEFAULT):
         self.node_name = node_name
         self.client = client
         self.inventory = inventory
         self.headroom = headroom
+        #: Heartbeat directory injected into HBM-slice tenants (empty
+        #: string disables the usage contract entirely).
+        self.usage_dir = usage_dir
         #: uid -> container grant sizes served so far (HBM GiB or chip
         #: counts, per resource). kubelet calls Allocate once per
         #: CONTAINER, so a multi-container pod is matched container by
@@ -517,8 +524,23 @@ class TPUSharePlugin:
                         else jaxenv.DEFAULT_HEADROOM)
             fraction = round(hbm_pod / hbm_chip * headroom, 3)
             envs[const.ENV_XLA_MEM_FRACTION] = str(fraction)
+        mounts: tuple[tuple[str, str, bool], ...] = ()
+        if self.usage_dir and not whole_chips:
+            # The verify half of trust + verify (the fraction cap is
+            # measured-unenforced): tell the tenant where to heartbeat
+            # its memory_stats so the GrantWatchdog can compare against
+            # THIS grant. Each pod gets ONLY ITS OWN subdirectory
+            # mounted (same path inside and out) — mounting the shared
+            # dir would let any tenant forge or destroy its neighbors'
+            # heartbeats, i.e. frame an innocent pod as the overrunner.
+            pod_dir = os.path.join(self.usage_dir, pod.uid)
+            os.makedirs(pod_dir, exist_ok=True)
+            envs[const.ENV_USAGE_FILE] = os.path.join(pod_dir,
+                                                      "usage.json")
+            mounts = ((pod_dir, pod_dir, False),)
         log.info("allocated chips %s (%d GiB) to pod %s",
                  chip_ids, hbm_pod, pod.key())
         return ContainerAllocation(
             envs=envs, devices=self._device_nodes(chip_ids),
-            annotations={const.ANN_CHIP_IDX: ",".join(map(str, chip_ids))})
+            annotations={const.ANN_CHIP_IDX: ",".join(map(str, chip_ids))},
+            mounts=mounts)

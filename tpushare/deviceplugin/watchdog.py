@@ -1,0 +1,316 @@
+"""Grant watchdog: per-tenant HBM usage vs. granted — trust + VERIFY.
+
+Why this exists (measured, not assumed): ``XLA_PYTHON_CLIENT_MEM_FRACTION``
+is NOT enforced by the TPU PJRT client (``COTENANCY_r04.json``
+``fraction_cap.runtime_enforced: false`` — a 4-GiB-grant tenant allocated
+10 GiB and ran). Enforcement is therefore the scheduler ledger plus
+cooperative sizing, and "containment" means the *next* allocation on the
+chip fails — which belongs to whichever innocent tenant asks next, not to
+the overrunner. Without telemetry an overrun is invisible and the failure
+is mis-attributed.
+
+This module is the node-local verify half, extending the device plugin's
+runtime-contract role (reference ``docs/designs/designs.md:53-61`` — the
+component that owns what actually happens on the node — and the env
+convention the workload honors, ``docs/userguide.md:56-77``):
+
+* tenants heartbeat their PJRT ``memory_stats()`` into a per-pod JSON
+  file (:func:`tpushare.runtime.jaxenv.start_usage_reporter`; the path is
+  injected by Allocate as ``TPUSHARE_USAGE_FILE`` over a hostPath mount);
+* :class:`GrantWatchdog` sweeps the heartbeats, compares each tenant
+  against its granted GiB (the pod annotation the extender committed),
+  and publishes three ways:
+
+  - **Prometheus** — ``tpushare_hbm_used_gib{namespace,pod,node}`` and
+    ``tpushare_grant_overrun{namespace,pod,node}`` (0/1) on the plugin's
+    own registry;
+  - **apiserver** — ``tpushare.io/hbm-used`` / ``tpushare.io/grant-overrun``
+    pod annotations (apiserver-as-store; the extender's inspect and any
+    ``kubectl get pod -o yaml`` user see used-vs-granted), plus a Warning
+    Event *naming the overrunner* and — on every innocent co-tenant of
+    the overrun chip — an Event attributing future allocation failures
+    to the overrunner by name;
+  - **policy** — opt-in eviction (``evict_after`` consecutive overrun
+    sweeps) for fleets that want the overrunner, not its victims, to die.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+from tpushare.api.objects import Pod
+from tpushare.k8s import events
+from tpushare.k8s.errors import ConflictError
+from tpushare.utils import const, pod as podutils
+
+log = logging.getLogger(__name__)
+
+REASON_OVERRUN = "TPUShareGrantOverrun"
+REASON_STARVED = "TPUShareStarvedByCoTenant"
+REASON_EVICTED = "TPUShareOverrunEvicted"
+
+GIB = 1 << 30
+
+#: Heartbeats older than this are liveness-stale: the process restarted
+#: or died, and its last-written bytes say nothing about the chip NOW.
+STALE_AFTER_S = 120.0
+
+
+class GrantWatchdog:
+    """Node-local used-vs-granted comparator (runs in the device-plugin
+    daemon next to the allocator whose grants it verifies)."""
+
+    def __init__(self, node_name: str, client,
+                 usage_dir: str = const.USAGE_DIR_DEFAULT,
+                 evict_after: int = 0,
+                 stale_after: float = STALE_AFTER_S,
+                 registry: CollectorRegistry | None = None,
+                 now=time.time):
+        self.node_name = node_name
+        self.client = client
+        self.usage_dir = usage_dir
+        #: 0 disables eviction (default: observe + attribute only);
+        #: N>0 evicts after N CONSECUTIVE overrun sweeps — a single
+        #: transient spike (compile-time temp buffers) never kills.
+        self.evict_after = evict_after
+        self.stale_after = stale_after
+        self.now = now
+        self.registry = registry or CollectorRegistry()
+        self._used = Gauge(
+            "tpushare_hbm_used_gib",
+            "Tenant-reported HBM in use (GiB), from the PJRT heartbeat",
+            ["namespace", "pod", "node"], registry=self.registry)
+        self._overrun = Gauge(
+            "tpushare_grant_overrun",
+            "1 while the tenant's reported usage exceeds its granted GiB",
+            ["namespace", "pod", "node"], registry=self.registry)
+        #: uid -> consecutive overrun sweep count (eviction counter and
+        #: edge detector: events fire on the 0->1 transition only).
+        self._over_streak: dict[str, int] = {}
+        #: label sets currently exported, for series GC when pods vanish.
+        self._series: set[tuple[str, str]] = set()
+
+    # ------------------------------------------------------------------ #
+    # One sweep
+    # ------------------------------------------------------------------ #
+
+    def sweep(self) -> dict:
+        """Read every tenant heartbeat, publish, return a summary doc
+        (the doc is what cochipcheck records in its artifact)."""
+        pods = [p for p in self.client.list_pods(node_name=self.node_name)
+                if p.node_name == self.node_name
+                and podutils.is_assigned_non_terminated(p)]
+        tenants: list[dict] = []
+        overruns: list[dict] = []
+        live_series: set[tuple[str, str]] = set()
+        for pod in pods:
+            granted = podutils.pod_used_hbm(pod)
+            if granted <= 0:
+                continue  # whole-chip / non-HBM pods own their chips
+            snap = self._read_heartbeat(pod.uid)
+            entry = {
+                "namespace": pod.namespace, "pod": pod.name,
+                "uid": pod.uid, "granted_gib": granted,
+                "chips": podutils.get_chip_ids_from_annotation(pod),
+            }
+            if snap is None:
+                entry["used_gib"] = None  # no (fresh) heartbeat
+                self._over_streak.pop(pod.uid, None)
+                # A stale/absent heartbeat says nothing about NOW: the
+                # gauges are GC'd below, and the pod's last-written
+                # usage/overrun annotations must go too — otherwise
+                # inspect shows a phantom overrun forever while the
+                # Prometheus series is gone.
+                self._clear_annotations(pod)
+                tenants.append(entry)
+                continue
+            used_gib = snap["bytes_in_use"] / GIB
+            peak_gib = snap.get("peak_bytes", snap["bytes_in_use"]) / GIB
+            entry["used_gib"] = round(used_gib, 2)
+            entry["peak_gib"] = round(peak_gib, 2)
+            over = used_gib > granted
+            entry["overrun"] = over
+            labels = (pod.namespace, pod.name)
+            live_series.add(labels)
+            self._used.labels(pod.namespace, pod.name,
+                              self.node_name).set(round(used_gib, 3))
+            self._overrun.labels(pod.namespace, pod.name,
+                                 self.node_name).set(1 if over else 0)
+            streak = self._over_streak.get(pod.uid, 0)
+            if over:
+                self._over_streak[pod.uid] = streak + 1
+                if streak == 0:  # edge: entered overrun this sweep
+                    self._emit_overrun(pod, used_gib, peak_gib, granted,
+                                       pods)
+            else:
+                self._over_streak.pop(pod.uid, None)
+            self._annotate(pod, used_gib, over)
+            tenants.append(entry)
+            if over:
+                overruns.append(entry)
+        evicted = self._maybe_evict(pods)
+        self._gc_series(live_series)
+        return {"node": self.node_name, "tenants": tenants,
+                "overruns": overruns, "evicted": evicted}
+
+    def run(self, stop: threading.Event, interval: float = 10.0) -> None:
+        """Sweep loop for the daemon (observability must never crash the
+        allocator: every sweep error is logged and retried)."""
+        while not stop.wait(interval):
+            try:
+                self.sweep()
+            except Exception:  # noqa: BLE001
+                log.exception("grant-watchdog sweep failed")
+
+    def render(self) -> bytes:
+        """Prometheus exposition of this plugin's watchdog registry."""
+        return generate_latest(self.registry)
+
+    # ------------------------------------------------------------------ #
+    # Pieces
+    # ------------------------------------------------------------------ #
+
+    def usage_path(self, uid: str) -> str:
+        # Per-pod subdirectory: Allocate mounts only usage_dir/<uid>
+        # into the tenant, so no tenant can write (or delete) another's
+        # heartbeat and frame it as the overrunner.
+        return os.path.join(self.usage_dir, uid, "usage.json")
+
+    def _read_heartbeat(self, uid: str) -> dict | None:
+        try:
+            with open(self.usage_path(uid), encoding="utf-8") as f:
+                snap = json.load(f)
+            if self.now() - float(snap.get("ts", 0)) > self.stale_after:
+                return None  # dead/restarted process: says nothing NOW
+            return {"bytes_in_use": int(snap["bytes_in_use"]),
+                    "peak_bytes": int(snap.get("peak_bytes",
+                                               snap["bytes_in_use"])),
+                    "ts": float(snap.get("ts", 0))}
+        except (OSError, ValueError, TypeError, KeyError):
+            return None
+
+    def _emit_overrun(self, pod: Pod, used: float, peak: float,
+                      granted: int, pods: list[Pod]) -> None:
+        """Warning on the overrunner, attribution on every innocent
+        co-tenant sharing a chip with it — so when the innocent party's
+        next allocation fails, ``kubectl describe`` already names the
+        actual culprit."""
+        chips = set(podutils.get_chip_ids_from_annotation(pod))
+        events.record(
+            self.client, pod, REASON_OVERRUN,
+            f"HBM grant overrun: using {used:.1f} GiB "
+            f"(peak {peak:.1f}) of {granted} GiB granted on "
+            f"chip(s) {sorted(chips)} — the runtime does not enforce "
+            f"the fraction cap; co-tenant allocations on these chips "
+            f"may fail because of this pod", event_type="Warning")
+        log.warning("grant overrun: %s using %.1f GiB of %d granted",
+                    pod.key(), used, granted)
+        for other in pods:
+            if other.uid == pod.uid:
+                continue
+            if podutils.pod_used_hbm(other) <= 0:
+                continue
+            shared = chips & set(
+                podutils.get_chip_ids_from_annotation(other))
+            if not shared:
+                continue
+            events.record(
+                self.client, other, REASON_STARVED,
+                f"co-tenant {pod.namespace}/{pod.name} exceeds its HBM "
+                f"grant ({used:.1f} of {granted} GiB) on shared chip(s) "
+                f"{sorted(shared)}; allocation failures on this pod are "
+                f"attributable to it", event_type="Warning")
+
+    def _annotate(self, pod: Pod, used_gib: float, over: bool) -> None:
+        """Publish used-vs-granted onto the pod (apiserver-as-store).
+        Write only on real change — a 10 s sweep writing every pod every
+        time would be an apiserver update storm from every node."""
+        want_used = f"{used_gib:.1f}"
+        want_over = const.ASSIGNED_TRUE if over else None
+        have_used = pod.annotations.get(const.ANN_HBM_USED)
+        have_over = pod.annotations.get(const.ANN_OVERRUN)
+        if have_used == want_used and have_over == want_over:
+            return
+        try:
+            fresh = self.client.get_pod(pod.namespace, pod.name)
+            if fresh is None or fresh.uid != pod.uid:
+                return
+            ann = fresh.raw.setdefault("metadata", {}).setdefault(
+                "annotations", {})
+            ann[const.ANN_HBM_USED] = want_used
+            if over:
+                ann[const.ANN_OVERRUN] = const.ASSIGNED_TRUE
+            else:
+                ann.pop(const.ANN_OVERRUN, None)
+            self.client.update_pod(fresh)
+        except ConflictError:
+            pass  # next sweep retries with a fresh read
+        except Exception:  # noqa: BLE001 - telemetry never breaks the node
+            log.debug("usage annotation update failed for %s", pod.key(),
+                      exc_info=True)
+
+    def _clear_annotations(self, pod: Pod) -> None:
+        """Remove stale usage claims from a pod with no fresh heartbeat."""
+        if (const.ANN_HBM_USED not in pod.annotations
+                and const.ANN_OVERRUN not in pod.annotations):
+            return
+        try:
+            fresh = self.client.get_pod(pod.namespace, pod.name)
+            if fresh is None or fresh.uid != pod.uid:
+                return
+            ann = fresh.raw.setdefault("metadata", {}).setdefault(
+                "annotations", {})
+            ann.pop(const.ANN_HBM_USED, None)
+            ann.pop(const.ANN_OVERRUN, None)
+            self.client.update_pod(fresh)
+        except ConflictError:
+            pass  # next sweep retries
+        except Exception:  # noqa: BLE001 - telemetry never breaks the node
+            log.debug("stale-usage annotation clear failed for %s",
+                      pod.key(), exc_info=True)
+
+    def _maybe_evict(self, pods: list[Pod]) -> list[str]:
+        """Opt-in escalation: after ``evict_after`` CONSECUTIVE overrun
+        sweeps, delete the overrunner so the chip's HBM goes back to the
+        tenants that honor their grants."""
+        if self.evict_after <= 0:
+            return []
+        evicted = []
+        by_uid = {p.uid: p for p in pods}
+        for uid, streak in list(self._over_streak.items()):
+            if streak < self.evict_after:
+                continue
+            pod = by_uid.get(uid)
+            if pod is None:
+                self._over_streak.pop(uid, None)
+                continue
+            events.record(
+                self.client, pod, REASON_EVICTED,
+                f"evicting: HBM grant overrun persisted for {streak} "
+                f"consecutive sweeps (policy TPUSHARE_EVICT_OVERRUN)",
+                event_type="Warning")
+            try:
+                self.client.delete_pod(pod.namespace, pod.name)
+                evicted.append(pod.uid)
+                log.warning("evicted overrunning pod %s", pod.key())
+            except Exception:  # noqa: BLE001
+                log.exception("eviction of %s failed", pod.key())
+            self._over_streak.pop(uid, None)
+        return evicted
+
+    def _gc_series(self, live: set[tuple[str, str]]) -> None:
+        """Drop gauge series for pods that vanished, so a deleted
+        tenant's last value doesn't freeze on the scrape forever."""
+        for namespace, name in self._series - live:
+            try:
+                self._used.remove(namespace, name, self.node_name)
+                self._overrun.remove(namespace, name, self.node_name)
+            except KeyError:
+                pass
+        self._series = live

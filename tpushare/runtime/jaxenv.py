@@ -29,7 +29,10 @@ Call :func:`configure` BEFORE importing jax (it only sets env vars).
 from __future__ import annotations
 
 import dataclasses
+import json
 import os
+import threading
+import time
 
 from tpushare.utils import const
 
@@ -158,3 +161,94 @@ def configure(environ=None, headroom: float = DEFAULT_HEADROOM) -> ShareGrant | 
         fraction = round(grant.mem_fraction * headroom, 3)
         env.setdefault(const.ENV_XLA_MEM_FRACTION, str(fraction))
     return grant
+
+
+# --------------------------------------------------------------------- #
+# Usage reporting (the "verify" half of trust + verify)
+# --------------------------------------------------------------------- #
+# The fraction cap is measured-unenforced (COTENANCY_r04.json), so the
+# scheduler ledger is the only enforcement — and an overrunning tenant
+# is invisible until an INNOCENT co-tenant's next allocation fails.
+# Closing that gap needs the tenant to tell the node what it actually
+# uses: a heartbeat file (path injected by the device plugin as
+# TPUSHARE_USAGE_FILE, backed by a hostPath mount) carrying the PJRT
+# client's memory stats. The device plugin's GrantWatchdog reads every
+# tenant's heartbeat, compares against the checkpointed grant, exports
+# used-vs-granted gauges, and names the overrunner in a Warning Event.
+
+def usage_snapshot() -> dict | None:
+    """Current HBM usage of this process SUMMED over its local devices,
+    from the PJRT client's ``memory_stats()`` (None when the backend
+    exposes none — CPU does not; TPU does). Summing matters: a grant
+    can span chips (``ANN_CHIP_IDX`` "0,1"), and reporting only device
+    0 would hide an overrun living on device 1."""
+    import jax
+
+    try:
+        devices = jax.local_devices()
+    except RuntimeError:
+        return None
+    in_use = peak = limit = 0
+    seen = False
+    for dev in devices:
+        stats = dev.memory_stats()
+        if not stats:
+            continue
+        seen = True
+        in_use += int(stats.get("bytes_in_use", 0))
+        peak += int(stats.get("peak_bytes_in_use",
+                              stats.get("bytes_in_use", 0)))
+        limit += int(stats.get("bytes_limit", 0))
+    if not seen:
+        return None
+    return {
+        "bytes_in_use": in_use,
+        "peak_bytes": peak,
+        "bytes_limit": limit,
+        "ts": time.time(),
+        "pid": os.getpid(),
+    }
+
+
+def write_usage(path: str | None = None, environ=None) -> dict | None:
+    """One heartbeat: snapshot → atomic write to ``path`` (default: the
+    injected ``TPUSHARE_USAGE_FILE``). No-op (None) outside a tpushare
+    pod or on a statless backend — callers may invoke unconditionally."""
+    env = os.environ if environ is None else environ
+    path = path or env.get(const.ENV_USAGE_FILE, "")
+    if not path:
+        return None
+    snap = usage_snapshot()
+    if snap is None:
+        return None
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(snap, f)
+        os.replace(tmp, path)  # atomic: the watchdog never reads a torn file
+    except OSError:
+        return None
+    return snap
+
+
+def start_usage_reporter(interval: float = 5.0, path: str | None = None,
+                         environ=None) -> threading.Thread | None:
+    """Daemon thread heartbeating :func:`write_usage` every ``interval``
+    seconds. Returns None (no thread) outside a tpushare pod. Call once
+    after jax is initialized; the thread dies with the process — a
+    stale heartbeat is the watchdog's liveness signal, not a leak."""
+    env = os.environ if environ is None else environ
+    target = path or env.get(const.ENV_USAGE_FILE, "")
+    if not target:
+        return None
+
+    def _beat() -> None:
+        while True:
+            write_usage(target, environ=env)
+            time.sleep(interval)
+
+    t = threading.Thread(target=_beat, name="tpushare-usage-reporter",
+                         daemon=True)
+    t.start()
+    return t

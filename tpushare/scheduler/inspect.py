@@ -56,6 +56,17 @@ class Inspect:
                 scoring = p.annotations.get(const.ANN_SCORING)
                 if scoring:
                     entry["scoring"] = scoring
+                # Watchdog telemetry (apiserver-as-store): what the
+                # tenant REPORTS using vs. the grant the ledger priced —
+                # the operator-visible "verify" half of trust + verify
+                # (the fraction cap is measured-unenforced, so the
+                # ledger's usedHBM alone can hide an overrun).
+                reported = p.annotations.get(const.ANN_HBM_USED)
+                if reported is not None:
+                    entry["reportedUsedHBM"] = reported
+                if p.annotations.get(const.ANN_OVERRUN) == \
+                        const.ASSIGNED_TRUE:
+                    entry["overrun"] = True
                 gang = p.annotations.get(const.ANN_POD_GROUP)
                 if gang:
                     entry["gang"] = gang

@@ -114,10 +114,17 @@ class Prioritize:
             # node emptiness breaks the tie and fans load across hosts;
             # int() rather than round() keeps the secondary term from
             # erasing itself at the top of the scale.
-            best = max((f - req) / c for f, c in fits if c)
-            emptiness = statistics.fmean(
-                avail[i] / info.chips[i].total_hbm
-                for i in avail if info.chips[i].total_hbm)
+            # Degenerate zero-capacity chips (possible only with a req-0
+            # pod on a malformed node) would make max()/fmean() throw on
+            # empty input and 500 the verb — filter them and score 0,
+            # mirroring the binpack branch's cap==0 guard.
+            nz_fits = [(f, c) for f, c in fits if c]
+            nz_caps = [(avail[i], info.chips[i].total_hbm)
+                       for i in avail if info.chips[i].total_hbm]
+            if not nz_fits or not nz_caps:
+                return 0
+            best = max((f - req) / c for f, c in nz_fits)
+            emptiness = statistics.fmean(f / c for f, c in nz_caps)
             score = int(MAX_SCORE * (0.8 * best + 0.2 * emptiness))
         if gang_nodes and info.name in gang_nodes and score < MAX_SCORE:
             score += 1  # consolidate gang slices onto fewer hosts

@@ -155,6 +155,26 @@ ENV_POD_GROUP_SIZE = "TPUSHARE_POD_GROUP_SIZE"
 #: usually the group's rank-0 headless-service DNS name.
 ENV_COORDINATOR = "TPUSHARE_COORDINATOR"
 
+#: Where the tenant process writes its HBM-usage heartbeat (JSON file;
+#: injected per container by the device plugin, which mounts the node's
+#: usage dir read-write). Consumed by runtime.jaxenv's usage reporter;
+#: read back by the device plugin's grant watchdog.
+ENV_USAGE_FILE = "TPUSHARE_USAGE_FILE"
+
+#: Node-local directory holding per-pod usage heartbeats (hostPath in
+#: the DaemonSet manifest; mounted into tenant containers at the same
+#: path so ENV_USAGE_FILE is valid on both sides of the boundary).
+USAGE_DIR_DEFAULT = "/var/run/tpushare/usage"
+
+#: Watchdog-reported HBM usage (GiB, one decimal) written onto the POD
+#: by the device plugin's grant watchdog — apiserver-as-store, like
+#: every other piece of tpushare state, so the extender's inspect and
+#: any kubectl user see used-vs-granted without a side channel.
+ANN_HBM_USED = "tpushare.io/hbm-used"
+
+#: "true" on a pod the watchdog currently observes above its grant.
+ANN_OVERRUN = "tpushare.io/grant-overrun"
+
 #: Value used for ANN_ASSIGNED.
 ASSIGNED_FALSE = "false"
 ASSIGNED_TRUE = "true"

@@ -252,6 +252,18 @@ def admit(params: dict, state: dict, prompt: jax.Array,
     its stream samples per-slot via ``serve_chunk``'s vector."""
     Lp = prompt.shape[0]
     max_len = state["cache"][0]["k"].shape[1]
+    slots = state["pos"].shape[0]
+    if not isinstance(slot, jax.core.Tracer):
+        # Same boundary discipline as true_len: a concrete out-of-range
+        # slot inside the jit would make the .at[slot].set bookkeeping
+        # silently DROP (scatter OOB default) while the
+        # dynamic_update_slice cache writes CLAMP into slot slots-1,
+        # corrupting that slot's K/V mid-stream with no state change.
+        s = int(slot)
+        if not 0 <= s < slots:
+            raise ValueError(
+                f"slot {s} outside [0, {slots}) — an out-of-range slot "
+                f"would silently corrupt slot {slots - 1}'s cache")
     if Lp > max_len:
         raise ValueError(
             f"prompt length {Lp} exceeds cache max_len {max_len}")
@@ -312,6 +324,12 @@ def _admit(params: dict, state: dict, prompt: jax.Array,
         attn_fn = M.causal_attention
     Lp = prompt.shape[0]
     max_len = state["cache"][0]["k"].shape[1]
+    # A TRACED slot bypasses the wrapper's concrete check; clamp so the
+    # scatter (.at[slot].set) and the dynamic_update_slice cache writes
+    # agree on ONE in-range slot instead of the scatter dropping while
+    # the slice write clamps into a different slot's rows.
+    slot = jnp.clip(jnp.asarray(slot, jnp.int32), 0,
+                    state["pos"].shape[0] - 1)
     # A TRACED true_len bypasses the wrapper's concrete checks; defend
     # structurally instead of corrupting: clamp into the prompt, and
     # admit a no-decode-room request INERT (active=False — it emits
