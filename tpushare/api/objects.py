@@ -129,6 +129,13 @@ class Pod(K8sObject):
         return self.spec.get("nodeName", "")
 
     @property
+    def nominated_node_name(self) -> str:
+        """``status.nominatedNodeName`` — set by the kube-scheduler after
+        a successful preemption round; the capacity its victims free is
+        earmarked for this pod until it binds."""
+        return self.status.get("nominatedNodeName", "")
+
+    @property
     def phase(self) -> str:
         return self.status.get("phase", "")
 

@@ -95,12 +95,23 @@ class Controller:
 
     def _on_pod_update(self, old: Pod | None, new: Pod) -> None:
         """Enqueue iff the update changes ledger state: a known pod that
-        completed, or an unknown pod that acquired a chip assignment
-        (reference controller.go:257-305)."""
+        completed, an unknown pod that acquired a chip assignment
+        (reference controller.go:257-305), or a nomination transition —
+        the scheduler setting/clearing ``status.nominatedNodeName``
+        after a preemption round (that earmark gates OTHER pods'
+        admission, so the cache must learn it promptly)."""
         known = self.cache.known_pod(new.uid)
         if known and podutils.is_complete_pod(new):
             self.queue.add(new.key())
         elif not known and podutils.is_assumed(new) and new.node_name:
+            self.queue.add(new.key())
+        elif new.nominated_node_name != (
+                old.nominated_node_name if old is not None else ""):
+            self.queue.add(new.key())
+        elif new.nominated_node_name and podutils.is_complete_pod(new):
+            # A nominated pod that dies while still pending (its
+            # nomination string unchanged) must still sync, or its
+            # earmark blocks admission on that node forever.
             self.queue.add(new.key())
 
     def _on_pod_delete(self, pod: Pod) -> None:
@@ -138,6 +149,10 @@ class Controller:
             log.info("sync: pod %s complete, freed its HBM", key)
         elif podutils.is_assumed(pod) and pod.node_name:
             self.cache.add_or_update_pod(pod)
+        else:
+            # Pending: track (or drop) its preemption nomination so the
+            # eviction→bind window is honored by admission.
+            self.cache.note_nominated(pod)
 
     def _maybe_reap_gang(self, dead: Pod) -> None:
         """Whole-gang reclamation: an ASSIGNED gang member died mid-run

@@ -121,13 +121,17 @@ class Predicate:
 
     def filter_node(self, pod, node_name: str) -> tuple[bool, str]:
         """The per-node admission check (reference
-        gpushare-predicate.go:16-37)."""
+        gpushare-predicate.go:16-37), run with higher-or-equal-priority
+        NOMINATED pods assumed present (upstream scheduler semantics) —
+        capacity a preemptor's victims freed stays earmarked for it
+        until it binds."""
         info = self.cache.get_node_info(node_name)
         if info is None:
             return False, f"unknown node {node_name}"
         if not nodeutils.is_tpu_sharing_node(info.node):
             return False, f"node {node_name} advertises no shareable TPU HBM"
-        ok, reason = info.assume(pod)
+        ok, reason = info.assume(pod,
+                                 nominated=self.cache.nominated_on(node_name))
         return ok, reason
 
     def handle(self, args: ExtenderArgs) -> ExtenderFilterResult:

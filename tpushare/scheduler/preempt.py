@@ -56,7 +56,7 @@ from tpushare.api.extender import (ExtenderPreemptionArgs,
                                    ExtenderPreemptionResult)
 from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
-from tpushare.cache.nodeinfo import NodeInfo
+from tpushare.cache.nodeinfo import NodeInfo, apply_nominated_demand
 from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
@@ -129,6 +129,28 @@ class Preempt:
     # Per-node planning
     # ------------------------------------------------------------------ #
 
+    def _nominated_view(self, info: NodeInfo, preemptor: Pod
+                        ) -> tuple[dict[int, int], set[int]]:
+        """(available HBM per chip, earmarked chip set) after subtracting
+        higher-or-equal-priority NOMINATED demand — capacity some other
+        preemptor's victims freed stays spoken for until it binds, so a
+        plan here must not hand it to this preemptor (the gang case:
+        member B "already fits" on the chips member A's victims freed,
+        and the gang livelocks)."""
+        nominated = [p for p in self.cache.nominated_on(info.name)
+                     if p.uid != preemptor.uid
+                     and p.priority >= preemptor.priority]
+        avail = info.get_available_hbm()
+        if not nominated:
+            return avail, set()
+        free = set(info.get_free_chips())
+        free_before = set(free)
+        avail_before = dict(avail)
+        apply_nominated_demand(avail, free, nominated)
+        earmarked = {i for i in free_before - free} | {
+            i for i in avail if avail[i] != avail_before.get(i, 0)}
+        return avail, earmarked
+
     def plan_node(self, info: NodeInfo, preemptor: Pod,
                   preferred: set[str],
                   gang_memo: dict | None = None) -> list[Pod] | None:
@@ -139,14 +161,14 @@ class Preempt:
         search never rescans the cluster pod table."""
         if gang_memo is None:
             gang_memo = {}
+        avail, earmarked = self._nominated_view(info, preemptor)
         req_chips = podutils.get_chips_from_pod_resource(preemptor)
         if req_chips > 0:
             return self._plan_node_chips(info, req_chips, preemptor,
-                                         preferred, gang_memo)
+                                         preferred, gang_memo, earmarked)
         req_hbm = podutils.get_hbm_from_pod_resource(preemptor)
         if req_hbm <= 0:
             return None  # not a TPU pod; caller handles pass-through
-        avail = info.get_available_hbm()
         best: list[tuple[Pod, int]] | None = None
         for idx, chip in info.chips.items():
             if chip.total_hbm < req_hbm:
@@ -163,7 +185,9 @@ class Preempt:
 
     def _plan_node_chips(self, info: NodeInfo, req_chips: int,
                          preemptor: Pod, preferred: set[str],
-                         gang_memo: dict) -> list[Pod] | None:
+                         gang_memo: dict,
+                         earmarked: set[int] = frozenset(),
+                         ) -> list[Pod] | None:
         """The N-chip set whose *distinct-victim union* is cheapest.
 
         Chips cannot be costed independently: one multi-chip victim can
@@ -171,9 +195,13 @@ class Preempt:
         share a single victim while per-chip costing would evict two
         separate pods. Chip counts per host are small (4-8), so the
         exact search over combinations is affordable; pathological chip
-        counts fall back to greedy marginal-cost selection."""
+        counts fall back to greedy marginal-cost selection.
+        ``earmarked`` chips carry nominated demand (another preemptor's
+        freed capacity) and are never offered."""
         clearable: dict[int, list[tuple[Pod, int]]] = {}
         for idx, chip in info.chips.items():
+            if idx in earmarked:
+                continue
             residents = [(p, c) for p, c in chip.snapshot_contributions()
                          if not podutils.is_complete_pod(p)]
             if any(not self._evictable(p, preemptor) for p, _ in residents):
