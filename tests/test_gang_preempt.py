@@ -229,6 +229,68 @@ class TestNominatedAccounting:
         ok, _ = pred.filter_node(Pod(make_pod("steal", hbm=6)), "n0")
         assert not ok
 
+    def test_unmet_nominee_demand_blocks_other_preemptors(self, api):
+        """While a nominee's victims are still DYING (its demand not yet
+        coverable by free capacity), the node is not offered to another
+        same-priority preemptor at all — double-targeting the same
+        dying victims would nominate two pods to capacity that fits one
+        (round-5 review; upstream adds nominated pods' FULL requests to
+        its preemption simulation)."""
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n, chips=2, hbm_per_chip=16))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        # n0: nominee A needs 2 chips; its 2 victims are still dying
+        # (still in the ledger), so nothing is free yet
+        dying = [_resident(cache, f"dying-{c}", "n0", [c], 16,
+                           priority=0) for c in (0, 1)]
+        doc = make_pod("member-a", chips=2, priority=5, uid="uid-a")
+        doc["status"]["nominatedNodeName"] = "n0"
+        cache.note_nominated(api.create_pod(doc))
+        # n1: fully held by evictable priority-0 residents
+        for c in (0, 1):
+            _resident(cache, f"bg-{c}", "n1", [c], 16, priority=0)
+        handler = Preempt(cache)
+        b = make_pod("member-b", chips=2, priority=5, uid="uid-b")
+        result = handler.handle(_args(b, {"n0": [], "n1": []}))
+        # B's only plan is n1 — n0's capacity is spoken for even though
+        # the dying victims are technically still evictable there
+        assert set(result.node_victims) == {"n1"}
+
+    def test_reserved_gang_member_not_double_held(self, api):
+        """A reserved-but-unbound gang member's capacity lives in the
+        LEDGER; a sync of the same pod must not add a nomination
+        earmark on top (round-5 review: double-hold with no cleanup
+        path phantom-rejects fitting pods for the member's lifetime)."""
+        from tpushare.controller.controller import Controller
+        from tpushare.utils import pod as podutils
+
+        api.create_node(make_node("n0", chips=2, hbm_per_chip=16))
+        ctrl = Controller(api)
+        doc = make_pod("member", hbm=16, priority=5, uid="uid-m",
+                       annotations=GANG4)
+        doc["status"]["nominatedNodeName"] = "n0"
+        pod = api.create_pod(doc)
+        ctrl.sync_pod("default/member")
+        assert len(ctrl.cache.nominated_on("n0")) == 1
+        # the gang planner reserves: annotations persisted, nodeName
+        # reflected LOCALLY only (allocate(bind=False) — the apiserver
+        # copy stays nodeName-less until quorum), ledger priced
+        reserved = podutils.updated_pod_annotation_spec(pod, [0], 16, 16)
+        reserved.raw["status"]["nominatedNodeName"] = "n0"
+        api.update_pod(reserved)
+        local = api.get_pod("default", "member")
+        local.spec["nodeName"] = "n0"
+        ctrl.cache.add_or_update_pod(local)
+        assert ctrl.cache.nominated_on("n0") == []  # cleared on pricing
+        # the queued nomination-transition sync arrives AFTER the
+        # reservation: it must NOT re-earmark
+        ctrl.sync_pod("default/member")
+        assert ctrl.cache.nominated_on("n0") == []
+        # a 16-GiB pod still fits on chip 1 (no phantom double-hold)
+        pred = Predicate(ctrl.cache)
+        ok, reason = pred.filter_node(Pod(make_pod("fits", hbm=16)), "n0")
+        assert ok, reason
+
     def test_dead_nominated_pod_releases_earmark(self, api):
         """A nominated pod that dies while still pending must release
         its earmark (review finding, round 5: the enqueue filter missed

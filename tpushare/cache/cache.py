@@ -75,12 +75,14 @@ class SchedulerCache:
 
     def note_nominated(self, pod: Pod) -> None:
         """Track (or stop tracking) a pod's preemption nomination. A pod
-        is nominated demand only while PENDING: once bound its ledger
-        entry accounts for it, and a completed/unnominated pod earmarks
-        nothing."""
+        is nominated demand only while PENDING and UNLEDGERED: once its
+        grant is priced (bound, or reserved by the gang planner) the
+        ledger accounts for it, and an earmark on top would double-hold
+        its capacity; a completed/unnominated pod earmarks nothing."""
         with self._lock:
             if (pod.nominated_node_name and not pod.node_name
-                    and not podutils.is_complete_pod(pod)):
+                    and not podutils.is_complete_pod(pod)
+                    and pod.uid not in self._known_pods):
                 self._nominated[pod.uid] = pod
             else:
                 self._nominated.pop(pod.uid, None)

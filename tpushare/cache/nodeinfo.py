@@ -38,10 +38,14 @@ class AllocationError(Exception):
 
 
 def apply_nominated_demand(avail: dict[int, int], free_chips: set[int],
-                           nominated: list[Pod]) -> None:
+                           nominated: list[Pod]) -> bool:
     """Subtract nominated pods' earmarked demand from an availability
     view, IN PLACE (``avail``: chip idx → free HBM GiB; ``free_chips``:
-    wholly-free chip indices).
+    wholly-free chip indices). Returns True when some nominee's demand
+    could NOT be fully covered by current free capacity — its victims
+    are still dying, and that shortfall is spoken for by capacity that
+    has not materialized yet (the preempt planner refuses to plan other
+    same-or-lower-priority preemptors onto such a node).
 
     Mirrors upstream preemption bookkeeping: capacity a preemptor's
     victims freed is spoken for until that preemptor binds, so admission
@@ -55,14 +59,18 @@ def apply_nominated_demand(avail: dict[int, int], free_chips: set[int],
     far) earmarks WHATEVER is currently free — an all-or-nothing
     earmark would leave each partially-freed chip stealable exactly
     during the staggered-termination window."""
+    unmet = False
     for pod in sorted(nominated, key=lambda p: -p.priority):
         req_chips = podutils.get_chips_from_pod_resource(pod)
         if req_chips > 0:
             # Partial earmark: hold however many chips are free so far
             # (victims may still be terminating toward the full count).
-            for idx in sorted(free_chips)[:req_chips]:
+            take = sorted(free_chips)[:req_chips]
+            for idx in take:
                 free_chips.discard(idx)
                 avail[idx] = 0  # a whole-chip grant owns its HBM
+            if len(take) < req_chips:
+                unmet = True
             continue
         req_hbm = podutils.get_hbm_from_pod_resource(pod)
         if req_hbm <= 0:
@@ -84,6 +92,9 @@ def apply_nominated_demand(avail: dict[int, int], free_chips: set[int],
             avail[idx] -= take
             remaining -= take
             free_chips.discard(idx)
+        if remaining > 0:
+            unmet = True
+    return unmet
 
 
 class NodeInfo:

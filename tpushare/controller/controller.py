@@ -149,10 +149,17 @@ class Controller:
             log.info("sync: pod %s complete, freed its HBM", key)
         elif podutils.is_assumed(pod) and pod.node_name:
             self.cache.add_or_update_pod(pod)
-        else:
+        elif not podutils.is_assumed(pod):
             # Pending: track (or drop) its preemption nomination so the
             # eviction→bind window is honored by admission.
             self.cache.note_nominated(pod)
+        else:
+            # Assumed but unbound (reserved gang member awaiting
+            # quorum): its LEDGER reservation holds its capacity — a
+            # nomination earmark on top would double-hold it and, with
+            # no later transition to clear it, phantom-reject fitting
+            # pods for the member's whole lifetime (round-5 review).
+            self.cache.clear_nominated(pod.uid)
 
     def _maybe_reap_gang(self, dead: Pod) -> None:
         """Whole-gang reclamation: an ASSIGNED gang member died mid-run

@@ -130,26 +130,32 @@ class Preempt:
     # ------------------------------------------------------------------ #
 
     def _nominated_view(self, info: NodeInfo, preemptor: Pod
-                        ) -> tuple[dict[int, int], set[int]]:
-        """(available HBM per chip, earmarked chip set) after subtracting
-        higher-or-equal-priority NOMINATED demand — capacity some other
-        preemptor's victims freed stays spoken for until it binds, so a
-        plan here must not hand it to this preemptor (the gang case:
-        member B "already fits" on the chips member A's victims freed,
-        and the gang livelocks)."""
+                        ) -> tuple[dict[int, int], set[int], bool]:
+        """(available HBM per chip, earmarked chip set, unmet?) after
+        subtracting higher-or-equal-priority NOMINATED demand — capacity
+        some other preemptor's victims freed stays spoken for until it
+        binds, so a plan here must not hand it to this preemptor (the
+        gang case: member B "already fits" on the chips member A's
+        victims freed, and the gang livelocks). ``unmet`` means a
+        nominee's victims are still dying and its shortfall is covered
+        by capacity that has not materialized yet — this node cannot be
+        safely planned for another same-priority preemptor this round
+        (upstream runs its preemption simulation with nominated pods'
+        FULL requests added; one delayed round beats double-targeting
+        the same dying victims)."""
         nominated = [p for p in self.cache.nominated_on(info.name)
                      if p.uid != preemptor.uid
                      and p.priority >= preemptor.priority]
         avail = info.get_available_hbm()
         if not nominated:
-            return avail, set()
+            return avail, set(), False
         free = set(info.get_free_chips())
         free_before = set(free)
         avail_before = dict(avail)
-        apply_nominated_demand(avail, free, nominated)
+        unmet = apply_nominated_demand(avail, free, nominated)
         earmarked = {i for i in free_before - free} | {
             i for i in avail if avail[i] != avail_before.get(i, 0)}
-        return avail, earmarked
+        return avail, earmarked, unmet
 
     def plan_node(self, info: NodeInfo, preemptor: Pod,
                   preferred: set[str],
@@ -161,7 +167,9 @@ class Preempt:
         search never rescans the cluster pod table."""
         if gang_memo is None:
             gang_memo = {}
-        avail, earmarked = self._nominated_view(info, preemptor)
+        avail, earmarked, unmet = self._nominated_view(info, preemptor)
+        if unmet:
+            return None  # a nominee's grant is still materializing here
         req_chips = podutils.get_chips_from_pod_resource(preemptor)
         if req_chips > 0:
             return self._plan_node_chips(info, req_chips, preemptor,
