@@ -16,7 +16,7 @@ chipcheck:
 # (train + decode) under injected HBM grants, a mid-flight overcommit
 # that must fail cleanly, the fraction-cap enforcement probe, and the
 # max_batch_for_grant estimator under real HBM pressure. Writes
-# COTENANCY_r04.json (VERDICT round-3 weakness 1).
+# COTENANCY_r05.json (VERDICT round-3 weakness 1).
 cochipcheck:
 	python cochipcheck.py
 

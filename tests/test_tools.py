@@ -187,6 +187,29 @@ class TestInspectCLI:
         out = cli.render(doc, details=True)
         assert "default/p1: 8 GiB" in out
 
+    def test_render_details_shows_watchdog_telemetry(self, api, cluster):
+        """Used-vs-granted (and an overrun flag) rides the annotation
+        the grant watchdog writes — the operator sees the culprit in
+        the same table that shows the grants."""
+        import kubectl_inspect_tpushare as cli
+
+        from tpushare.utils import const
+
+        api.create_pod(make_pod("hog", hbm=4))
+        assert cluster.schedule(make_pod("hog", hbm=4))[0]
+        api.update_pod_status("default", "hog", "Running")
+        fresh = api.get_pod("default", "hog")
+        fresh.raw["metadata"]["annotations"][const.ANN_HBM_USED] = "10.0"
+        fresh.raw["metadata"]["annotations"][
+            const.ANN_OVERRUN] = const.ASSIGNED_TRUE
+        api.update_pod(fresh)
+        cluster.stack.controller.cache.add_or_update_pod(
+            api.get_pod("default", "hog"))
+        doc = cli.fetch(cluster.base, "v5e-0")
+        out = cli.render(doc, details=True)
+        assert "reports 10.0 GiB" in out
+        assert "** OVER GRANT **" in out
+
     def test_main_against_live_server(self, api, cluster, capsys):
         import kubectl_inspect_tpushare as cli
 

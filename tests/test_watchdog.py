@@ -3,7 +3,7 @@ annotations, and opt-in eviction.
 
 The watchdog is the "verify" half of the trust + verify enforcement
 story (the fraction cap is measured-unenforced on TPU PJRT —
-COTENANCY_r04.json): these tests pin the full plugin/metric/Event path
+COTENANCY_r05.json): these tests pin the full plugin/metric/Event path
 the round-4 verdict asked for (reference counterpart: the device
 plugin's runtime-contract role, docs/designs/designs.md:53-61).
 """

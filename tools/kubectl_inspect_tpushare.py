@@ -108,10 +108,20 @@ def render(doc: dict, details: bool = False) -> str:
                 lines.append(f"  chip {chip['id']}{where}: "
                              f"{chip['usedHBM']}/{chip['totalHBM']} GiB")
                 for pod in chip.get("pods", []):
+                    # Watchdog telemetry, when the tenant heartbeats:
+                    # granted vs what it ADMITS using; overruns flagged
+                    # loudly — this row is how an operator spots the
+                    # culprit before the innocent co-tenant pages them.
+                    reported = pod.get("reportedUsedHBM")
+                    extra = (f", reports {reported} GiB"
+                             if reported is not None else "")
+                    if pod.get("overrun"):
+                        extra += "  ** OVER GRANT **"
                     lines.append(
                         f"    {pod['namespace']}/{pod['name']}: "
                         f"{pod['usedHBM']} GiB "
-                        f"(chips {','.join(map(str, pod['chipIds']))})")
+                        f"(chips {','.join(map(str, pod['chipIds']))}"
+                        f"{extra})")
                 if not chip.get("pods"):
                     lines.append("    (idle)")
     return "\n".join(lines)

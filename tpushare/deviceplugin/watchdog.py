@@ -1,7 +1,7 @@
 """Grant watchdog: per-tenant HBM usage vs. granted — trust + VERIFY.
 
 Why this exists (measured, not assumed): ``XLA_PYTHON_CLIENT_MEM_FRACTION``
-is NOT enforced by the TPU PJRT client (``COTENANCY_r04.json``
+is NOT enforced by the TPU PJRT client (``COTENANCY_r05.json``
 ``fraction_cap.runtime_enforced: false`` — a 4-GiB-grant tenant allocated
 10 GiB and ran). Enforcement is therefore the scheduler ledger plus
 cooperative sizing, and "containment" means the *next* allocation on the

@@ -12,7 +12,7 @@ maps the device plugin's injected env onto the knobs JAX/libtpu honor:
   granted fraction.
 
 **What is actually enforced** (measured on silicon — ``cochipcheck.py``,
-``COTENANCY_r04.json``): the fraction cap is advisory on TPU PJRT
+``COTENANCY_r05.json``): the fraction cap is advisory on TPU PJRT
 clients — a tenant allocating past its grant is NOT stopped by the
 runtime until it exceeds the *chip*, where it fails cleanly (a
 compile/alloc error confined to the offending process). Co-tenancy
@@ -166,7 +166,7 @@ def configure(environ=None, headroom: float = DEFAULT_HEADROOM) -> ShareGrant | 
 # --------------------------------------------------------------------- #
 # Usage reporting (the "verify" half of trust + verify)
 # --------------------------------------------------------------------- #
-# The fraction cap is measured-unenforced (COTENANCY_r04.json), so the
+# The fraction cap is measured-unenforced (COTENANCY_r05.json), so the
 # scheduler ledger is the only enforcement — and an overrunning tenant
 # is invisible until an INNOCENT co-tenant's next allocation fails.
 # Closing that gap needs the tenant to tell the node what it actually
@@ -176,12 +176,23 @@ def configure(environ=None, headroom: float = DEFAULT_HEADROOM) -> ShareGrant | 
 # tenant's heartbeat, compares against the checkpointed grant, exports
 # used-vs-granted gauges, and names the overrunner in a Warning Event.
 
+#: Process-local running max for the live_arrays fallback (which has no
+#: allocator-side peak counter of its own).
+_live_peak = 0
+
+
 def usage_snapshot() -> dict | None:
     """Current HBM usage of this process SUMMED over its local devices,
-    from the PJRT client's ``memory_stats()`` (None when the backend
-    exposes none — CPU does not; TPU does). Summing matters: a grant
+    from the PJRT client's ``memory_stats()``. Summing matters: a grant
     can span chips (``ANN_CHIP_IDX`` "0,1"), and reporting only device
-    0 would hide an overrun living on device 1."""
+    0 would hide an overrun living on device 1.
+
+    Backends without memory stats (the axon relay returns None —
+    measured) fall back to the bytes of this process's LIVE device
+    arrays (``jax.live_arrays()``): client-side truth of what the
+    process holds resident, labeled ``source: live_arrays`` so the
+    artifact never passes an approximation off as allocator stats. No
+    usable signal at all → None (the caller no-ops)."""
     import jax
 
     try:
@@ -199,12 +210,26 @@ def usage_snapshot() -> dict | None:
         peak += int(stats.get("peak_bytes_in_use",
                               stats.get("bytes_in_use", 0)))
         limit += int(stats.get("bytes_limit", 0))
+    source = "memory_stats"
     if not seen:
-        return None
+        try:
+            live = jax.live_arrays()
+        except Exception:  # noqa: BLE001 - fallback must not raise
+            return None
+        in_use = sum(int(getattr(a, "nbytes", 0)) for a in live)
+        # live_arrays has no allocator-side peak; keep a process-local
+        # running max so a transient spike (the thing that broke a
+        # co-tenant) survives into later heartbeats instead of being
+        # overwritten by the next 5 s sample.
+        global _live_peak
+        _live_peak = max(_live_peak, in_use)
+        peak = _live_peak
+        source = "live_arrays"
     return {
         "bytes_in_use": in_use,
         "peak_bytes": peak,
         "bytes_limit": limit,
+        "source": source,
         "ts": time.time(),
         "pid": os.getpid(),
     }
