@@ -69,6 +69,20 @@ def _tenant_env(grant_gib: float, chip_gib: int = CHIP_HBM_GIB) -> dict:
     return env
 
 
+def _heartbeat() -> tuple[float | None, str | None]:
+    """Start the PRODUCTION heartbeat contract (periodic reporter; the
+    watchdog's staleness window must never race a slow co-tenant) and
+    return this tenant's (resident GiB, source) for the artifact —
+    shared by every tenant body that reports usage."""
+    from tpushare.runtime import jaxenv
+
+    snap = jaxenv.write_usage() or jaxenv.usage_snapshot()
+    jaxenv.start_usage_reporter(interval=5.0)
+    if snap is None:
+        return None, None
+    return round(snap["bytes_in_use"] / (1 << 30), 2), snap.get("source")
+
+
 def _configure_or_die():
     """The workload-side contract: read the grant, set the knobs, THEN
     import jax. Returns (grant, jax module)."""
@@ -172,25 +186,17 @@ def tenant_overrun(grant_gib: float, alloc_gib: float,
     grant, jax = _configure_or_die()
     import jax.numpy as jnp
 
-    from tpushare.runtime import jaxenv
-
     n = int(alloc_gib * (1 << 30)) // 4
     try:
         x = jnp.ones((n,), jnp.float32)
         ok = float(x[:3].sum()) == 3.0
-        snap = jaxenv.write_usage() or jaxenv.usage_snapshot()
-        # The PRODUCTION heartbeat contract: periodic reporting, not a
-        # one-shot write (matches samples/docker/main.py) — the
-        # watchdog's staleness window then can't race a slow co-tenant.
-        jaxenv.start_usage_reporter(interval=5.0)
+        reported, source = _heartbeat()
         if hold_s:
             time.sleep(hold_s)  # stay resident while the watchdog reads
         return {"tenant": "overrun", "grant_gib": grant.hbm_pod_gib,
                 "alloc_gib": alloc_gib, "outcome": "allocated",
                 "resident": ok,
-                "reported_gib": (round(snap["bytes_in_use"] / (1 << 30), 2)
-                                 if snap else None),
-                "usage_source": snap.get("source") if snap else None}
+                "reported_gib": reported, "usage_source": source}
     except Exception as e:  # noqa: BLE001
         return {"tenant": "overrun", "grant_gib": grant.hbm_pod_gib,
                 "alloc_gib": alloc_gib, "outcome": "refused",
@@ -204,8 +210,6 @@ def tenant_ballast(gib: float, hold_s: float, work_iters: int) -> dict:
     grant, jax = _configure_or_die()
     import jax.numpy as jnp
 
-    from tpushare.runtime import jaxenv
-
     n = int(gib * (1 << 30)) // 4
     x = jnp.ones((n,), jnp.float32)
     m = jnp.ones((4096, 4096), jnp.bfloat16)
@@ -217,11 +221,7 @@ def tenant_ballast(gib: float, hold_s: float, work_iters: int) -> dict:
         return m.sum().astype(jnp.float32) + x[0]
 
     _ = float(work(m, x))  # compile + materialize ballast
-    # Heartbeat when the usage contract is injected; either way the
-    # artifact records this tenant's REAL resident bytes. Periodic
-    # (production contract) so the watchdog never reads us stale.
-    snap = jaxenv.write_usage() or jaxenv.usage_snapshot()
-    jaxenv.start_usage_reporter(interval=5.0)
+    reported, source = _heartbeat()
     t0 = time.time()
     for _ in range(work_iters):
         s = work(m, x)
@@ -236,9 +236,7 @@ def tenant_ballast(gib: float, hold_s: float, work_iters: int) -> dict:
             "matmul_iters_per_s": round(work_iters / dt, 2),
             "resident_after_hold": still,
             "grant_gib": grant.hbm_pod_gib,
-            "reported_gib": (round(snap["bytes_in_use"] / (1 << 30), 2)
-                             if snap else None),
-            "usage_source": snap.get("source") if snap else None}
+            "reported_gib": reported, "usage_source": source}
 
 
 def tenant_estimator(overshoot: float) -> dict:

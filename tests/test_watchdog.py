@@ -269,6 +269,17 @@ def test_allocate_injects_usage_contract(api, tmp_path):
     assert m.host_path == str(pod_dir) and not m.read_only
 
 
+def test_usage_snapshot_refuses_cpu_fallback():
+    """A tenant whose JAX silently fell back to the CPU backend must
+    report NOTHING: live-array bytes there are host RAM, and
+    heartbeating them as HBM could get an innocent pod flagged — or
+    evicted — as an overrunner (round-5 review). The suite runs on the
+    CPU backend, so this exercises the real path."""
+    from tpushare.runtime import jaxenv
+
+    assert jaxenv.usage_snapshot() is None
+
+
 def test_jaxenv_write_usage(tmp_path, monkeypatch):
     """Tenant-side heartbeat: snapshot → atomic file the watchdog reads
     (snapshot stubbed: the CPU backend exposes no memory_stats)."""
