@@ -306,6 +306,47 @@ class TestSimulator:
         assert len(ring) == 4
         assert sum(1 for p in ring if p.get("via") == "gang commit") == 3
 
+    def test_execute_preemptions_places_priority_gang(self):
+        """execute_preemptions: the offline dry-run of the round-5
+        gang×preemption composition — a priority-5 whole-host gang of 2
+        arrives on a fleet saturated with priority-0 slices, each
+        member's preemption is EXECUTED (evict + nominate + retry), and
+        the earmark steers the members to DISTINCT hosts."""
+        report = self._run({
+            "execute_preemptions": True,
+            "fleet": [{"count": 2, "prefix": "n", "chips": 2,
+                       "hbm_per_chip": 16}],
+            "workload": [
+                {"count": 4, "name": "bg", "hbm": 16},   # saturate
+                {"count": 2, "name": "gw", "chips": 2, "priority": 5,
+                 "group": "urgent", "group_min": 2},
+            ],
+        })
+        assert report["unschedulable"] == 0
+        done = report["preemptions_executed"]
+        assert len(done) == 2
+        assert {p["node"] for p in done} == {"n-00", "n-01"}  # steered
+        assert sum(len(p["evicted"]) for p in done) == 4
+        gw = [p for p in report["placements"]
+              if p["pod"].startswith("gw")]
+        assert len(gw) == 2
+        assert {p["node"] for p in gw} == {"n-00", "n-01"}
+
+    def test_would_preempt_still_default(self):
+        """Without the opt-in flag nothing is evicted (the pre-round-5
+        advisory behavior is the default)."""
+        report = self._run({
+            "fleet": [{"prefix": "n", "chips": 1, "hbm_per_chip": 16}],
+            "workload": [
+                {"name": "bg", "hbm": 16},
+                {"name": "vip", "hbm": 16, "priority": 5},
+            ],
+        })
+        assert report["unschedulable"] == 1
+        assert report["unschedulable_pods"][0]["would_preempt"]
+        assert report["preemptions_executed"] == []
+        assert report["bound"] == 1  # bg still resident
+
     def test_cordoned_node_excluded_from_candidates(self):
         report = self._run({
             "fleet": [

@@ -27,6 +27,8 @@ Scenario schema (YAML or JSON)::
         unschedulable: true      # cordoned                (optional)
         taints:                  # v1.Taint list           (optional)
           - {key: pool, value: tpu, effect: NoSchedule}
+    execute_preemptions: true    # evict + re-schedule instead of
+                                 # reporting would-be victims (optional)
     workload:                    # ordered arrival stream
       - count: 8                 # pods in this group      (default 1)
         name: trainer            # names name-0..          (required)
@@ -45,8 +47,12 @@ upstream cordon/taint filtering, then ``POST filter`` →
 ``POST prioritize`` (bind to the top score) → ``POST bind``. Gang
 members held below quorum stay "held"; pods no node can take are
 "unschedulable", and for those with a priority the preempt verb is
-consulted dry-run to report which victims WOULD make room (no eviction
-is simulated — the report shows the blast radius).
+consulted dry-run to report which victims WOULD make room (the report
+shows the blast radius). With top-level ``execute_preemptions: true``
+the round is EXECUTED instead: victims evicted, the scheduler's
+``nominatedNodeName`` earmark recorded (so gang siblings can't steal
+each other's freed chips), and the pod re-scheduled — the offline
+dry-run of the gang×preemption composition.
 """
 
 from __future__ import annotations
@@ -175,7 +181,14 @@ def simulate(scenario: dict) -> dict:
     placements: list[dict] = []
     held: list[dict] = []
     unschedulable: list[dict] = []
+    executed_preemptions: list[dict] = []
     latencies: list[float] = []
+    # Opt-in: EXECUTE the preemptions the what-if would only report —
+    # evict the victims, record the scheduler's nominatedNodeName, and
+    # re-schedule, exactly kube-scheduler's preemption round. This is
+    # how an operator dry-runs the gang×preemption composition (a
+    # priority gang arriving on a saturated fleet) offline.
+    execute = bool(scenario.get("execute_preemptions"))
     all_nodes = [Node(d) for d in node_docs]
     try:
         for spec in _expand_workload(scenario):
@@ -187,17 +200,34 @@ def simulate(scenario: dict) -> dict:
             t0 = time.perf_counter()
             verdict = _schedule_one(client, pod, candidates)
             latencies.append((time.perf_counter() - t0) * 1e3)
-            verdict["pod"] = pod.name
-            verdict["namespace"] = pod.namespace
-            if verdict.pop("state") == "bound":
-                placements.append(verdict)
-            elif verdict.get("pending"):
-                held.append(verdict)
-            else:
-                if pod.priority:
-                    verdict["would_preempt"] = _whatif_preempt(
-                        client, pod, candidates)
-                unschedulable.append(verdict)
+            def _file(v) -> bool:
+                """Route one schedule verdict to its bucket; False
+                when it is unschedulable (caller may escalate)."""
+                v["pod"] = pod.name
+                v["namespace"] = pod.namespace
+                if v.pop("state") == "bound":
+                    placements.append(v)
+                elif v.get("pending"):
+                    held.append(v)
+                else:
+                    return False
+                return True
+
+            if _file(verdict):
+                continue
+            if pod.priority:
+                plan = _whatif_preempt(client, pod, candidates)
+                verdict["would_preempt"] = plan
+                if execute and plan:
+                    outcome = _execute_preemption(
+                        api, client, stack.controller, pod, plan)
+                    if outcome is not None:
+                        retry, record = outcome
+                        executed_preemptions.append(record)
+                        if not _file(retry):
+                            unschedulable.append(retry)
+                        continue
+            unschedulable.append(verdict)
         stack.controller.wait_idle(timeout=10)
         # Reconcile against the apiserver's final truth: a member held
         # pending quorum at arrival time is bound by the gang commit
@@ -220,7 +250,8 @@ def simulate(scenario: dict) -> dict:
     finally:
         client.close()
         shutdown_stack(stack, server)
-    return _report(inspect_doc, placements, held, unschedulable, latencies)
+    return _report(inspect_doc, placements, held, unschedulable,
+                   latencies, executed_preemptions)
 
 
 class WireError(RuntimeError):
@@ -281,7 +312,48 @@ def _whatif_preempt(client: _Client, pod, candidates: list[str]) -> dict:
     return out
 
 
-def _report(inspect_doc, placements, held, unschedulable, latencies):
+def _execute_preemption(api, client: _Client, controller, pod,
+                        plan: dict) -> tuple[dict, dict] | None:
+    """Replay kube-scheduler's preemption round for one pod: pick the
+    node with the smallest victim set, evict (delete) the victims,
+    record ``status.nominatedNodeName`` (the earmark that keeps other
+    pods — gang siblings included — off the freed capacity), wait for
+    the controller to observe the deletions, then re-schedule on that
+    node. Returns (schedule verdict, eviction record), or None when no
+    victim could be resolved (plan raced a completion)."""
+    node = min(plan, key=lambda n: (len(plan[n]), n))
+    by_uid = {p.uid: p for p in api.list_pods()}
+    evicted = []
+    for uid in plan[node]:
+        victim = by_uid.get(uid)
+        if victim is None:
+            continue
+        api.delete_pod(victim.namespace, victim.name)
+        evicted.append(f"{victim.namespace}/{victim.name}")
+    if not evicted:
+        return None
+    fresh = api.get_pod(pod.namespace, pod.name)
+    fresh.raw.setdefault("status", {})["nominatedNodeName"] = node
+    api.update_pod(fresh)
+    controller.wait_idle(timeout=10)
+    # The evictions flow through the informer; retry until the ledger
+    # shows the space (bounded — the fake apiserver settles in ms).
+    deadline = time.time() + 5.0
+    verdict = {"state": "unschedulable", "reason": "eviction not seen"}
+    while time.time() < deadline:
+        verdict = _schedule_one(client,
+                                api.get_pod(pod.namespace, pod.name),
+                                [node])
+        if verdict["state"] != "unschedulable":
+            break
+        time.sleep(0.01)
+    verdict.setdefault("via", "preemption")
+    return verdict, {"pod": f"{pod.namespace}/{pod.name}", "node": node,
+                     "evicted": evicted}
+
+
+def _report(inspect_doc, placements, held, unschedulable,
+            latencies, executed_preemptions=()):
     nodes = []
     total_hbm = used_hbm = free_whole_chips = cordoned_hbm = 0
     for n in inspect_doc.get("nodes", []):
@@ -322,6 +394,7 @@ def _report(inspect_doc, placements, held, unschedulable, latencies):
         "held_pods": held,
         "unschedulable_pods": unschedulable,
         "gangs": inspect_doc.get("gangs", []),
+        "preemptions_executed": list(executed_preemptions),
     }
 
 
@@ -356,6 +429,11 @@ def _print_human(report: dict) -> None:
             for node, victims in (u.get("would_preempt") or {}).items():
                 print(f"    would fit on {node} by evicting "
                       f"{len(victims)} pod(s)")
+    if report.get("preemptions_executed"):
+        print("\npreemptions executed:")
+        for p in report["preemptions_executed"]:
+            print(f"  {p['pod']} -> {p['node']}: evicted "
+                  f"{', '.join(p['evicted'])}")
     for g in report.get("gangs", []):
         print(f"\ngang {g.get('name')}: {g}")
 

@@ -199,6 +199,13 @@ def usage_snapshot() -> dict | None:
         devices = jax.local_devices()
     except RuntimeError:
         return None
+    if not devices or devices[0].platform == "cpu":
+        # JAX fell back to the CPU backend (e.g. libtpu init failed):
+        # ANY bytes reported from here — allocator stats or live
+        # arrays — would be HOST RAM, and heartbeating them as HBM
+        # could get an innocent tenant flagged, or evicted, as an
+        # overrunner. No signal.
+        return None
     in_use = peak = limit = 0
     seen = False
     for dev in devices:
@@ -212,12 +219,6 @@ def usage_snapshot() -> dict | None:
         limit += int(stats.get("bytes_limit", 0))
     source = "memory_stats"
     if not seen:
-        if not devices or devices[0].platform == "cpu":
-            # JAX fell back to the CPU backend (e.g. libtpu init
-            # failed): live-array bytes would be HOST RAM, and
-            # reporting them as HBM could get an innocent tenant
-            # flagged — or evicted — as an overrunner. No signal.
-            return None
         try:
             live = jax.live_arrays()
         except Exception:  # noqa: BLE001 - fallback must not raise
