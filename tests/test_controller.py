@@ -180,6 +180,33 @@ class TestNodeLifecycle:
         finally:
             c.stop()
 
+    def test_reported_usage_aggregated_in_metrics(self, api, v5e_node):
+        """Fleet-level view of the watchdog's telemetry: the extender
+        sums tenants' reported HBM (and overrun flags) per node from
+        the annotations the node watchdogs write."""
+        from tpushare.routes import metrics
+        from tpushare.utils import const
+
+        c = start_controller(api)
+        try:
+            pod = api.create_pod(make_pod("p", hbm=4, phase="Running"))
+            info = c.cache.get_node_info("v5e-node-0")
+            placed = info.allocate(api, pod)
+            # the node watchdog writes usage onto the pod; the informer
+            # delivers it to the extender's cache
+            placed.raw["metadata"]["annotations"][
+                const.ANN_HBM_USED] = "9.5"
+            placed.raw["metadata"]["annotations"][
+                const.ANN_OVERRUN] = const.ASSIGNED_TRUE
+            c.cache.add_or_update_pod(placed)
+            metrics.observe_cache(c.cache)
+            out = metrics.render()
+            assert (b'tpushare_node_hbm_reported_gib'
+                    b'{node="v5e-node-0"} 9.5') in out
+            assert b'tpushare_overrun_pods{node="v5e-node-0"} 1.0' in out
+        finally:
+            c.stop()
+
     def test_readded_node_rebuilds_from_known_pods(self, api, v5e_node):
         """Node flaps: its assigned pods survive in _known_pods, so the
         re-registered node's ledger comes back with the HBM accounted."""

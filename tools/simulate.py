@@ -336,17 +336,19 @@ def _execute_preemption(api, client: _Client, controller, pod,
     fresh.raw.setdefault("status", {})["nominatedNodeName"] = node
     api.update_pod(fresh)
     controller.wait_idle(timeout=10)
-    # The evictions flow through the informer; retry until the ledger
-    # shows the space (bounded — the fake apiserver settles in ms).
-    deadline = time.time() + 5.0
-    verdict = {"state": "unschedulable", "reason": "eviction not seen"}
-    while time.time() < deadline:
+    # wait_idle guarantees the deletions reached the ledger; a couple
+    # of short retries cover any residual lag without letting a
+    # genuinely-doomed pod (plan raced a completion, earmarked chips)
+    # spin for seconds.
+    verdict = _schedule_one(client, api.get_pod(pod.namespace, pod.name),
+                            [node])
+    for _ in range(2):
+        if verdict["state"] != "unschedulable":
+            break
+        time.sleep(0.05)
         verdict = _schedule_one(client,
                                 api.get_pod(pod.namespace, pod.name),
                                 [node])
-        if verdict["state"] != "unschedulable":
-            break
-        time.sleep(0.01)
     verdict.setdefault("via", "preemption")
     return verdict, {"pod": f"{pod.namespace}/{pod.name}", "node": node,
                      "evicted": evicted}

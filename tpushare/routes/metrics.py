@@ -119,6 +119,21 @@ IS_LEADER = Gauge(
     "a standby follower. Flapping -> alert",
     registry=REGISTRY,
 )
+HBM_REPORTED = Gauge(
+    "tpushare_node_hbm_reported_gib",
+    "HBM tenants REPORT using per node (sum of the watchdog-written "
+    "tpushare.io/hbm-used annotations; only pods opted into the usage "
+    "heartbeat contribute). Compare against tpushare_node_hbm_used_gib "
+    "(the ledger's committed grants): reported > committed means an "
+    "overrun somewhere on the node.",
+    ["node"], registry=REGISTRY,
+)
+OVERRUN_PODS = Gauge(
+    "tpushare_overrun_pods",
+    "Pods currently flagged over their grant per node (fleet-level "
+    "aggregate of the device plugins' per-pod tpushare_grant_overrun)",
+    ["node"], registry=REGISTRY,
+)
 
 
 def render() -> bytes:
@@ -132,13 +147,43 @@ def observe_cache(cache) -> None:
     Rebuilt from scratch each scrape so a deleted node's label series
     disappears instead of freezing at its last value (gauges only know
     the nodes the ledger currently knows)."""
+    from tpushare.utils import const
+
     with _SCRAPE_LOCK:
         HBM_TOTAL.clear()
         HBM_USED.clear()
+        HBM_REPORTED.clear()
+        OVERRUN_PODS.clear()
         for info in cache.get_node_infos():
             HBM_TOTAL.labels(node=info.name).set(info.total_hbm)
             used = sum(c.get_used_hbm() for c in info.chips.values())
             HBM_USED.labels(node=info.name).set(used)
+            # Fleet-level view of the watchdog's apiserver-as-store
+            # telemetry: a multi-chip pod appears on each chip it pins,
+            # so dedupe by uid before summing.
+            reported = 0.0
+            overrunning = 0
+            saw_report = False  # "wired up, using zero" must still emit
+            seen: set[str] = set()
+            for chip in info.chips.values():
+                for p in chip.snapshot_pods():
+                    if p.uid in seen:
+                        continue
+                    seen.add(p.uid)
+                    raw = p.annotations.get(const.ANN_HBM_USED)
+                    if raw is not None:
+                        try:
+                            reported += float(raw)
+                            saw_report = True
+                        except ValueError:
+                            pass
+                    if p.annotations.get(const.ANN_OVERRUN) == \
+                            const.ASSIGNED_TRUE:
+                        overrunning += 1
+            if saw_report or overrunning:
+                HBM_REPORTED.labels(node=info.name).set(
+                    round(reported, 2))
+                OVERRUN_PODS.labels(node=info.name).set(overrunning)
 
 
 def scrape(cache, gang_planner=None, leader=None, demand=None) -> bytes:
