@@ -14,4 +14,4 @@ coordinates, and the workload contract injects XLA/TPU environment
 variables instead of CUDA memory fractions.
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
