@@ -239,6 +239,69 @@ class TestPreemptionLoop:
         assert doc["nodes"][0]["usedHBM"] == 64
 
 
+class TestGangPreemptionLoop:
+    def test_priority_gang_preempts_over_the_wire(self, api, cluster):
+        """The round-5 composition, driven entirely over HTTP the way
+        kube-scheduler would: a priority-5 gang of 2 whole-host members
+        arrives on 2 saturated hosts; each member filter-fails, the
+        preempt verb plans its victims, the 'scheduler' evicts and
+        records nominatedNodeName (informer carries it to the cache),
+        and the nominated earmark steers the SECOND member's plan to
+        the other host. Both bind; the gang commits."""
+        for n in range(2):
+            api.create_node(make_node(f"gp-{n}", chips=4, hbm_per_chip=16))
+        for n in range(2):
+            for c in range(4):
+                name = f"bg-{n}{c}"
+                api.create_pod(make_pod(name, hbm=16, priority=0))
+                bound, where = cluster.schedule(
+                    make_pod(name, hbm=16, priority=0))
+                assert bound, where
+
+        gang_ann = {const.ANN_POD_GROUP: "urgent",
+                    const.ANN_POD_GROUP_MIN: "2"}
+        members = [api.create_pod(make_pod(
+            f"gw-{i}", chips=4, priority=5, annotations=gang_ann))
+            for i in range(2)]
+        nominated: dict[str, str] = {}
+        for member in members:
+            fresh = api.get_pod("default", member.name)
+            status, result = cluster._post("/tpushare-scheduler/filter", {
+                "Pod": fresh.raw,
+                "NodeNames": ["gp-0", "gp-1"]})
+            assert status == 200 and not result["NodeNames"]
+            status, plan = cluster._post("/tpushare-scheduler/preempt", {
+                "Pod": fresh.raw,
+                "NodeNameToMetaVictims": {"gp-0": {"Pods": []},
+                                          "gp-1": {"Pods": []}}})
+            assert status == 200, plan
+            offers = plan["NodeNameToMetaVictims"]
+            node = sorted(offers)[0]
+            for v in offers[node]["Pods"]:
+                victim = next(p for p in api.list_pods()
+                              if p.uid == v["UID"])
+                api.delete_pod(victim.namespace, victim.name)
+            fresh = api.get_pod("default", member.name)
+            fresh.raw.setdefault("status", {})[
+                "nominatedNodeName"] = node
+            api.update_pod(fresh)
+            nominated[member.name] = node
+            assert cluster.controller.wait_idle(timeout=5)
+        # the earmark steered the members onto DISTINCT hosts
+        assert set(nominated.values()) == {"gp-0", "gp-1"}
+        for i, member in enumerate(members):
+            fresh = api.get_pod("default", member.name)
+            status, result = cluster._post("/tpushare-scheduler/bind", {
+                "PodName": fresh.name, "PodNamespace": fresh.namespace,
+                "PodUID": fresh.uid, "Node": nominated[member.name]})
+            if i == 0:
+                assert result["Error"]  # held pending quorum
+        assert cluster.controller.wait_idle(timeout=5)
+        for member in members:
+            final = api.get_pod("default", member.name)
+            assert final.node_name == nominated[member.name]
+
+
 class TestCrashRestart:
     def test_restart_rebuilds_from_annotations(self, api):
         """Kill the stack, start a fresh one: the ledger reconstructs from
