@@ -84,6 +84,31 @@ def _audit(cache, api):
                 f"{info.name}/chip{idx} rebuild "
                 f"{fresh_info.chips[idx].get_used_hbm()} != live {used}")
             audited += 1
+    # Nominated-earmark hygiene: every tracked nomination must belong
+    # to a LIVE, PENDING, still-nominated pod — a stale earmark is a
+    # phantom capacity hold that rejects fitting pods forever.
+    with cache._lock:
+        nominated = dict(cache._nominated)
+    live_pods = {p.uid: p for p in api.list_pods()}
+    for uid, pod in nominated.items():
+        current = live_pods.get(uid)
+        assert current is not None, (
+            f"earmark for deleted pod {pod.key()}")
+        assert not current.node_name, (
+            f"earmark survived binding of {pod.key()}")
+        assert current.nominated_node_name, (
+            f"earmark for de-nominated pod {pod.key()}")
+        assert not podutils.is_complete_pod(current), (
+            f"earmark for terminal pod {pod.key()}")
+    # ... and the converse: every live pending nominated pod IS
+    # earmarked (otherwise deleting note_nominated from the controller
+    # would pass this audit vacuously).
+    for uid, p in live_pods.items():
+        if (p.nominated_node_name and not p.node_name
+                and not podutils.is_complete_pod(p)
+                and uid not in cache._known_pods):
+            assert uid in nominated, (
+                f"pending nominated pod {p.key()} has no earmark")
     return audited
 
 
@@ -104,6 +129,7 @@ def test_randomized_churn_soak(api, seed):
     controller.start(workers=4)
     bound: list[str] = []
     binds: list[str] = []  # every successful bind, never popped
+    nominated_live: list[str] = []  # pending pods with an earmark
     seq = 0
     audits = 0
     def one_op():
@@ -162,6 +188,28 @@ def test_randomized_churn_soak(api, seed):
                 "NodeNameToMetaVictims": {
                     n.name: {"Pods": []} for n in api.list_nodes()},
             }))
+            # Sometimes the scheduler "wins" a preemption round: a
+            # pending pod becomes nominated demand the predicate must
+            # honor — and the earmark must die with the pod (audited).
+            roll = rng.random()
+            if roll < 0.5:
+                doc = make_pod(f"nom{seq}", hbm=rng.choice([4, 8]),
+                               priority=1000)
+                seq += 1
+                doc["status"]["nominatedNodeName"] = rng.choice(
+                    [n.name for n in api.list_nodes()])
+                api.create_pod(doc)
+                nominated_live.append(doc["metadata"]["name"])
+            if nominated_live and roll >= 0.4:
+                name = nominated_live.pop(
+                    rng.randrange(len(nominated_live)))
+                if rng.random() < 0.5:
+                    api.delete_pod("default", name)
+                else:  # scheduler withdraws the nomination
+                    p = api.get_pod("default", name)
+                    p.raw.get("status", {}).pop("nominatedNodeName",
+                                                None)
+                    api.update_pod(p)
         elif op < 0.97:
             # -- cordon churn: toggle spec.unschedulable -------------- #
             # Exercises the node-document refresh path (resourceVersion
