@@ -1,10 +1,28 @@
 # Top-level developer entry points.
 
-.PHONY: test chipcheck cochipcheck native bench bench-workload all
+.PHONY: test lint test-race chipcheck cochipcheck native bench bench-workload all
 
 # CPU test suite (virtual 8-device mesh; kernels in interpreter mode).
 test:
 	python -m pytest tests/ -q
+
+# Static-analysis hard gate: tools/vet (annotation-key lint, lock
+# discipline, raw-lock ban, sleep-in-handler, bare-except, strict
+# typing) + mypy --strict on the core packages where mypy exists.
+# tools/vet is stdlib-only so the gate itself needs no extra deps.
+lint:
+	python -m tools.vet
+	@if python -c "import mypy" >/dev/null 2>&1; then \
+		python -m mypy --config-file pyproject.toml; \
+	else \
+		echo "mypy not installed; skipped (tools.vet strict-typing engine enforced annotations)"; \
+	fi
+
+# Soak/scale suites with the runtime lock-order race detector armed:
+# fails on any lock-order cycle (potential deadlock) or any mutation of
+# a registered guarded container while its lock is unheld.
+test-race:
+	TPUSHARE_RACE_DETECT=1 python -m pytest tests/test_soak.py tests/test_scale.py tests/test_vet.py -q
 
 # On-chip Pallas kernel regression — REQUIRES real TPU hardware.
 # Interpreter-mode tests cannot catch (8,128)-tiling / MXU lowering

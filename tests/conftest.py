@@ -27,6 +27,28 @@ from tpushare.k8s.builders import make_node, make_pod  # re-export for tests
 from tpushare.k8s.fake import FakeApiServer
 
 
+@pytest.fixture(autouse=True)
+def race_detector():
+    """``make test-race`` (TPUSHARE_RACE_DETECT=1) arms the lock-order
+    race detector around every test: at teardown, any lock-order cycle
+    observed or any mutation of a registered guarded container while
+    its lock was unheld fails the test with the full report. Off by
+    default — the armed detector serializes edge recording and would
+    tax the perf suites."""
+    from tpushare.utils import locks
+
+    if os.environ.get("TPUSHARE_RACE_DETECT") != "1":
+        yield
+        return
+    locks.arm_race_detector()
+    try:
+        yield
+        locks.assert_race_free()
+    finally:
+        locks.disarm_race_detector()
+        locks.reset_race_detector()
+
+
 @pytest.fixture
 def api():
     return FakeApiServer()

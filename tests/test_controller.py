@@ -181,9 +181,12 @@ class TestNodeLifecycle:
             c.stop()
 
     def test_reported_usage_aggregated_in_metrics(self, api, v5e_node):
-        """Fleet-level view of the watchdog's telemetry: the extender
-        sums tenants' reported HBM (and overrun flags) per node from
-        the annotations the node watchdogs write."""
+        """Fleet-level view of the watchdog's telemetry, THROUGH THE
+        INFORMER: the node watchdog writes usage annotations onto the
+        pod in the apiserver; the controller's update handler must
+        carry an annotation-only change on a known bound pod into the
+        ledger (ADVICE round 5 — it used to drop these, so metrics and
+        inspect served bind-time values forever)."""
         from tpushare.routes import metrics
         from tpushare.utils import const
 
@@ -191,19 +194,39 @@ class TestNodeLifecycle:
         try:
             pod = api.create_pod(make_pod("p", hbm=4, phase="Running"))
             info = c.cache.get_node_info("v5e-node-0")
-            placed = info.allocate(api, pod)
-            # the node watchdog writes usage onto the pod; the informer
-            # delivers it to the extender's cache
-            placed.raw["metadata"]["annotations"][
+            info.allocate(api, pod)
+            assert c.wait_idle()
+            time.sleep(0.05)
+            # the node watchdog writes usage onto the pod via the
+            # apiserver; ONLY the informer may deliver it to the cache
+            fresh = api.get_pod("default", "p")
+            fresh.raw["metadata"]["annotations"][
                 const.ANN_HBM_USED] = "9.5"
-            placed.raw["metadata"]["annotations"][
+            fresh.raw["metadata"]["annotations"][
                 const.ANN_OVERRUN] = const.ASSIGNED_TRUE
-            c.cache.add_or_update_pod(placed)
+            api.update_pod(fresh)
+            assert c.wait_idle()
+            time.sleep(0.05)
             metrics.observe_cache(c.cache)
             out = metrics.render()
             assert (b'tpushare_node_hbm_reported_gib'
                     b'{node="v5e-node-0"} 9.5') in out
             assert b'tpushare_overrun_pods{node="v5e-node-0"} 1.0' in out
+
+            # recovery flows the same path: the watchdog clears the
+            # overrun flag, the fleet gauge must follow
+            fresh = api.get_pod("default", "p")
+            fresh.raw["metadata"]["annotations"][
+                const.ANN_HBM_USED] = "3.0"
+            del fresh.raw["metadata"]["annotations"][const.ANN_OVERRUN]
+            api.update_pod(fresh)
+            assert c.wait_idle()
+            time.sleep(0.05)
+            metrics.observe_cache(c.cache)
+            out = metrics.render()
+            assert (b'tpushare_node_hbm_reported_gib'
+                    b'{node="v5e-node-0"} 3.0') in out
+            assert b'tpushare_overrun_pods{node="v5e-node-0"} 0.0' in out
         finally:
             c.stop()
 

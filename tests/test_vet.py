@@ -1,0 +1,381 @@
+"""tpushare-vet: the gate must be green on the tree AND each engine
+must catch its seeded defect class (the acceptance contract: a raw
+annotation literal, an unlocked ledger mutation, a lock-order inversion,
+and an untyped core function all fail the gate).
+
+Static engines are exercised both on inline sources and on the
+intentionally-dirty files under tools/vet/fixtures/ (which the default
+walk must SKIP); the runtime lock-order detector is exercised with a
+real two-lock inversion and a real unguarded mutation.
+"""
+
+import os
+import threading
+
+import pytest
+
+from tools.vet.engine import SKIP_DIRS, check_source, check_tree, iter_py_files
+from tools.vet.rules import LINT_RULES
+from tools.vet.typing_rules import TYPING_RULES
+from tpushare.utils import locks
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tools", "vet", "fixtures")
+
+ALL_RULES = LINT_RULES + TYPING_RULES
+
+
+def _rules_hit(src, path="tpushare/somewhere/mod.py", rules=ALL_RULES):
+    return {v.rule for v in check_source(src, path, rules)}
+
+
+# ------------------------------------------------------------------------ #
+# The gate is green on the tree as shipped
+# ------------------------------------------------------------------------ #
+
+
+def test_tree_is_clean():
+    """`make lint`'s hard gate: zero violations across tpushare/ and
+    tools/ — every rule, including strict typing on the core packages."""
+    roots = [os.path.join(REPO_ROOT, "tpushare"),
+             os.path.join(REPO_ROOT, "tools")]
+    violations = check_tree(roots, ALL_RULES)
+    assert violations == [], "\n".join(v.render() for v in violations)
+
+
+def test_fixtures_are_skipped_by_the_walk():
+    """The intentionally-dirty fixtures must never reach the gate."""
+    assert "fixtures" in SKIP_DIRS
+    files = list(iter_py_files([os.path.join(REPO_ROOT, "tools")]))
+    assert not any("fixtures" in f for f in files)
+
+
+# ------------------------------------------------------------------------ #
+# Engine 1: AST lint rules, one seeded defect per rule
+# ------------------------------------------------------------------------ #
+
+
+def test_catches_raw_annotation_literal():
+    with open(os.path.join(FIXTURES, "bad_annotation.py")) as f:
+        src = f.read()
+    assert "annotation-literal" in _rules_hit(src)
+    # utils/const.py itself is the one legal home for the literals
+    assert "annotation-literal" not in _rules_hit(
+        src, path="tpushare/utils/const.py")
+    # prose MENTIONING a key (metric help strings, docstrings) is fine
+    assert "annotation-literal" not in _rules_hit(
+        'DOC = "sums the tpushare.io/hbm-used annotations per node"\n')
+
+
+def test_catches_unlocked_ledger_mutation():
+    with open(os.path.join(FIXTURES, "bad_unlocked.py")) as f:
+        src = f.read()
+    vs = [v for v in check_source(src, "tpushare/cache/fixture.py",
+                                  LINT_RULES)
+          if v.rule == "unlocked-mutation"]
+    # exactly the racy method — not __init__, not the locked twin
+    assert len(vs) == 1
+    assert "self._nodes" in vs[0].message
+
+
+@pytest.mark.parametrize("snippet,expected", [
+    # every mutation form is seen
+    ("class ChipInfo:\n"
+     "    def up(self):\n"
+     "        self._used += 1\n", True),
+    ("class ChipInfo:\n"
+     "    def put(self, uid, pod):\n"
+     "        self.pods[uid] = pod\n", True),
+    ("class ChipInfo:\n"
+     "    def drop(self, uid):\n"
+     "        del self.pods[uid]\n", True),
+    ("class ChipInfo:\n"
+     "    def mark(self, uid):\n"
+     "        self._active.add(uid)\n", True),
+    # reads and locked mutations pass
+    ("class ChipInfo:\n"
+     "    def get(self, uid):\n"
+     "        return self.pods.get(uid)\n", False),
+    ("class ChipInfo:\n"
+     "    def put(self, uid, pod):\n"
+     "        with self._lock:\n"
+     "            self.pods[uid] = pod\n", False),
+    # unguarded classes are not this rule's business
+    ("class Whatever:\n"
+     "    def put(self, k, v):\n"
+     "        self.pods[k] = v\n", False),
+])
+def test_unlocked_mutation_forms(snippet, expected):
+    hit = "unlocked-mutation" in _rules_hit(snippet)
+    assert hit is expected, snippet
+
+
+def test_catches_bare_except():
+    src = "try:\n    pass\nexcept:\n    pass\n"
+    assert "bare-except" in _rules_hit(src)
+    assert "bare-except" not in _rules_hit(
+        "try:\n    pass\nexcept Exception:\n    pass\n")
+
+
+def test_catches_sleep_in_handler_packages():
+    src = "import time\n\ndef handle():\n    time.sleep(1)\n"
+    for pkg in ("routes", "scheduler", "api"):
+        assert "sleep-in-handler" in _rules_hit(
+            src, path=f"tpushare/{pkg}/mod.py")
+    # outside handler packages sleeping is legal (controller backoff &c)
+    assert "sleep-in-handler" not in _rules_hit(
+        src, path="tpushare/controller/mod.py")
+    # an injectable default (`sleep=time.sleep`) is a reference, not a
+    # call — pprof's samplers rely on this distinction
+    assert "sleep-in-handler" not in _rules_hit(
+        "import time\n\ndef sample(sleep=time.sleep):\n    sleep(1)\n",
+        path="tpushare/routes/mod.py")
+    # `from time import sleep` does not dodge the rule
+    assert "sleep-in-handler" in _rules_hit(
+        "from time import sleep\n\ndef handle():\n    sleep(1)\n",
+        path="tpushare/api/mod.py")
+
+
+def test_aliased_imports_do_not_dodge_rules():
+    """`from time import sleep as nap` / `from threading import Lock
+    as L` must still be caught (review finding: alias bypass)."""
+    assert "sleep-in-handler" in _rules_hit(
+        "from time import sleep as nap\n\ndef handle():\n    nap(1)\n",
+        path="tpushare/routes/mod.py")
+    assert "raw-lock" in _rules_hit(
+        "from threading import Lock as L\nlock = L()\n")
+    assert "raw-lock" in _rules_hit(
+        "from threading import RLock as R\nlock = R()\n")
+    # but an unrelated local `sleep`/`Lock` symbol is not flagged
+    assert "sleep-in-handler" not in _rules_hit(
+        "def sleep(x):\n    pass\n\ndef handle():\n    sleep(1)\n",
+        path="tpushare/routes/mod.py")
+
+
+def test_catches_raw_lock_construction():
+    src = "import threading\nL = threading.Lock()\n"
+    assert "raw-lock" in _rules_hit(src)
+    assert "raw-lock" in _rules_hit(
+        "import threading\nL = threading.RLock()\n")
+    assert "raw-lock" in _rules_hit(
+        "from threading import Lock\nL = Lock()\n")
+    # the one legal home
+    assert "raw-lock" not in _rules_hit(
+        src, path="tpushare/utils/locks.py")
+    # Condition is exempt (its internal lock never crosses call sites)
+    assert "raw-lock" not in _rules_hit(
+        "import threading\nC = threading.Condition()\n")
+
+
+# ------------------------------------------------------------------------ #
+# Pragmas
+# ------------------------------------------------------------------------ #
+
+
+def test_inline_pragma_suppresses_only_that_rule():
+    src = ("import threading\n"
+           "L = threading.Lock()  # vet: ignore[raw-lock]\n"
+           "M = threading.Lock()\n")
+    vs = check_source(src, "tpushare/x/mod.py", LINT_RULES)
+    assert [v.line for v in vs if v.rule == "raw-lock"] == [3]
+
+
+def test_pragma_on_preceding_line():
+    src = ("import threading\n"
+           "# vet: ignore[raw-lock]\n"
+           "L = threading.Lock()\n")
+    assert "raw-lock" not in _rules_hit(src)
+
+
+def test_file_pragma():
+    src = ("# vet: ignore-file[raw-lock]\n"
+           "import threading\n"
+           "L = threading.Lock()\n"
+           "M = threading.Lock()\n")
+    assert "raw-lock" not in _rules_hit(src)
+
+
+def test_pragma_does_not_suppress_other_rules():
+    src = ("import threading\n"
+           "L = threading.Lock()  # vet: ignore[annotation-literal]\n")
+    assert "raw-lock" in _rules_hit(src)
+
+
+# ------------------------------------------------------------------------ #
+# Engine 2 (runtime): lock-order inversion + guarded mutation
+# ------------------------------------------------------------------------ #
+
+
+@pytest.fixture
+def armed():
+    locks.arm_race_detector()
+    yield
+    locks.disarm_race_detector()
+    locks.reset_race_detector()
+
+
+def test_lock_order_inversion_detected(armed):
+    """The seeded inversion: two threads take the same pair of locks in
+    opposite orders. The run itself gets lucky (no deadlock — the
+    threads are serialized), but the ORDER graph has the cycle and the
+    gate must fail."""
+    a = locks.TracingRLock("fixture/A")
+    b = locks.TracingRLock("fixture/B")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab)
+    t1.start(); t1.join()
+    t2 = threading.Thread(target=ba)
+    t2.start(); t2.join()
+    cycles = locks.lock_order_cycles()
+    assert any({"fixture/A", "fixture/B"} <= set(c) for c in cycles)
+    with pytest.raises(AssertionError, match="lock-order cycle"):
+        locks.assert_race_free()
+    # the report names where each edge was first taken
+    report = locks.race_report()
+    assert "test_vet.py" in report
+
+
+def test_consistent_order_is_race_free(armed):
+    a = locks.TracingRLock("fixture/C")
+    b = locks.TracingRLock("fixture/D")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert locks.lock_order_cycles() == []
+    locks.assert_race_free()
+
+
+def test_reentrant_acquire_records_no_self_edge(armed):
+    a = locks.TracingRLock("fixture/R")
+    with a:
+        with a:  # reentrant
+            pass
+    assert locks.lock_order_cycles() == []
+
+
+def test_guarded_mutation_without_lock_detected(armed):
+    lock = locks.TracingRLock("fixture/guard")
+    d = locks.guarded_dict(lock, "Fixture.table")
+    s = locks.guarded_set(lock, "Fixture.active")
+    with lock:
+        d["ok"] = 1       # guarded: fine
+        s.add("ok")
+    d["racy"] = 2         # unguarded: violation
+    s.discard("ok")       # unguarded: violation
+    report = locks.race_report()
+    assert "Fixture.table" in report and "Fixture.active" in report
+    with pytest.raises(AssertionError, match="unguarded mutation"):
+        locks.assert_race_free()
+
+
+def test_guarded_inplace_operators_detected(armed):
+    """`|=` and friends mutate at the C level without dispatching to
+    update(); the guard must intercept them too (review finding)."""
+    lock = locks.TracingRLock("fixture/iops")
+    d = locks.guarded_dict(lock, "Fixture.dmerge")
+    s = locks.guarded_set(lock, "Fixture.smerge", {"a"})
+    with lock:
+        d |= {"ok": 1}
+        s |= {"b"}
+    assert locks.guard_violations() == []
+    d |= {"racy": 2}   # unguarded
+    s -= {"a"}         # unguarded
+    assert d["racy"] == 2 and "a" not in s  # semantics intact
+    report = locks.race_report()
+    assert "Fixture.dmerge" in report and "Fixture.smerge" in report
+
+
+def test_guarded_mutation_from_wrong_thread_detected(armed):
+    """Holding the lock on ANOTHER thread does not excuse this one."""
+    lock = locks.TracingRLock("fixture/guard2")
+    d = locks.guarded_dict(lock, "Fixture.cross")
+    hold = threading.Event()
+    done = threading.Event()
+
+    def holder():
+        with lock:
+            hold.set()
+            done.wait(timeout=5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert hold.wait(timeout=5)
+    d["racy"] = 1  # this thread does NOT hold the lock
+    done.set()
+    t.join()
+    assert any("Fixture.cross" in v for v in locks.guard_violations())
+
+
+def test_ledger_containers_are_registered():
+    """The real ledger classes construct their shared containers via
+    guarded_dict/guarded_set — deleting that wiring would quietly
+    disable the runtime half of the gate."""
+    from tpushare.cache.cache import SchedulerCache
+    from tpushare.cache.chipinfo import ChipInfo
+
+    cache = SchedulerCache(lambda name: None, lambda: [])
+    assert isinstance(cache._nodes, locks.GuardedDict)
+    assert isinstance(cache._known_pods, locks.GuardedDict)
+    assert isinstance(cache._nominated, locks.GuardedDict)
+    chip = ChipInfo(0, 16)
+    assert isinstance(chip.pods, locks.GuardedDict)
+    assert isinstance(chip._active, locks.GuardedSet)
+
+
+@pytest.mark.skipif(os.environ.get("TPUSHARE_RACE_DETECT") == "1",
+                    reason="make test-race arms the detector globally")
+def test_detector_disarmed_is_silent():
+    assert not locks.race_detector_armed()
+    lock = locks.TracingRLock("fixture/off")
+    d = locks.guarded_dict(lock, "Fixture.off")
+    d["free"] = 1  # no lock held, detector off: no violation recorded
+    assert locks.guard_violations() == []
+
+
+# ------------------------------------------------------------------------ #
+# Engine 3: strict typing
+# ------------------------------------------------------------------------ #
+
+
+def test_catches_untyped_core_function():
+    src = "def price(pod, hbm):\n    return hbm * 2\n"
+    for pkg in ("cache", "scheduler", "utils", "api"):
+        vs = check_source(src, f"tpushare/{pkg}/mod.py", TYPING_RULES)
+        assert [v.rule for v in vs] == ["strict-typing"]
+        assert "pod" in vs[0].message and "return" in vs[0].message
+    # non-core packages are out of scope (for now)
+    assert check_source(src, "tpushare/workload/mod.py", TYPING_RULES) == []
+
+
+def test_incomplete_annotations_fail():
+    src = "def price(pod: object, hbm) -> int:\n    return hbm\n"
+    vs = check_source(src, "tpushare/cache/mod.py", TYPING_RULES)
+    assert vs and "hbm" in vs[0].message and "return" not in vs[0].message
+
+
+def test_fully_typed_function_passes():
+    src = ("def price(pod: object, hbm: int = 0,\n"
+           "          *chips: int, **kw: str) -> int:\n"
+           "    return hbm\n")
+    assert check_source(src, "tpushare/cache/mod.py", TYPING_RULES) == []
+
+
+def test_self_and_cls_are_exempt():
+    src = ("class A:\n"
+           "    def m(self, x: int) -> int:\n"
+           "        return x\n"
+           "    @classmethod\n"
+           "    def c(cls) -> None:\n"
+           "        pass\n")
+    assert check_source(src, "tpushare/cache/mod.py", TYPING_RULES) == []

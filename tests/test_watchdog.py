@@ -187,6 +187,91 @@ def test_opt_in_eviction_after_consecutive_sweeps(api, tmp_path):
         api.get_pod("default", "hog")
 
 
+def test_over_streak_pruned_for_vanished_pods(api, tmp_path):
+    """Sub-threshold streak entries for deleted pods must not leak: on
+    a churny fleet every overrunning-then-deleted pod would otherwise
+    pin a dict entry forever (ADVICE round 5). Covers evict_after=0
+    (no eviction sweep prunes anything) AND the below-threshold case
+    with eviction armed."""
+    wd = _watchdog(api, tmp_path, evict_after=5)
+    for name in ("churn-a", "churn-b"):
+        api.create_pod(_tenant(name, 4, [0], uid=f"uid-{name}"))
+        _beat(tmp_path, f"uid-{name}", 10.0)
+    wd.sweep()
+    assert set(wd._over_streak) == {"uid-churn-a", "uid-churn-b"}
+    api.delete_pod("default", "churn-a")
+    wd.sweep()
+    assert set(wd._over_streak) == {"uid-churn-b"}
+    # observe-only mode (evict_after=0) prunes too
+    wd0 = _watchdog(api, tmp_path)
+    wd0.sweep()
+    assert set(wd0._over_streak) == {"uid-churn-b"}
+    api.delete_pod("default", "churn-b")
+    wd0.sweep()
+    assert wd0._over_streak == {}
+
+
+def test_eviction_honors_pdb(api, tmp_path):
+    """Opt-in eviction goes through the pods/eviction subresource: a
+    PodDisruptionBudget with no disruptions left blocks it (429), the
+    streak survives so the eviction retries, and lifting the budget
+    lets the next sweep complete the eviction."""
+    pod = _tenant("hog", 4, [0])
+    pod["metadata"]["labels"] = {"app": "protected"}
+    api.create_pod(pod)
+    _beat(tmp_path, "uid-hog", 10.0)
+    pdb = api.create_pdb({
+        "metadata": {"name": "hog-pdb", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {"app": "protected"}}},
+        "status": {"disruptionsAllowed": 0},
+    })
+    wd = _watchdog(api, tmp_path, evict_after=2)
+    wd.sweep()
+    doc = wd.sweep()  # streak hits the threshold, but the PDB blocks
+    assert doc["evicted"] == []
+    assert api.get_pod("default", "hog") is not None
+    assert wd._over_streak["uid-hog"] >= 2  # retry state survives
+    # budget recovers -> the eviction completes on the next sweep
+    pdb.raw["status"]["disruptionsAllowed"] = 1
+    api.update_pdb(pdb)
+    doc = wd.sweep()
+    assert doc["evicted"] == ["uid-hog"]
+    assert events.flush()
+    assert REASON_EVICTED in _event_reasons(api, "hog")
+
+
+def test_eviction_falls_back_to_delete_without_rbac(api, tmp_path):
+    """Rolled-forward image + un-reapplied RBAC: pods/eviction answers
+    403. Enforcement must not silently vanish — the watchdog falls
+    back to the pre-eviction bare DELETE (loudly; PDBs bypassed)."""
+    from tpushare.k8s.errors import ApiError
+
+    class NoEvictRbac:
+        """The fake minus the pods/eviction create permission."""
+
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def evict_pod(self, namespace, name):
+            raise ApiError(403, reason="Forbidden",
+                           body="pods/eviction is forbidden")
+
+    api.create_pod(_tenant("hog", 4, [0]))
+    _beat(tmp_path, "uid-hog", 10.0)
+    wd = GrantWatchdog("host-a", NoEvictRbac(api),
+                       usage_dir=str(tmp_path), evict_after=2)
+    wd.sweep()
+    doc = wd.sweep()
+    assert doc["evicted"] == ["uid-hog"]
+    assert events.flush()
+    assert REASON_EVICTED in _event_reasons(api, "hog")
+    with pytest.raises(Exception):
+        api.get_pod("default", "hog")
+
+
 def test_default_policy_never_evicts(api, tmp_path):
     api.create_pod(_tenant("hog", 4, [0]))
     _beat(tmp_path, "uid-hog", 10.0)

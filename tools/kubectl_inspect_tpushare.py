@@ -136,10 +136,12 @@ def whatif_preempt(endpoint: str, hbm: int, chips: int, priority: int,
     if not names:
         return "no TPU-sharing nodes found"
     limits = {}
+    # This plugin is deliberately stdlib-only (it is copied bare onto
+    # PATH as a kubectl plugin), so it cannot import utils/const.
     if chips > 0:
-        limits["tpushare.io/tpu-chip"] = str(chips)
+        limits["tpushare.io/tpu-chip"] = str(chips)  # vet: ignore[annotation-literal]
     else:
-        limits["tpushare.io/tpu-hbm"] = str(hbm)
+        limits["tpushare.io/tpu-hbm"] = str(hbm)  # vet: ignore[annotation-literal]
     review = {
         "Pod": {
             "apiVersion": "v1", "kind": "Pod",

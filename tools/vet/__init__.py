@@ -1,0 +1,27 @@
+"""tpushare-vet: project-native static analysis.
+
+Three engines keep the two historical bug classes of an
+annotations-as-truth, lock-guarded control plane mechanically
+impossible (the posture the Go reference inherits from ``go vet`` and
+``-race`` for free):
+
+1. AST lint rules (:mod:`tools.vet.rules`) — repo invariants: no raw
+   ``tpushare.io/*`` annotation keys outside ``utils/const.py``, no
+   mutation of ledger shared fields outside ``with self._lock:``, no
+   bare ``except:``, no ``time.sleep`` in request-handler packages, no
+   raw ``threading.Lock()``/``RLock()`` outside ``utils/locks.py``.
+2. Strict-typing engine (:mod:`tools.vet.typing_rules`) — every
+   function in the core packages fully annotated (the stdlib-``ast``
+   enforcement of the contract ``mypy --strict`` checks where
+   installed; see ``[tool.mypy]`` in pyproject.toml).
+3. The runtime lock-order race detector lives with the locks it
+   instruments (:mod:`tpushare.utils.locks`); ``make test-race`` arms
+   it under the soak/scale suites.
+
+Run: ``python -m tools.vet`` (or ``make lint``). Suppress a finding
+with an inline ``# vet: ignore[rule-id]`` pragma — see docs/vet.md.
+"""
+
+from tools.vet.engine import Violation, check_source, check_tree, iter_py_files
+
+__all__ = ["Violation", "check_source", "check_tree", "iter_py_files"]

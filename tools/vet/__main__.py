@@ -1,0 +1,68 @@
+"""CLI: ``python -m tools.vet [paths...]``.
+
+Default scan roots are ``tpushare/`` and ``tools/`` relative to the
+repo root (found via this file's location, so the gate behaves the same
+from any CWD). Exit 1 on any violation — this is the hard-gate half of
+``make lint``; ``make test-race`` arms the runtime detector.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from tools.vet.engine import check_tree
+from tools.vet.rules import LINT_RULES
+from tools.vet.typing_rules import TYPING_RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+ALL_RULES = LINT_RULES + TYPING_RULES
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tools.vet",
+        description="tpushare project-native static analysis")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to scan "
+                             "(default: tpushare/ and tools/)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print every rule id and exit")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="RULE-ID",
+                        help="run only this rule (repeatable)")
+    opts = parser.parse_args(argv)
+
+    if opts.list_rules:
+        for rule in ALL_RULES:
+            doc = ((rule.__doc__ or "").strip().splitlines() or [""])[0]
+            print(f"{rule.rule_id:20s} {doc}")
+        return 0
+
+    rules = ALL_RULES
+    if opts.rule:
+        known = {r.rule_id for r in ALL_RULES}
+        unknown = set(opts.rule) - known
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = tuple(r for r in ALL_RULES if r.rule_id in opts.rule)
+
+    roots = opts.paths or [os.path.join(REPO_ROOT, "tpushare"),
+                           os.path.join(REPO_ROOT, "tools")]
+    violations = check_tree(roots, rules)
+    for v in violations:
+        print(v.render())
+    if violations:
+        print(f"tools.vet: {len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    print(f"tools.vet: clean ({len(rules)} rules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

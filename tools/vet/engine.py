@@ -1,0 +1,110 @@
+"""vet core: file walking, pragma handling, rule running, reporting.
+
+A *rule* is a callable ``(tree, src, path) -> list[Violation]`` with a
+``rule_id`` attribute. The engine parses each file once, runs every
+applicable rule over the shared AST, and filters the findings through
+the inline-pragma layer:
+
+* ``# vet: ignore[rule-id]`` on (or immediately above) the offending
+  line suppresses that rule there;
+* ``# vet: ignore-file[rule-id]`` in the first 20 lines suppresses the
+  rule for the whole file. Several ids may be comma-separated.
+
+Pragmas are deliberately rule-scoped — a bare "ignore everything"
+escape hatch would rot into the default.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Callable, Iterable, Iterator, Sequence
+
+#: Signature every rule implements.
+Rule = Callable[[ast.AST, str, str], "list[Violation]"]
+
+_PRAGMA_RE = re.compile(r"#\s*vet:\s*ignore\[([a-z0-9_,\s-]+)\]")
+_FILE_PRAGMA_RE = re.compile(r"#\s*vet:\s*ignore-file\[([a-z0-9_,\s-]+)\]")
+
+#: Directories never scanned (fixtures are *intentionally* dirty).
+SKIP_DIRS = {"fixtures", "__pycache__", ".git", "node_modules"}
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+def iter_py_files(roots: Sequence[str]) -> Iterator[str]:
+    for root in roots:
+        if os.path.isfile(root):
+            if root.endswith(".py"):
+                yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _pragma_sets(src: str) -> tuple[set[str], dict[int, set[str]]]:
+    """(file-wide ignored rules, line -> rules ignored on that line)."""
+    file_ignores: set[str] = set()
+    line_ignores: dict[int, set[str]] = {}
+    for lineno, line in enumerate(src.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if m:
+            ids = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            line_ignores.setdefault(lineno, set()).update(ids)
+            # A pragma on a line OF ITS OWN covers the statement below
+            # it; an inline pragma covers only its own line.
+            if line.lstrip().startswith("#"):
+                line_ignores.setdefault(lineno + 1, set()).update(ids)
+        if lineno <= 20:
+            fm = _FILE_PRAGMA_RE.search(line)
+            if fm:
+                file_ignores.update(
+                    r.strip() for r in fm.group(1).split(",") if r.strip())
+    return file_ignores, line_ignores
+
+
+def check_source(src: str, path: str,
+                 rules: Iterable[Rule]) -> list[Violation]:
+    """Run ``rules`` over one file's source text."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 1, e.offset or 0, "syntax",
+                          f"file does not parse: {e.msg}")]
+    file_ignores, line_ignores = _pragma_sets(src)
+    out: list[Violation] = []
+    for rule in rules:
+        rule_id = getattr(rule, "rule_id", rule.__name__)
+        if rule_id in file_ignores:
+            continue
+        for v in rule(tree, src, path):
+            if v.rule in line_ignores.get(v.line, ()):
+                continue
+            out.append(v)
+    return sorted(out, key=lambda v: (v.path, v.line, v.col, v.rule))
+
+
+def check_tree(roots: Sequence[str],
+               rules: Iterable[Rule]) -> list[Violation]:
+    rules = list(rules)
+    out: list[Violation] = []
+    for path in iter_py_files(roots):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        out.extend(check_source(src, path, rules))
+    return out

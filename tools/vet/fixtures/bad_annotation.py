@@ -1,0 +1,5 @@
+"""Seeded defect: a raw annotation key (annotation-literal)."""
+
+
+def chip_ids(pod):
+    return pod.annotations.get("tpushare.io/chip-idx", "")

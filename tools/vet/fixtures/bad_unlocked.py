@@ -1,0 +1,19 @@
+"""Seeded defect: ledger mutation outside the lock (unlocked-mutation).
+
+This is the reference's cache.go:40-46 bug class replayed: a
+SchedulerCache method touching the node table with no lock held.
+"""
+
+
+class SchedulerCache:
+    def __init__(self):
+        self._nodes = {}
+        self._known_pods = {}
+        self._lock = None
+
+    def remove_node_racy(self, name):
+        self._nodes.pop(name, None)  # BUG: no `with self._lock:`
+
+    def remove_node_ok(self, name):
+        with self._lock:
+            self._nodes.pop(name, None)

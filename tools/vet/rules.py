@@ -1,0 +1,274 @@
+"""AST lint rules enforcing tpushare's repo invariants.
+
+Each rule is a function ``(tree, src, path) -> list[Violation]`` with a
+``rule_id`` attribute; :mod:`tools.vet.engine` runs them and applies
+the ``# vet: ignore[rule-id]`` pragma layer. docs/vet.md documents the
+rationale for every rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Callable
+
+from tools.vet.engine import Violation
+
+# --------------------------------------------------------------------------
+# Shared helpers
+# --------------------------------------------------------------------------
+
+
+def _posix(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def _rule(rule_id: str) -> Callable:
+    def deco(fn: Callable) -> Callable:
+        fn.rule_id = rule_id  # type: ignore[attr-defined]
+        return fn
+    return deco
+
+
+# --------------------------------------------------------------------------
+# annotation-literal: raw tpushare.io/* keys must come from utils/const.py
+# --------------------------------------------------------------------------
+
+#: Matches a BARE annotation/resource key ("tpushare.io/hbm-pod"), not
+#: prose that merely mentions one ("... the tpushare.io/hbm-used ann...").
+_ANN_KEY_RE = re.compile(r"^tpushare\.io/[A-Za-z0-9._-]+$")
+
+
+@_rule("annotation-literal")
+def annotation_literal(tree: ast.AST, src: str, path: str) -> list[Violation]:
+    """Every ``tpushare.io/*`` key outside utils/const.py must be a
+    ``const.ANN_*`` reference — raw literals are how keys drift from the
+    schema (the reference's string-typo bug class)."""
+    if _posix(path).endswith("utils/const.py"):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and _ANN_KEY_RE.match(node.value)):
+            out.append(Violation(
+                path, node.lineno, node.col_offset, "annotation-literal",
+                f"raw annotation key {node.value!r}: use the "
+                "tpushare.utils.const symbol instead"))
+    return out
+
+
+# --------------------------------------------------------------------------
+# unlocked-mutation: ledger shared fields mutate only under self._lock
+# --------------------------------------------------------------------------
+
+#: class name -> fields whose mutation must be lock-guarded. The exact
+#: bug class cache/cache.py's own header calls out (reads/writes of the
+#: node map outside the lock, reference cache.go:40-46).
+GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
+    "SchedulerCache": ("_nodes", "_known_pods", "_nominated",
+                       "_node_epochs"),
+    "NodeInfo": ("chips",),
+    "ChipInfo": ("pods", "_contrib", "_used", "_active"),
+}
+
+#: Method calls that mutate a dict/set/list in place.
+_MUTATORS = {"pop", "popitem", "clear", "update", "setdefault", "add",
+             "discard", "remove", "append", "extend", "insert"}
+
+
+def _is_self_field(node: ast.AST, fields: tuple[str, ...]) -> str | None:
+    """``self.<field>`` (or a subscript of it) for a guarded field."""
+    if isinstance(node, ast.Subscript):
+        return _is_self_field(node.value, fields)
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self" and node.attr in fields):
+        return node.attr
+    return None
+
+
+def _with_holds_self_lock(node: ast.With) -> bool:
+    for item in node.items:
+        ctx = item.context_expr
+        if (isinstance(ctx, ast.Attribute)
+                and isinstance(ctx.value, ast.Name)
+                and ctx.value.id == "self" and "lock" in ctx.attr):
+            return True
+    return False
+
+
+class _MutationVisitor(ast.NodeVisitor):
+    def __init__(self, path: str, fields: tuple[str, ...]):
+        self.path = path
+        self.fields = fields
+        self.lock_depth = 0
+        self.out: list[Violation] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        if _with_holds_self_lock(node):
+            self.lock_depth += 1
+            self.generic_visit(node)
+            self.lock_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    def _flag(self, node: ast.AST, field: str, what: str) -> None:
+        if self.lock_depth == 0:
+            self.out.append(Violation(
+                self.path, node.lineno, node.col_offset,  # type: ignore[attr-defined]
+                "unlocked-mutation",
+                f"{what} of guarded field self.{field} outside "
+                "'with self._lock:'"))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            field = _is_self_field(tgt, self.fields)
+            if field:
+                self._flag(node, field, "assignment")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        field = _is_self_field(node.target, self.fields)
+        if field:
+            self._flag(node, field, "augmented assignment")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            field = _is_self_field(tgt, self.fields)
+            if field:
+                self._flag(node, field, "deletion")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+            field = _is_self_field(fn.value, self.fields)
+            if field:
+                self._flag(node, field, f".{fn.attr}()")
+        self.generic_visit(node)
+
+
+@_rule("unlocked-mutation")
+def unlocked_mutation(tree: ast.AST, src: str, path: str) -> list[Violation]:
+    """Mutations of ledger shared state (``GUARDED_FIELDS``) must sit
+    lexically inside ``with self._lock:``. ``__init__`` is exempt — the
+    object is not shared until construction returns."""
+    out: list[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        fields = GUARDED_FIELDS.get(node.name)
+        if not fields:
+            continue
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if item.name == "__init__":
+                continue
+            visitor = _MutationVisitor(path, fields)
+            visitor.visit(item)
+            out.extend(visitor.out)
+    return out
+
+
+# --------------------------------------------------------------------------
+# bare-except
+# --------------------------------------------------------------------------
+
+
+@_rule("bare-except")
+def bare_except(tree: ast.AST, src: str, path: str) -> list[Violation]:
+    """``except:`` also swallows KeyboardInterrupt/SystemExit and hides
+    the exception type from the reader; name the exception (at minimum
+    ``except Exception:``)."""
+    return [Violation(path, node.lineno, node.col_offset, "bare-except",
+                      "bare 'except:': catch a named exception type")
+            for node in ast.walk(tree)
+            if isinstance(node, ast.ExceptHandler) and node.type is None]
+
+
+# --------------------------------------------------------------------------
+# sleep-in-handler: no time.sleep on request-serving paths
+# --------------------------------------------------------------------------
+
+#: Packages whose code runs inside HTTP request handlers (the extender's
+#: filter/prioritize/bind verbs sit on the scheduler's critical path —
+#: a stray sleep there stalls every placement in the cluster).
+_HANDLER_PACKAGES = ("tpushare/routes/", "tpushare/scheduler/",
+                     "tpushare/api/")
+
+
+def _from_import_names(tree: ast.AST, module: str,
+                       symbols: tuple[str, ...]) -> set[str]:
+    """Local names (including ``as`` aliases) bound to ``module``'s
+    ``symbols`` by from-imports — ``from time import sleep as nap``
+    must not dodge a rule that bans ``sleep``."""
+    return {alias.asname or alias.name
+            for node in ast.walk(tree) if isinstance(node, ast.ImportFrom)
+            and node.module == module
+            for alias in node.names if alias.name in symbols}
+
+
+def _is_time_sleep(fn: ast.AST, sleep_names: set[str]) -> bool:
+    if (isinstance(fn, ast.Attribute) and fn.attr == "sleep"
+            and isinstance(fn.value, ast.Name) and fn.value.id == "time"):
+        return True
+    return isinstance(fn, ast.Name) and fn.id in sleep_names
+
+
+@_rule("sleep-in-handler")
+def sleep_in_handler(tree: ast.AST, src: str, path: str) -> list[Violation]:
+    """``time.sleep()`` calls in request-handler packages stall the
+    scheduler's filter/bind critical path; injectable ``sleep=``
+    parameters (pprof's samplers) are references, not calls, and pass."""
+    p = _posix(path)
+    if not any(pkg in p for pkg in _HANDLER_PACKAGES):
+        return []
+    sleep_names = _from_import_names(tree, "time", ("sleep",))
+    return [Violation(path, node.lineno, node.col_offset,
+                      "sleep-in-handler",
+                      "time.sleep() call in a request-handler package")
+            for node in ast.walk(tree)
+            if isinstance(node, ast.Call)
+            and _is_time_sleep(node.func, sleep_names)]
+
+
+# --------------------------------------------------------------------------
+# raw-lock: all locks go through utils/locks.py (TracingRLock)
+# --------------------------------------------------------------------------
+
+
+@_rule("raw-lock")
+def raw_lock(tree: ast.AST, src: str, path: str) -> list[Violation]:
+    """``threading.Lock()``/``RLock()`` constructed outside
+    utils/locks.py is a hole in the mutex profile AND invisible to the
+    lock-order race detector; use ``locks.TracingRLock(site)``.
+    (``threading.Condition()`` is exempt: its internal lock never spans
+    call boundaries the detector cares about.)"""
+    if _posix(path).endswith("utils/locks.py"):
+        return []
+    lock_names = _from_import_names(tree, "threading", ("Lock", "RLock"))
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        hit = None
+        if (isinstance(fn, ast.Attribute) and fn.attr in ("Lock", "RLock")
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "threading"):
+            hit = f"threading.{fn.attr}"
+        elif isinstance(fn, ast.Name) and fn.id in lock_names:
+            hit = fn.id
+        if hit:
+            out.append(Violation(
+                path, node.lineno, node.col_offset, "raw-lock",
+                f"direct {hit}() construction: use "
+                "tpushare.utils.locks.TracingRLock(site) so the mutex "
+                "profile and race detector see it"))
+    return out
+
+
+LINT_RULES = (annotation_literal, unlocked_mutation, bare_except,
+              sleep_in_handler, raw_lock)

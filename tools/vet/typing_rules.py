@@ -1,0 +1,69 @@
+"""strict-typing engine: every core-package function fully annotated.
+
+This is the stdlib-``ast`` enforcement of the contract ``mypy --strict``
+(``disallow_untyped_defs`` / ``disallow_incomplete_defs``) checks where
+mypy is installed: every function in the core packages carries a return
+annotation and an annotation on every parameter. ``make lint`` runs real
+mypy on top when the interpreter has it; this engine is the part of the
+gate that cannot be skipped by a missing tool.
+
+Scope: the packages whose objects cross thread boundaries — exactly
+where an Any-typed value turns a lock-discipline bug into a type
+confusion the tests cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.vet.engine import Violation
+
+#: Path fragments of the strictly-typed core packages.
+CORE_PACKAGES = ("tpushare/cache/", "tpushare/scheduler/",
+                 "tpushare/utils/", "tpushare/api/")
+
+#: Parameter names exempt from annotation (bound implicitly).
+_IMPLICIT = {"self", "cls"}
+
+
+def _missing(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> list[str]:
+    gaps = []
+    args = fn.args
+    positional = args.posonlyargs + args.args
+    for i, a in enumerate(positional):
+        if i == 0 and a.arg in _IMPLICIT:
+            continue
+        if a.annotation is None:
+            gaps.append(a.arg)
+    for a in args.kwonlyargs:
+        if a.annotation is None:
+            gaps.append(a.arg)
+    if args.vararg is not None and args.vararg.annotation is None:
+        gaps.append("*" + args.vararg.arg)
+    if args.kwarg is not None and args.kwarg.annotation is None:
+        gaps.append("**" + args.kwarg.arg)
+    if fn.returns is None:
+        gaps.append("return")
+    return gaps
+
+
+def strict_typing(tree: ast.AST, src: str, path: str) -> list[Violation]:
+    p = path.replace("\\", "/")
+    if not any(pkg in p for pkg in CORE_PACKAGES):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        gaps = _missing(node)
+        if gaps:
+            out.append(Violation(
+                path, node.lineno, node.col_offset, "strict-typing",
+                f"def {node.name}() missing annotations: "
+                + ", ".join(gaps)))
+    return out
+
+
+strict_typing.rule_id = "strict-typing"  # type: ignore[attr-defined]
+
+TYPING_RULES = (strict_typing,)

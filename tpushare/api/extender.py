@@ -14,11 +14,13 @@ both the node-name and the full-node forms are supported here.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Any
 
 from tpushare.api.objects import Node, Pod
 
 
-def _either(doc: dict, legacy: str, modern: str, default=None):
+def _either(doc: dict, legacy: str, modern: str,
+            default: Any = None) -> Any:
     """Read a wire field in either era's casing: the legacy v1.11
     ``pkg/scheduler/api`` structs had no json tags (Go marshals the
     exported — capitalized — field names; what the reference's vendored

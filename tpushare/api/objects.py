@@ -16,7 +16,9 @@ from __future__ import annotations
 
 import copy
 import re
-from typing import Any, Iterator
+from typing import Any, Iterator, TypeVar
+
+_K = TypeVar("_K", bound="K8sObject")
 
 _QUANTITY_RE = re.compile(r"^([+-]?[0-9.]+(?:[eE][+-]?[0-9]+)?)([A-Za-z]*)$")
 
@@ -64,7 +66,7 @@ class K8sObject:
 
     __slots__ = ("raw",)
 
-    def __init__(self, raw: dict):
+    def __init__(self, raw: dict) -> None:
         self.raw = raw
 
     # -- metadata ----------------------------------------------------------
@@ -100,16 +102,16 @@ class K8sObject:
     def deletion_timestamp(self) -> str | None:
         return self.metadata.get("deletionTimestamp")
 
-    def deepcopy(self):
+    def deepcopy(self: _K) -> _K:
         return type(self)(copy.deepcopy(self.raw))
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         return f"{type(self).__name__}({self.namespace}/{self.name})"
 
-    def __eq__(self, other) -> bool:
+    def __eq__(self, other: object) -> bool:
         return type(self) is type(other) and self.raw == other.raw
 
-    def __hash__(self):  # identity by UID (falls back to ns/name)
+    def __hash__(self) -> int:  # identity by UID (falls back to ns/name)
         return hash((type(self).__name__, self.uid or f"{self.namespace}/{self.name}"))
 
 

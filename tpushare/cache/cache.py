@@ -17,6 +17,7 @@ capacities change, not only on the non-sharing → sharing transition
 from __future__ import annotations
 
 import logging
+from typing import Callable
 
 from tpushare.api.objects import Node, Pod
 from tpushare.cache.nodeinfo import NodeInfo
@@ -29,8 +30,9 @@ log = logging.getLogger(__name__)
 
 
 class SchedulerCache:
-    def __init__(self, node_getter, pod_lister,
-                 default_scoring: str | None = None):
+    def __init__(self, node_getter: Callable[[str], Node | None],
+                 pod_lister: Callable[[], list[Pod]],
+                 default_scoring: str | None = None) -> None:
         """``node_getter(name) -> Node | None`` and
         ``pod_lister() -> list[Pod]`` abstract the informer listers the
         reference wired in (cache.go:30-38); tests pass a fake client's
@@ -41,12 +43,20 @@ class SchedulerCache:
         self._node_getter = node_getter
         self._pod_lister = pod_lister
         self._default_scoring = default_scoring
-        self._nodes: dict[str, NodeInfo] = {}
-        self._known_pods: dict[str, Pod] = {}  # uid -> annotated pod
+        self._lock = locks.TracingRLock("cache/table")
+        # Guarded containers: `make test-race` fails any mutation of
+        # these while cache/table is unheld (the reference's unlocked-
+        # read bug class, cache.go:40-46, enforced at runtime).
+        self._nodes: dict[str, NodeInfo] = locks.guarded_dict(
+            self._lock, "SchedulerCache._nodes")
+        #: uid -> annotated pod
+        self._known_pods: dict[str, Pod] = locks.guarded_dict(
+            self._lock, "SchedulerCache._known_pods")
         #: name -> deletion epoch; bumped on every eviction so a lookup
         #: that fetched the node doc before the delete cannot re-insert
         #: a zombie ledger afterwards.
-        self._node_epochs: dict[str, int] = {}
+        self._node_epochs: dict[str, int] = locks.guarded_dict(
+            self._lock, "SchedulerCache._node_epochs")
         #: uid -> PENDING pod with ``status.nominatedNodeName`` set (the
         #: scheduler preempted for it; its victims' capacity is earmarked
         #: until it binds). The predicate and the preempt planner subtract
@@ -54,8 +64,8 @@ class SchedulerCache:
         #: the eviction→bind window — without it, gang members' per-member
         #: preemptions re-consume each other's freed capacity and the
         #: gang never commits (round-4 verdict, Weak #4).
-        self._nominated: dict[str, Pod] = {}
-        self._lock = locks.TracingRLock("cache/table")
+        self._nominated: dict[str, Pod] = locks.guarded_dict(
+            self._lock, "SchedulerCache._nominated")
 
     # ------------------------------------------------------------------ #
     # Known-pod set (reference cache.go:76-87)

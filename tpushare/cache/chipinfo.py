@@ -18,17 +18,21 @@ from tpushare.utils import pod as podutils
 class ChipInfo:
     """One TPU chip's allocation state."""
 
-    def __init__(self, idx: int, total_hbm: int):
+    def __init__(self, idx: int, total_hbm: int) -> None:
         self.idx = idx
         self.total_hbm = total_hbm
-        self.pods: dict[str, Pod] = {}  # uid -> Pod
-        self._contrib: dict[str, int] = {}  # uid -> GiB counted
+        self._lock = locks.TracingRLock(f"chip/{idx}")
+        # Guarded: `make test-race` fails mutations while chip/N unheld.
+        self.pods: dict[str, Pod] = locks.guarded_dict(
+            self._lock, f"ChipInfo({idx}).pods")  # uid -> Pod
+        self._contrib: dict[str, int] = locks.guarded_dict(
+            self._lock, f"ChipInfo({idx})._contrib")  # uid -> GiB counted
         self._used = 0
         #: uids priced as active (not complete/terminating) at add time —
         #: a set, not a counter, so it cannot drift if a stored pod's
         #: status document is mutated in place between add and remove.
-        self._active: set[str] = set()
-        self._lock = locks.TracingRLock(f"chip/{idx}")
+        self._active: set[str] = locks.guarded_set(
+            self._lock, f"ChipInfo({idx})._active")
 
     def _contribution(self, pod: Pod) -> int:
         """What ``pod`` pins on this chip.

@@ -19,6 +19,7 @@ Counterpart of the reference's ``pkg/cache/nodeinfo.go`` (NodeInfo,
 from __future__ import annotations
 
 import time
+from typing import Any
 
 from tpushare.utils import locks
 from tpushare.api.objects import Node, Pod, binding_doc
@@ -100,16 +101,21 @@ def apply_nominated_demand(avail: dict[int, int], free_chips: set[int],
 class NodeInfo:
     """Aggregated allocation state of one TPU node."""
 
-    def __init__(self, node: Node, default_scoring: str | None = None):
+    def __init__(self, node: Node,
+                 default_scoring: str | None = None) -> None:
         self.name = node.name
         self.node = node
         #: Fleet scoring default for the chip picker; None -> the env
         #: fallback inside podutils.effective_scoring (standalone use).
         self.default_scoring = default_scoring
+        self._lock = locks.TracingRLock(f"node/{self.name}")
         caps = nodeutils.get_chip_capacities(node)
-        self.chips: dict[int, ChipInfo] = {
-            i: ChipInfo(i, cap) for i, cap in enumerate(caps)
-        }
+        # Guarded: the chip table itself only mutates at construction,
+        # but registering it keeps `make test-race` watching for any
+        # future in-place rebuild landing outside the lock.
+        self.chips: dict[int, ChipInfo] = locks.guarded_dict(
+            self._lock, f"NodeInfo({self.name}).chips",
+            {i: ChipInfo(i, cap) for i, cap in enumerate(caps)})
         self.chip_count = len(caps)
         self.total_hbm = sum(caps)
         topo_spec = nodeutils.get_topology(node)
@@ -129,7 +135,6 @@ class NodeInfo:
                 "falling back to flat", self.name, topo_spec,
                 self.topology.chip_count, self.chip_count)
             self.topology = Topology.flat(self.chip_count)
-        self._lock = locks.TracingRLock(f"node/{self.name}")
 
     # ------------------------------------------------------------------ #
     # Ledger bookkeeping (reference nodeinfo.go:72-110)
@@ -329,7 +334,7 @@ class NodeInfo:
     # Commit path (reference Allocate, nodeinfo.go:139-206)
     # ------------------------------------------------------------------ #
 
-    def allocate(self, client, pod: Pod, *, bind: bool = True) -> Pod:
+    def allocate(self, client: Any, pod: Pod, *, bind: bool = True) -> Pod:
         """Place ``pod``, persist the grant, bind, and update the ledger.
 
         1. pick chips (policy above);

@@ -25,6 +25,7 @@ from tpushare.k8s.errors import ApiError, NotFoundError
 from tpushare.k8s.informer import InformerHub
 from tpushare.k8s.workqueue import RateLimitedQueue
 from tpushare.utils import const
+from tpushare.utils import locks
 from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
@@ -45,13 +46,15 @@ class Controller:
         #: originates (today: the gang reaper). Reads/ledger upkeep run
         #: on every replica; deletes from N replicas would multiply.
         self._is_leader = is_leader or (lambda: True)
+        self._removed_lock = locks.TracingRLock("controller/removed")
         #: ns/name -> last seen Pod, for deletes (reference removePodCache)
-        self._removed: dict[str, Pod] = {}
-        self._removed_lock = threading.Lock()
+        self._removed: dict[str, Pod] = locks.guarded_dict(
+            self._removed_lock, "Controller._removed")
         #: uids the gang reaper itself deleted: their delete events must
         #: not re-trigger reaping (the cascade would race the owning
         #: Job's freshly recreated replacement pods).
-        self._reaped_uids: set[str] = set()
+        self._reaped_uids: set[str] = locks.guarded_set(
+            self._removed_lock, "Controller._reaped_uids")
         self._workers: list[threading.Thread] = []
         self._stop = threading.Event()
 
@@ -93,15 +96,30 @@ class Controller:
     def _on_pod_add(self, pod: Pod) -> None:
         self.queue.add(pod.key())
 
+    @staticmethod
+    def _usage_changed(old: Pod | None, new: Pod) -> bool:
+        """Did the node watchdog's usage telemetry on the pod change?"""
+        if old is None:
+            return (const.ANN_HBM_USED in new.annotations
+                    or const.ANN_OVERRUN in new.annotations)
+        return any(old.annotations.get(k) != new.annotations.get(k)
+                   for k in (const.ANN_HBM_USED, const.ANN_OVERRUN))
+
     def _on_pod_update(self, old: Pod | None, new: Pod) -> None:
         """Enqueue iff the update changes ledger state: a known pod that
         completed, an unknown pod that acquired a chip assignment
-        (reference controller.go:257-305), or a nomination transition —
-        the scheduler setting/clearing ``status.nominatedNodeName``
-        after a preemption round (that earmark gates OTHER pods'
-        admission, so the cache must learn it promptly)."""
+        (reference controller.go:257-305), a known bound pod whose
+        watchdog-written usage annotations changed (hbm-used/overrun
+        must reach the ledger copy, or inspect and the fleet metrics
+        serve bind-time values forever — ADVICE round 5), or a
+        nomination transition — the scheduler setting/clearing
+        ``status.nominatedNodeName`` after a preemption round (that
+        earmark gates OTHER pods' admission, so the cache must learn it
+        promptly)."""
         known = self.cache.known_pod(new.uid)
         if known and podutils.is_complete_pod(new):
+            self.queue.add(new.key())
+        elif known and self._usage_changed(old, new):
             self.queue.add(new.key())
         elif not known and podutils.is_assumed(new) and new.node_name:
             self.queue.add(new.key())

@@ -29,13 +29,12 @@ import dataclasses
 import json
 import logging
 import os
-import threading
 import time
 
 from tpushare.api.objects import Pod
 from tpushare.deviceplugin.discovery import HostInventory
 from tpushare.k8s.errors import ConflictError
-from tpushare.utils import const, pod as podutils
+from tpushare.utils import const, locks, pod as podutils
 
 log = logging.getLogger(__name__)
 
@@ -104,7 +103,7 @@ class TPUSharePlugin:
         #: Serializes match->record->commit: concurrent Allocate RPCs
         #: (the gRPC servicer runs on a thread pool) must not both match
         #: the same pending container.
-        self._alloc_lock = threading.Lock()
+        self._alloc_lock = locks.TracingRLock("plugin/alloc")
         self._load_state()
 
     # ------------------------------------------------------------------ #
@@ -535,6 +534,12 @@ class TPUSharePlugin:
             # heartbeats, i.e. frame an innocent pod as the overrunner.
             pod_dir = os.path.join(self.usage_dir, pod.uid)
             os.makedirs(pod_dir, exist_ok=True)
+            # World-writable on purpose: tenant containers on
+            # runAsNonRoot fleets must be able to write usage.json, and
+            # the plugin cannot know the pod's runAsUser at Allocate
+            # time. The dir is pod-private anyway — Allocate mounts
+            # ONLY this subdirectory into this pod (docs/install.md).
+            os.chmod(pod_dir, 0o777)
             envs[const.ENV_USAGE_FILE] = os.path.join(pod_dir,
                                                       "usage.json")
             mounts = ((pod_dir, pod_dir, False),)

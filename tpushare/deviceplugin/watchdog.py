@@ -46,7 +46,7 @@ from prometheus_client import CollectorRegistry, Gauge, generate_latest
 
 from tpushare.api.objects import Pod
 from tpushare.k8s import events
-from tpushare.k8s.errors import ConflictError
+from tpushare.k8s.errors import ApiError, ConflictError, NotFoundError
 from tpushare.utils import const, pod as podutils
 
 log = logging.getLogger(__name__)
@@ -156,6 +156,13 @@ class GrantWatchdog:
                 overruns.append(entry)
         evicted = self._maybe_evict(pods)
         self._gc_series(live_series)
+        # Prune streaks for pods that vanished (deleted/moved) while
+        # over grant: with evict_after=0 nothing else ever drops them,
+        # and sub-threshold streaks would otherwise accumulate forever
+        # on a churny fleet (ADVICE round 5).
+        live_uids = {p.uid for p in pods}
+        for uid in [u for u in self._over_streak if u not in live_uids]:
+            self._over_streak.pop(uid, None)
         return {"node": self.node_name, "tenants": tenants,
                 "overruns": overruns, "evicted": evicted}
 
@@ -290,18 +297,66 @@ class GrantWatchdog:
             if pod is None:
                 self._over_streak.pop(uid, None)
                 continue
-            events.record(
-                self.client, pod, REASON_EVICTED,
-                f"evicting: HBM grant overrun persisted for {streak} "
-                f"consecutive sweeps (policy TPUSHARE_EVICT_OVERRUN)",
-                event_type="Warning")
             try:
-                self.client.delete_pod(pod.namespace, pod.name)
+                # pods/eviction subresource, NOT a bare DELETE: the
+                # apiserver then honors PodDisruptionBudgets, matching
+                # the scheduler-side preemption path's PDB-aware
+                # semantics (ADVICE round 5). 429 == a PDB is blocking
+                # the disruption right now.
+                self.client.evict_pod(pod.namespace, pod.name)
                 evicted.append(pod.uid)
                 log.warning("evicted overrunning pod %s", pod.key())
+                events.record(
+                    self.client, pod, REASON_EVICTED,
+                    f"evicting: HBM grant overrun persisted for {streak} "
+                    f"consecutive sweeps (policy TPUSHARE_EVICT_OVERRUN)",
+                    event_type="Warning")
+                self._over_streak.pop(uid, None)
+            except NotFoundError:
+                # Pod vanished between the list and the eviction: the
+                # overrun is moot; the end-of-sweep prune drops the
+                # streak next pass.
+                pass
+            except ApiError as e:
+                if e.status == 429:
+                    # PDB-protected: keep the streak so the eviction
+                    # retries once the budget allows a disruption.
+                    log.warning("eviction of %s blocked by a "
+                                "PodDisruptionBudget; will retry",
+                                pod.key())
+                elif e.status in (403, 405):
+                    # Old RBAC (no pods/eviction create rule) or an
+                    # apiserver without the subresource: fall back to
+                    # the bare DELETE this policy used before, LOUDLY —
+                    # the fallback bypasses PDBs, and silently losing
+                    # enforcement on a rolled-forward image with
+                    # un-reapplied RBAC would be worse.
+                    log.error(
+                        "pods/eviction unavailable for %s (%s); falling "
+                        "back to DELETE (PDBs BYPASSED) — apply the "
+                        "updated RBAC in config/tpushare-device-plugin"
+                        ".yaml", pod.key(), e)
+                    try:
+                        self.client.delete_pod(pod.namespace, pod.name)
+                        evicted.append(pod.uid)
+                        log.warning("deleted overrunning pod %s "
+                                    "(eviction fallback)", pod.key())
+                        events.record(
+                            self.client, pod, REASON_EVICTED,
+                            f"evicting (DELETE fallback, PDBs "
+                            f"bypassed): HBM grant overrun persisted "
+                            f"for {streak} consecutive sweeps (policy "
+                            f"TPUSHARE_EVICT_OVERRUN)",
+                            event_type="Warning")
+                        self._over_streak.pop(uid, None)
+                    except Exception:  # noqa: BLE001
+                        log.exception("fallback deletion of %s failed",
+                                      pod.key())
+                else:
+                    log.warning("eviction of %s failed (%s)",
+                                pod.key(), e)
             except Exception:  # noqa: BLE001
                 log.exception("eviction of %s failed", pod.key())
-            self._over_streak.pop(uid, None)
         return evicted
 
     def _gc_series(self, live: set[tuple[str, str]]) -> None:

@@ -100,7 +100,7 @@ class GangPlanner:
         self.ttl = ttl
         self._interval = housekeeping_interval
         self._groups: dict[tuple[str, str], _Group] = {}
-        self._table_lock = threading.Lock()
+        self._table_lock = locks.TracingRLock("gang/table")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         #: Persistent binding-POST pool. Created lazily (most planner
@@ -109,7 +109,7 @@ class GangPlanner:
         #: cost ~13 ms of the 33 ms gang-commit p50 (VERDICT round 2,
         #: weakness 3).
         self._pool: ThreadPoolExecutor | None = None
-        self._pool_lock = threading.Lock()
+        self._pool_lock = locks.TracingRLock("gang/pool")
 
     def _executor(self) -> ThreadPoolExecutor | None:
         """The persistent POST pool, or None once :meth:`stop` ran — a

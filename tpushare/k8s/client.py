@@ -196,6 +196,17 @@ class ApiClient:
     def delete_pod(self, namespace: str, name: str) -> None:
         self._request("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
 
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """PDB-honoring deletion via the ``pods/eviction`` subresource:
+        the apiserver answers 429 while a matching PodDisruptionBudget
+        has no disruptions left, instead of silently bypassing it the
+        way a bare DELETE does. Needs a ``pods/eviction`` create RBAC
+        rule (config/tpushare-device-plugin.yaml)."""
+        self._request(
+            "POST", f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
+            body={"apiVersion": "policy/v1", "kind": "Eviction",
+                  "metadata": {"name": name, "namespace": namespace}})
+
     def create_pod(self, raw: dict) -> Pod:
         ns = raw.get("metadata", {}).get("namespace", "default")
         return Pod(self._request("POST", f"/api/v1/namespaces/{ns}/pods",

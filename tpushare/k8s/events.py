@@ -25,6 +25,7 @@ import threading
 import time
 
 from tpushare.api.objects import Pod
+from tpushare.utils import locks
 
 log = logging.getLogger(__name__)
 
@@ -32,7 +33,7 @@ _seq = itertools.count(1)
 
 _queue: "queue.Queue[tuple[object, str, dict]]" = queue.Queue(maxsize=1024)
 _worker: threading.Thread | None = None
-_worker_lock = threading.Lock()
+_worker_lock = locks.TracingRLock("events/worker")
 
 
 def _drain() -> None:

@@ -14,10 +14,10 @@ from __future__ import annotations
 import copy
 import itertools
 import queue
-import threading
 
 from tpushare.api.objects import Node, Pod, PodDisruptionBudget
-from tpushare.k8s.errors import ConflictError, NotFoundError
+from tpushare.utils import locks
+from tpushare.k8s.errors import ApiError, ConflictError, NotFoundError
 
 
 def _dcopy(obj):
@@ -37,7 +37,7 @@ class FakeApiServer:
     """Thread-safe in-memory pod/node store with watch fan-out."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = locks.TracingRLock("fake/apiserver")
         self._pods: dict[str, dict] = {}   # "ns/name" -> raw pod
         self._nodes: dict[str, dict] = {}  # name -> raw node
         self._leases: dict[str, dict] = {}  # "ns/name" -> raw lease
@@ -141,6 +141,30 @@ class FakeApiServer:
             if pod is None:
                 raise NotFoundError(reason=f"pod {key} not found")
             self._notify("Pod", "DELETED", pod)
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """``POST pods/{name}/eviction`` with real PDB semantics: while
+        a matching PodDisruptionBudget has ``disruptionsAllowed`` 0 the
+        eviction is refused with 429 (the real apiserver's behavior),
+        so callers exercising the eviction path see the PDB-blocked
+        case the bare DELETE path never surfaces."""
+        with self._lock:
+            key = f"{namespace}/{name}"
+            raw = self._pods.get(key)
+            if raw is None:
+                raise NotFoundError(reason=f"pod {key} not found")
+            pod = Pod(_dcopy(raw))
+            for pdb_raw in self._pdbs.values():
+                pdb = PodDisruptionBudget(_dcopy(pdb_raw))
+                if (pdb.matches(pod) and pdb.disruptions_allowed <= 0
+                        and pod.name not in pdb.disrupted_pods):
+                    raise ApiError(
+                        429, reason="TooManyRequests",
+                        body=f"Cannot evict pod as it would violate "
+                             f"the pod's disruption budget "
+                             f"{pdb.namespace}/{pdb.name}")
+            self._pods.pop(key)
+            self._notify("Pod", "DELETED", raw)
 
     def bind_pod(self, binding: dict) -> None:
         """``POST pods/{name}/binding`` — sets spec.nodeName (reference

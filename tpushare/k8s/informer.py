@@ -14,6 +14,7 @@ import logging
 import threading
 
 from tpushare.api.objects import Node, Pod, PodDisruptionBudget
+from tpushare.utils import locks
 
 log = logging.getLogger(__name__)
 
@@ -24,8 +25,8 @@ _WRAPPERS = {"Pod": Pod, "Node": Node,
 class Store:
     """Thread-safe keyed object store (the lister)."""
 
-    def __init__(self):
-        self._lock = threading.RLock()
+    def __init__(self, site: str = "informer/store"):
+        self._lock = locks.TracingRLock(site)
         self._items: dict[str, object] = {}
 
     @staticmethod
@@ -66,9 +67,9 @@ class InformerHub:
 
     def __init__(self, client):
         self.client = client
-        self.pods = Store()
-        self.nodes = Store()
-        self.pdbs = Store()
+        self.pods = Store("informer/pods")
+        self.nodes = Store("informer/nodes")
+        self.pdbs = Store("informer/pdbs")
         self._handlers: dict[str, list] = {"Pod": [], "Node": [],
                                            "PodDisruptionBudget": []}
         self._synced = threading.Event()

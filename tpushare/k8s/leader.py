@@ -37,6 +37,7 @@ import time
 from datetime import datetime, timedelta, timezone
 
 from tpushare.k8s.errors import ApiError, ConflictError
+from tpushare.utils import locks
 
 log = logging.getLogger(__name__)
 
@@ -74,7 +75,7 @@ class LeaderElector:
         self.renew_period = renew_period
         self._leader = False
         self._last_renew = 0.0  # monotonic time of last confirmed renewal
-        self._lock = threading.Lock()
+        self._lock = locks.TracingRLock("leader/state")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 

@@ -7,7 +7,7 @@ here are the source of the bench harness's latency numbers.
 
 from __future__ import annotations
 
-import threading
+from tpushare.utils import locks
 
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
 
@@ -16,7 +16,7 @@ REGISTRY = CollectorRegistry()
 # Scrapes run on ThreadingHTTPServer threads; the clear()+repopulate in
 # observe_cache must not interleave with another scrape's render() or
 # that scrape would see missing/partial node series.
-_SCRAPE_LOCK = threading.RLock()
+_SCRAPE_LOCK = locks.TracingRLock("metrics/scrape")
 
 _BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,

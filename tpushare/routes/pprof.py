@@ -30,6 +30,8 @@ import threading
 import time
 import traceback
 
+from tpushare.utils import locks
+
 
 def thread_dump() -> str:
     """All-threads stack dump (goroutine-profile analogue)."""
@@ -46,7 +48,7 @@ def thread_dump() -> str:
 #: Only one CPU profile may run at a time (Go's pprof likewise rejects a
 #: concurrent CPU profile) — N stacked samplers would each walk every
 #: thread's frames under the GIL and tax the webhook hot path.
-_profile_lock = threading.Lock()
+_profile_lock = locks.TracingRLock("pprof/profile")
 
 
 class ProfileBusyError(Exception):
@@ -151,7 +153,7 @@ def sample_block_profile(seconds: float = 5.0, hz: int = 100,
 #: Serializes start/stop/snapshot on tracemalloc: concurrent ?stop=1 and
 #: snapshot requests on the threading server must not race (stop between
 #: is_tracing() and take_snapshot() would 500 the snapshot).
-_heap_lock = threading.Lock()
+_heap_lock = locks.TracingRLock("pprof/heap")
 
 
 def heap_snapshot(top: int = 30, stop: bool = False) -> str:

@@ -28,6 +28,7 @@ at all the webhook allows everything (fail-open, matching the
 from __future__ import annotations
 
 import logging
+from typing import Callable
 
 from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
@@ -41,7 +42,8 @@ log = logging.getLogger(__name__)
 class Admission:
     name = "tpushare-admission"
 
-    def __init__(self, cache: SchedulerCache, node_lister=None):
+    def __init__(self, cache: SchedulerCache,
+                 node_lister: Callable[[], list] | None = None) -> None:
         self.cache = cache
         #: enumerate fleet nodes (informer lister); cache.get_node_infos
         #: only knows nodes already touched by a filter call.

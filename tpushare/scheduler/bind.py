@@ -13,8 +13,10 @@ all-or-nothing.
 from __future__ import annotations
 
 import logging
+from typing import Any, Callable
 
 from tpushare.api.extender import ExtenderBindingArgs, ExtenderBindingResult
+from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
 from tpushare.cache.nodeinfo import AllocationError
 from tpushare.gang.planner import GangPending
@@ -29,8 +31,10 @@ log = logging.getLogger(__name__)
 class Bind:
     name = "tpushare-bind"
 
-    def __init__(self, cache: SchedulerCache, client, gang_planner=None,
-                 pod_lister=None):
+    def __init__(self, cache: SchedulerCache, client: Any,
+                 gang_planner: Any = None,
+                 pod_lister: Callable[[str, str], Pod | None] | None = None,
+                 ) -> None:
         self.cache = cache
         self.client = client
         self.gang_planner = gang_planner
@@ -39,7 +43,7 @@ class Bind:
         #: lister path.
         self.pod_lister = pod_lister
 
-    def _get_pod(self, args: ExtenderBindingArgs):
+    def _get_pod(self, args: ExtenderBindingArgs) -> Pod | None:
         """Lister-first pod fetch with UID-guarded apiserver fallback
         (reference gpushare-bind.go:44-65 guards stale identity)."""
         pod = None

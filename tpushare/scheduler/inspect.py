@@ -12,7 +12,10 @@ the free HBM is.
 
 from __future__ import annotations
 
+from typing import Any, Callable
+
 from tpushare.cache.cache import SchedulerCache
+from tpushare.cache.nodeinfo import NodeInfo
 from tpushare.utils import const
 from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
@@ -21,13 +24,14 @@ from tpushare.utils import pod as podutils
 class Inspect:
     name = "tpushare-inspect"
 
-    def __init__(self, cache: SchedulerCache, node_lister=None,
-                 gang_planner=None):
+    def __init__(self, cache: SchedulerCache,
+                 node_lister: Callable[[], list] | None = None,
+                 gang_planner: Any = None) -> None:
         self.cache = cache
         self._node_lister = node_lister  # () -> list[Node], for all-nodes view
         self._gang_planner = gang_planner  # in-flight group visibility
 
-    def _build_node(self, info) -> dict:
+    def _build_node(self, info: NodeInfo) -> dict:
         """Per-node document (reference inspect.go:33-71)."""
         chips = []
         used_total = 0

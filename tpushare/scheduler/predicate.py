@@ -15,11 +15,13 @@ bind time.
 from __future__ import annotations
 
 import logging
-import threading
+from typing import Callable
 import time
 
 from tpushare.api.extender import ExtenderArgs, ExtenderFilterResult
+from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
+from tpushare.utils import locks
 from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
 
@@ -48,15 +50,17 @@ class DemandTracker:
     or terminated is pruned on the spot, replica-independently. The
     ``ttl`` is only the backstop for a missing lookup."""
 
-    def __init__(self, ttl: float = 900.0, pod_lookup=None):
+    def __init__(self, ttl: float = 900.0,
+                 pod_lookup: Callable[[str, str], Pod | None] | None = None,
+                 ) -> None:
         self.ttl = ttl
         #: Optional lister-style fetch ``(ns, name) -> Pod | None``.
         self.pod_lookup = pod_lookup
-        self._lock = threading.Lock()
+        self._lock = locks.TracingRLock("predicate/unschedulable")
         #: uid -> (hbm GiB, chips, (ns, name), last-seen monotonic)
         self._entries: dict[str, tuple[int, int, tuple, float]] = {}
 
-    def record_unplaceable(self, pod) -> None:
+    def record_unplaceable(self, pod: Pod) -> None:
         hbm = podutils.get_hbm_from_pod_resource(pod)
         chips = podutils.get_chips_from_pod_resource(pod)
         with self._lock:
@@ -115,11 +119,11 @@ class Predicate:
     name = "tpushare-filter"
 
     def __init__(self, cache: SchedulerCache,
-                 demand: DemandTracker | None = None):
+                 demand: DemandTracker | None = None) -> None:
         self.cache = cache
         self.demand = demand or DemandTracker()
 
-    def filter_node(self, pod, node_name: str) -> tuple[bool, str]:
+    def filter_node(self, pod: Pod, node_name: str) -> tuple[bool, str]:
         """The per-node admission check (reference
         gpushare-predicate.go:16-37), run with higher-or-equal-priority
         NOMINATED pods assumed present (upstream scheduler semantics) —

@@ -51,11 +51,13 @@ Victim-selection policy (TPU-first):
 from __future__ import annotations
 
 import logging
+from typing import Callable, Iterable
 
 from tpushare.api.extender import (ExtenderPreemptionArgs,
                                    ExtenderPreemptionResult)
 from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
+from tpushare.cache.chipinfo import ChipInfo
 from tpushare.cache.nodeinfo import NodeInfo, apply_nominated_demand
 from tpushare.utils import pod as podutils
 
@@ -65,7 +67,8 @@ log = logging.getLogger(__name__)
 class Preempt:
     name = "tpushare-preempt"
 
-    def __init__(self, cache: SchedulerCache, pdb_lister=None):
+    def __init__(self, cache: SchedulerCache,
+                 pdb_lister: Callable[[], list] | None = None) -> None:
         self.cache = cache
         #: Zero-arg callable returning the current PodDisruptionBudgets
         #: (wired to the informer's pdbs store). None = no PDB view:
@@ -84,7 +87,8 @@ class Preempt:
         return pod.priority < preemptor.priority
 
     @staticmethod
-    def _victim_order(pod: Pod, contrib: int, preferred: set[str]):
+    def _victim_order(pod: Pod, contrib: int,
+                      preferred: set[str]) -> tuple[int, int, int, int]:
         """Sort key: lowest priority first (same criteria order as
         ``_plan_cost``); among equals prefer non-gang pods, then pods the
         scheduler already nominated, then the largest contribution
@@ -94,7 +98,7 @@ class Preempt:
                 0 if pod.uid in preferred else 1,
                 -contrib)
 
-    def _plan_chip_hbm(self, chip, need: int, preemptor: Pod,
+    def _plan_chip_hbm(self, chip: ChipInfo, need: int, preemptor: Pod,
                        preferred: set[str]) -> list[tuple[Pod, int]] | None:
         """Cheapest victim set on one chip that frees ≥ ``need`` GiB
         beyond what is already free; None when even evicting every legal
@@ -218,7 +222,7 @@ class Preempt:
         if len(clearable) < req_chips:
             return None
 
-        def union_plan(chip_set) -> list[tuple[Pod, int]]:
+        def union_plan(chip_set: Iterable[int]) -> list[tuple[Pod, int]]:
             merged: dict[str, list] = {}
             for i in chip_set:
                 for p, c in clearable[i]:

@@ -34,8 +34,11 @@ from __future__ import annotations
 
 import logging
 import statistics
+from typing import Any
 
 from tpushare.api.extender import ExtenderArgs, HostPriority
+from tpushare.api.objects import Pod
+from tpushare.cache.nodeinfo import NodeInfo
 from tpushare.cache.cache import SchedulerCache
 from tpushare.utils import const
 from tpushare.utils import node as nodeutils
@@ -49,8 +52,8 @@ MAX_SCORE = 10
 class Prioritize:
     name = "tpushare-prioritize"
 
-    def __init__(self, cache: SchedulerCache, gang_planner=None,
-                 policy: str = "binpack"):
+    def __init__(self, cache: SchedulerCache, gang_planner: Any = None,
+                 policy: str = "binpack") -> None:
         """``policy``: ``"binpack"`` (default — tightest fit, maximizes
         whole-free chips for future multi-chip pods; the policy the
         whole bench story is built on) or ``"spread"`` (inverted fit —
@@ -67,7 +70,7 @@ class Prioritize:
         self.gang_planner = gang_planner
         self.policy = policy
 
-    def _policy_for(self, pod) -> str:
+    def _policy_for(self, pod: Pod) -> str:
         """Effective policy: the pod's ``tpushare.io/scoring`` annotation
         when valid, else the fleet default — inference pods spread while
         trainers bin-pack in one fleet. Unknown values fall back to the
@@ -90,7 +93,7 @@ class Prioritize:
     # Per-node scoring
     # ------------------------------------------------------------------ #
 
-    def _score_hbm(self, info, req: int, gang_nodes: set[str],
+    def _score_hbm(self, info: NodeInfo, req: int, gang_nodes: set[str],
                    policy: str) -> int:
         avail = info.get_available_hbm()
         fits = [(avail[i], info.chips[i].total_hbm)
@@ -130,7 +133,7 @@ class Prioritize:
             score += 1  # consolidate gang slices onto fewer hosts
         return max(0, min(MAX_SCORE, score))
 
-    def _score_chips(self, info, req: int,
+    def _score_chips(self, info: NodeInfo, req: int,
                      member_slices: dict | None,
                      policy: str) -> int:
         free = info.get_free_chips()
@@ -204,7 +207,8 @@ class Prioritize:
                 placement[sid] = coords + (pos[0],)
         return placement
 
-    def score_node(self, pod, node_name: str, gang_nodes: set[str]) -> int:
+    def score_node(self, pod: Pod, node_name: str,
+                   gang_nodes: set[str]) -> int:
         """Convenience single-node entry (tests); ``handle`` inlines the
         request parse across candidates."""
         req_chips = podutils.get_chips_from_pod_resource(pod)
