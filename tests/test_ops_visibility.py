@@ -1,9 +1,13 @@
-"""Gang visibility in inspect/CLI + HTTPS serving."""
+"""Gang visibility in inspect/CLI + HTTPS serving + control-plane
+telemetry (event-drop accounting, workqueue/informer gauges)."""
 
 import json
+import logging
+import queue
 import ssl
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 
@@ -72,6 +76,110 @@ class TestGangVisibility:
                 assert g["committed"] or g["reserved"] < g["minimum"]
         finally:
             cluster.close()
+
+
+def _counter_value(counter) -> float:
+    return counter.collect()[0].samples[0].value
+
+
+class TestEventDropAccounting:
+    """Satellite: a full event queue must COUNT its drops (not just
+    log.debug them) and warn at a bounded rate."""
+
+    def test_queue_full_counts_and_rate_limits_warning(
+            self, monkeypatch, caplog):
+        from tpushare.k8s import events
+        from tpushare.routes import metrics
+        from tpushare.k8s.builders import make_pod
+        from tpushare.api.objects import Pod
+
+        tiny = queue.Queue(maxsize=1)
+        tiny.put(("sentinel", "ns", {}))  # pre-filled: every put drops
+        monkeypatch.setattr(events, "_queue", tiny)
+        monkeypatch.setattr(events, "_last_drop_warn", 0.0)
+        # _ensure_worker would drain the REAL module queue; keep the
+        # test hermetic by making it a no-op.
+        monkeypatch.setattr(events, "_ensure_worker", lambda: None)
+
+        pod = Pod(make_pod("dropped", hbm=8, uid="u-drop"))
+        before = _counter_value(metrics.EVENTS_DROPPED)
+        with caplog.at_level(logging.DEBUG, logger="tpushare.k8s.events"):
+            for _ in range(3):
+                events.record(object(), pod, "TPUShareBound", "m")
+        assert _counter_value(metrics.EVENTS_DROPPED) == before + 3
+        warnings = [r for r in caplog.records
+                    if r.levelno == logging.WARNING]
+        debugs = [r for r in caplog.records if r.levelno == logging.DEBUG]
+        # one warning per window; the other two drops fall to debug
+        assert len(warnings) == 1
+        assert "tpushare_events_dropped_total" in warnings[0].getMessage()
+        assert len(debugs) == 2
+
+    def test_emission_failure_counts_as_drop(self):
+        from tpushare.k8s import events
+        from tpushare.routes import metrics
+        from tpushare.k8s.builders import make_pod
+        from tpushare.api.objects import Pod
+
+        class BrokenClient:
+            def create_event(self, namespace, event):
+                raise RuntimeError("RBAC says no")
+
+        before = _counter_value(metrics.EVENTS_DROPPED)
+        events.record(BrokenClient(), Pod(make_pod("p", hbm=8, uid="u")),
+                      "TPUShareBound", "m")
+        assert events.flush()
+        assert _counter_value(metrics.EVENTS_DROPPED) == before + 1
+
+    def test_backlog_gauge_on_the_wire(self, api, v5e_node):
+        from tests.test_handlers import build_stack
+        from tpushare.routes import metrics
+
+        cache, _, _, _, inspect = build_stack(api)
+        text = metrics.scrape(inspect.cache).decode()
+        assert "tpushare_events_queue_depth" in text
+
+
+class TestWorkqueueTelemetry:
+    def test_stats_snapshot(self):
+        from tpushare.k8s.workqueue import RateLimitedQueue
+
+        q = RateLimitedQueue(base_delay=60.0)  # delays never promote
+        q.add("a")
+        q.add("b")
+        got = q.get(timeout=0.1)
+        assert got == "a"
+        q.add_rate_limited("failed-1")
+        q.add_rate_limited("failed-1")
+        st = q.stats()
+        assert st["depth"] == 1          # "b" ready
+        assert st["delayed"] == 2        # two backoff entries
+        assert st["in_flight"] == 1      # "a" held by this "worker"
+        assert st["retries"] == 2        # cumulative, survives forget
+        q.forget("failed-1")
+        assert q.stats()["retries"] == 2
+
+    def test_gauges_wired_through_scrape(self, api, v5e_node):
+        from tests.test_handlers import build_stack
+        from tpushare.k8s.workqueue import RateLimitedQueue
+        from tpushare.routes import metrics
+
+        q = RateLimitedQueue(base_delay=60.0)
+        q.add("ns/pod-1")
+        q.add_rate_limited("ns/pod-2")
+        cache, _, _, _, inspect = build_stack(api)
+        text = metrics.scrape(inspect.cache, workqueue=q).decode()
+        assert "tpushare_workqueue_depth 2.0" in text
+        assert "tpushare_workqueue_retries_total 1.0" in text
+
+    def test_informer_relist_counter(self, api):
+        from tpushare.k8s.informer import InformerHub
+        from tpushare.routes import metrics
+
+        before = _counter_value(metrics.INFORMER_RELISTS)
+        hub = InformerHub(api)
+        hub._handle_relist("Pod", hub.pods, [])
+        assert _counter_value(metrics.INFORMER_RELISTS) == before + 1
 
 
 class TestHTTPS:

@@ -250,6 +250,40 @@ class TestInspectCLI:
                          "--whatif-chips", "1"]) == 2
         assert "mutually exclusive" in capsys.readouterr().err
 
+    def test_explain_renders_decision_timeline(self, api, cluster, capsys):
+        """`kubectl inspect tpushare explain <pod>`: the flight
+        recorder's trace as an operator-readable timeline."""
+        import kubectl_inspect_tpushare as cli
+
+        api.create_pod(make_pod("traced", hbm=8))
+        assert cluster.schedule(make_pod("traced", hbm=8))[0]
+        assert cli.main(["--endpoint", cluster.base,
+                         "explain", "traced"]) == 0
+        out = capsys.readouterr().out
+        assert "TRACE " in out and "outcome: bound" in out
+        assert "filter" in out and "allocate" in out
+        assert "tpushare.io/trace-id" in out  # the correlation hint
+
+        # --explain flag form is equivalent
+        assert cli.main(["--endpoint", cluster.base,
+                         "--explain", "default/traced"]) == 0
+        assert "outcome: bound" in capsys.readouterr().out
+
+        # unknown pod: clear failure, not a stack trace
+        assert cli.main(["--endpoint", cluster.base,
+                         "explain", "ghost"]) == 1
+        assert "no decision trace" in capsys.readouterr().err
+
+        # explain without a pod is a usage error
+        assert cli.main(["--endpoint", cluster.base, "explain"]) == 2
+        assert "explain needs a pod" in capsys.readouterr().err
+
+        # a node filter next to --explain is refused, not silently
+        # dropped (review finding)
+        assert cli.main(["--endpoint", cluster.base, "v5e-0",
+                         "--explain", "traced"]) == 2
+        assert "cannot be combined" in capsys.readouterr().err
+
 
 def test_debug_routes_can_be_disabled(api):
     """DEBUG_ROUTES=0 (advisor finding: unauthenticated profiling shares

@@ -1,0 +1,433 @@
+"""Decision tracing: the flight recorder explains every placement.
+
+Covers the ISSUE-2 acceptance contract: a pod scheduled through the
+fake cluster harness yields one trace whose spans cover filter (with a
+reason for every rejected node), bind, and allocate, with non-negative
+per-phase durations summing to <= wall time; the same trace-id lands in
+the bind annotation and the TPUShareBound Event; the ring buffer stays
+bounded under churn; /debug/flight and /debug/trace honor 404 and
+DEBUG_ROUTES=0; lock-wait is attributed via the TracingRLock contention
+hook; and the wire round-trip (annotation + Event) holds over a REAL
+apiserver dialect (tests/miniapiserver.py)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tpushare import trace
+from tpushare.k8s import events
+from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+from tpushare.utils import const, locks
+
+
+@pytest.fixture(autouse=True)
+def fresh_recorder():
+    trace.reset()
+    yield
+    trace.reset()
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post(url, doc):
+    req = urllib.request.Request(
+        url, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ------------------------------------------------------------------------ #
+# Recorder unit behavior
+# ------------------------------------------------------------------------ #
+
+
+class TestRecorder:
+    def test_phase_spans_and_completion(self):
+        rec = trace.recorder()
+        with trace.phase("filter", "default", "p", "u1") as dec:
+            trace.note("passed", ["n1"])
+        assert dec.outcome == "open"
+        with trace.phase("bind", "default", "p", "u1") as dec2:
+            with trace.span("allocate", node="n1"):
+                trace.note("chips", [0])
+        assert dec2 is dec  # same open decision across verbs
+        trace.complete(dec2, "bound", node="n1")
+        doc = rec.get_trace("default", "p")
+        assert doc["outcome"] == "bound" and doc["node"] == "n1"
+        phases = [s["phase"] for s in doc["spans"]]
+        assert phases == ["filter", "bind", "allocate"]
+        assert doc["spans"][2]["depth"] == 1
+        assert all(s["seconds"] >= 0 for s in doc["spans"])
+
+    def test_span_cannot_leak_on_exception(self):
+        with trace.phase("bind", "default", "p", "u1") as dec:
+            with pytest.raises(RuntimeError):
+                with trace.span("allocate"):
+                    raise RuntimeError("boom")
+            # the inner span was force-closed; the stack is back at the
+            # bind span, so new notes attach there
+            assert dec.innermost().phase == "bind"
+
+    def test_note_is_noop_without_decision(self):
+        trace.note("rejections", {"n": "r"})  # must not throw
+        with trace.span("allocate") as sp:
+            assert sp is None  # disabled outside a decision
+
+    def test_ring_bounded_under_churn(self):
+        rec = trace.recorder()
+        for i in range(trace.DEFAULT_CAPACITY * 2):
+            with trace.phase("bind", "default", f"p{i}", f"u{i}") as dec:
+                pass
+            trace.complete(dec, "bound", node="n")
+        flight = rec.flight()
+        assert len(flight) == trace.DEFAULT_CAPACITY
+        # newest first, oldest churned out
+        assert flight[0]["name"] == f"p{trace.DEFAULT_CAPACITY * 2 - 1}"
+
+    def test_open_table_bounded(self):
+        rec = trace.recorder()
+        for i in range(rec._max_open + 10):
+            with trace.phase("filter", "default", f"open{i}", f"u{i}"):
+                pass  # never completed
+        with rec._lock:
+            assert len(rec._open) <= rec._max_open
+        # the evicted ones were retired into the ring as abandoned
+        assert any(d["outcome"] == "abandoned" for d in rec.flight())
+
+    def test_recreated_pod_supersedes_old_attempt(self):
+        with trace.phase("filter", "default", "p", "uid-old") as old:
+            pass
+        with trace.phase("filter", "default", "p", "uid-new") as new:
+            pass
+        assert old.trace_id != new.trace_id
+        docs = [d for d in trace.flight() if d["name"] == "p"]
+        assert docs and docs[0]["outcome"] == "superseded"
+
+    def test_flight_limit(self):
+        for i in range(10):
+            with trace.phase("bind", "default", f"p{i}", f"u{i}") as dec:
+                pass
+            trace.complete(dec, "bound")
+        assert len(trace.flight(3)) == 3
+
+
+class TestLockWaitAttribution:
+    def test_contended_acquire_lands_in_current_span(self):
+        lock = locks.TracingRLock("fixture/trace-wait")
+        held = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lock:
+                held.set()
+                release.wait(timeout=5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert held.wait(timeout=5)
+        with trace.phase("bind", "default", "p", "u1") as dec:
+            threading.Timer(0.05, release.set).start()
+            with lock:  # contended: the holder releases ~50ms in
+                pass
+        t.join()
+        trace.complete(dec, "bound")
+        doc = trace.get_trace("default", "p")
+        bind_span = doc["spans"][0]
+        assert bind_span["lockWaitSeconds"] > 0
+        site, waited = bind_span["attrs"]["worstLockSite"]
+        assert site == "fixture/trace-wait" and waited > 0
+
+    def test_recorder_lock_never_self_attributes(self):
+        with trace.phase("bind", "default", "p", "u1") as dec:
+            trace._on_contention("trace/recorder", 1.0)
+            trace._on_contention("node/n1", 0.25)
+        trace.complete(dec, "bound")
+        doc = trace.get_trace("default", "p")
+        assert doc["spans"][0]["lockWaitSeconds"] == pytest.approx(0.25)
+
+
+# ------------------------------------------------------------------------ #
+# The fake-cluster acceptance slice, over real HTTP
+# ------------------------------------------------------------------------ #
+
+
+@pytest.fixture
+def http_stack(api):
+    from tests.test_handlers import build_stack
+    api.create_node(make_node("v5e-node-0"))
+    api.create_node(make_node("cpu-only", chips=0, hbm_per_chip=0,
+                              topology="1"))
+    _, pred, prio, binder, inspect = build_stack(api)
+    server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder, inspect,
+                                prioritize=prio)
+    serve_forever(server)
+    yield api, f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+class TestEndToEndTrace:
+    def _schedule(self, api, base, name="p", uid="u1", hbm=8):
+        api.create_pod(make_pod(name, hbm=hbm, uid=uid))
+        status, doc = _post(f"{base}/tpushare-scheduler/filter", {
+            "Pod": make_pod(name, hbm=hbm),
+            "NodeNames": ["v5e-node-0", "cpu-only"]})
+        assert status == 200
+        _post(f"{base}/tpushare-scheduler/prioritize", {
+            "Pod": make_pod(name, hbm=hbm),
+            "NodeNames": doc["NodeNames"]})
+        status, bind_doc = _post(f"{base}/tpushare-scheduler/bind", {
+            "PodName": name, "PodNamespace": "default", "PodUID": uid,
+            "Node": "v5e-node-0"})
+        assert status == 200, bind_doc
+
+    def test_acceptance_trace_contract(self, http_stack):
+        """ISSUE-2 acceptance: spans cover filter (reason per rejected
+        node), bind, allocate; durations sum <= wall; trace-id in both
+        the bind annotation and the TPUShareBound Event."""
+        api, base = http_stack
+        self._schedule(api, base)
+        status, doc = _get(f"{base}/debug/trace/default/p")
+        assert status == 200
+        assert doc["outcome"] == "bound" and doc["node"] == "v5e-node-0"
+
+        phases = [s["phase"] for s in doc["spans"]]
+        for wanted in ("filter", "prioritize", "bind", "allocate"):
+            assert wanted in phases, phases
+
+        f_span = doc["spans"][phases.index("filter")]
+        # a reason for EVERY rejected node
+        assert set(f_span["attrs"]["rejections"]) == {"cpu-only"}
+        assert "no shareable TPU HBM" in f_span["attrs"]["rejections"]["cpu-only"]
+        assert f_span["attrs"]["passed"] == ["v5e-node-0"]
+
+        a_span = doc["spans"][phases.index("allocate")]
+        assert a_span["depth"] == 1  # nested under bind
+        assert a_span["attrs"]["chips"] == [0]
+
+        assert all(s["seconds"] >= 0 for s in doc["spans"])
+        assert all(s["lockWaitSeconds"] >= 0 for s in doc["spans"])
+        top = sum(s["seconds"] for s in doc["spans"] if s["depth"] == 0)
+        assert top <= doc["wallSeconds"] + 1e-6
+
+        # correlation: annotation and Event carry the trace-id
+        tid = doc["traceId"]
+        stored = api.get_pod("default", "p")
+        assert stored.annotations[const.ANN_TRACE_ID] == tid
+        assert events.flush()
+        bound = [e for _ns, e in api.events
+                 if e["reason"] == "TPUShareBound"
+                 and e["involvedObject"]["name"] == "p"]
+        assert bound and f"[trace {tid}]" in bound[-1]["message"]
+
+    def test_flight_lists_completed_decisions(self, http_stack):
+        api, base = http_stack
+        self._schedule(api, base)
+        status, doc = _get(f"{base}/debug/flight")
+        assert status == 200
+        assert any(d["name"] == "p" and d["outcome"] == "bound"
+                   for d in doc["decisions"])
+        status, doc = _get(f"{base}/debug/flight?n=1")
+        assert len(doc["decisions"]) == 1
+
+    def test_unschedulable_pod_completes_with_reasons(self, http_stack):
+        api, base = http_stack
+        api.create_pod(make_pod("big", hbm=999, uid="u-big"))
+        _post(f"{base}/tpushare-scheduler/filter", {
+            "Pod": make_pod("big", hbm=999),
+            "NodeNames": ["v5e-node-0", "cpu-only"]})
+        status, doc = _get(f"{base}/debug/trace/default/big")
+        assert status == 200
+        assert doc["outcome"] == "unschedulable"
+        rejections = doc["spans"][0]["attrs"]["rejections"]
+        assert set(rejections) == {"v5e-node-0", "cpu-only"}
+
+    def test_non_tpu_pod_is_not_traced(self, http_stack):
+        api, base = http_stack
+        _post(f"{base}/tpushare-scheduler/filter", {
+            "Pod": make_pod("plain"), "NodeNames": ["v5e-node-0"]})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/debug/trace/default/plain")
+        assert exc.value.code == 404
+
+    def test_trace_404_for_unknown_pod_and_bad_path(self, http_stack):
+        _, base = http_stack
+        for path in ("/debug/trace/default/ghost", "/debug/trace/default",
+                     "/debug/trace/a/b/c"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{base}{path}")
+            assert exc.value.code == 404, path
+
+    def test_debug_routes_off_hides_flight_and_trace(self, api):
+        from tests.test_handlers import build_stack
+        api.create_node(make_node("v5e-node-0"))
+        _, pred, prio, binder, inspect = build_stack(api)
+        server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder,
+                                    inspect, prioritize=prio,
+                                    debug_routes=False)
+        serve_forever(server)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            for path in ("/debug/flight", "/debug/trace/default/p"):
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(f"{base}{path}")
+                assert exc.value.code == 404
+                assert "disabled" in json.loads(exc.value.read())["Error"]
+        finally:
+            server.shutdown()
+
+
+class TestGangEventCorrelation:
+    def test_commit_events_carry_each_members_own_trace_id(self, api):
+        """Quorum commit emits Events for EVERY member from the
+        completing member's thread — each must carry ITS pod's
+        trace-id (the one in its bind annotation), not the
+        completer's."""
+        from tests.test_e2e import Cluster
+
+        for i in range(2):
+            api.create_node(make_node(f"v5p-{i}", chips=4, hbm_per_chip=95,
+                                      topology="2x2x1", tpu_type="v5p"))
+        cluster = Cluster(api)
+        try:
+            ann = {const.ANN_POD_GROUP: "traced-gang",
+                   const.ANN_POD_GROUP_MIN: "2"}
+            for name in ("w0", "w1"):
+                doc = make_pod(name, chips=4, annotations=ann)
+                api.create_pod(doc)
+                cluster.schedule(doc)
+            assert events.flush()
+            tids = {}
+            for name in ("w0", "w1"):
+                tids[name] = api.get_pod(
+                    "default", name).annotations[const.ANN_TRACE_ID]
+            assert tids["w0"] != tids["w1"]  # one decision per member
+            committed = {e["involvedObject"]["name"]: e["message"]
+                         for _ns, e in api.events
+                         if e["reason"] == "TPUShareGangCommitted"}
+            assert set(committed) == {"w0", "w1"}
+            for name, message in committed.items():
+                assert f"[trace {tids[name]}]" in message, (name, message)
+        finally:
+            cluster.close()
+
+
+# ------------------------------------------------------------------------ #
+# Wire round-trip over the real apiserver dialect
+# ------------------------------------------------------------------------ #
+
+
+class TestMiniApiServerRoundTrip:
+    def test_trace_id_round_trips_annotation_and_event(self):
+        from tests.miniapiserver import MiniApiServer
+        from tpushare.cache.cache import SchedulerCache
+        from tpushare.k8s.client import ApiClient, ClusterConfig
+        from tpushare.scheduler.bind import Bind
+        from tpushare.scheduler.predicate import Predicate
+        from tpushare.api.extender import ExtenderArgs, ExtenderBindingArgs
+
+        server = MiniApiServer().start()
+        try:
+            client = ApiClient(ClusterConfig(
+                host=f"http://127.0.0.1:{server.port}"))
+            server.seed_node(make_node("v5e-node-0"))
+            server.seed_pod(make_pod("wirepod", hbm=8, uid="u-wire"))
+
+            cache = SchedulerCache(client.get_node, client.list_pods)
+            pred = Predicate(cache)
+            binder = Bind(cache, client)
+
+            with trace.phase("filter", "default", "wirepod",
+                             "u-wire") as dec:
+                result = pred.handle(ExtenderArgs.from_json({
+                    "Pod": make_pod("wirepod", hbm=8),
+                    "NodeNames": ["v5e-node-0"]}))
+            assert result.node_names == ["v5e-node-0"]
+            with trace.phase("bind", "default", "wirepod",
+                             "u-wire") as dec:
+                bind_result = binder.handle(ExtenderBindingArgs(
+                    pod_name="wirepod", pod_namespace="default",
+                    pod_uid="u-wire", node="v5e-node-0"))
+            assert bind_result.error == ""
+            trace.complete(dec, "bound", node="v5e-node-0")
+
+            doc = trace.get_trace("default", "wirepod")
+            tid = doc["traceId"]
+            # the bind+allocate spans saw real apiserver round-trips
+            by_phase = {s["phase"]: s for s in doc["spans"]}
+            assert by_phase["allocate"]["apiCalls"] >= 2  # PUT + binding
+            assert by_phase["allocate"]["apiSeconds"] > 0
+
+            stored = client.get_pod("default", "wirepod")
+            assert stored.annotations[const.ANN_TRACE_ID] == tid
+            assert stored.node_name == "v5e-node-0"
+
+            assert events.flush()
+            with server.store.lock:
+                posted = list(server.store.events)
+            bound = [e for e in posted if e["reason"] == "TPUShareBound"]
+            assert bound and f"[trace {tid}]" in bound[-1]["message"]
+        finally:
+            server.close()
+
+
+# ------------------------------------------------------------------------ #
+# Structured logging
+# ------------------------------------------------------------------------ #
+
+
+class TestJsonLogging:
+    def test_formatter_tags_trace_id(self):
+        import logging
+
+        from tpushare.trace.jsonlog import TraceJsonFormatter
+
+        fmt = TraceJsonFormatter()
+        record = logging.LogRecord("tpushare.test", logging.INFO, __file__,
+                                   1, "allocated pod %s", ("default/p",),
+                                   None)
+        outside = json.loads(fmt.format(record))
+        assert outside["message"] == "allocated pod default/p"
+        assert "traceId" not in outside
+
+        with trace.phase("bind", "default", "p", "u1") as dec:
+            inside = json.loads(fmt.format(record))
+        trace.complete(dec, "bound")
+        assert inside["traceId"] == dec.trace_id
+        assert inside["level"] == "INFO"
+        assert inside["ts"].endswith("Z")
+
+    def test_env_switch_installs_formatter(self, monkeypatch):
+        import logging
+
+        from tpushare.cmd.main import configure_logging
+        from tpushare.trace.jsonlog import TraceJsonFormatter
+
+        root = logging.getLogger()
+        saved = list(root.handlers)
+        for h in saved:
+            root.removeHandler(h)
+        try:
+            monkeypatch.setenv("TPUSHARE_LOG_JSON", "1")
+            monkeypatch.delenv("LOG_DIR", raising=False)
+            configure_logging()
+            ours = [h for h in root.handlers
+                    if getattr(h, "_tpushare_console", False)]
+            assert ours
+            assert isinstance(ours[0].formatter, TraceJsonFormatter)
+        finally:
+            for h in list(root.handlers):
+                root.removeHandler(h)
+            for h in saved:
+                root.addHandler(h)

@@ -152,6 +152,38 @@ def test_aliased_imports_do_not_dodge_rules():
         path="tpushare/routes/mod.py")
 
 
+def test_catches_swallowed_telemetry_error():
+    """The seeded defect: an except on a telemetry path that swallows
+    the error without counting the drop — the exact pre-PR-2 shape of
+    events.py's queue-full handler (log.debug and nothing else)."""
+    swallow = ("try:\n"
+               "    q.put_nowait(x)\n"
+               "except Exception:\n"
+               "    log.debug('dropping')\n")
+    for path in ("tpushare/k8s/events.py", "tpushare/routes/metrics.py",
+                 "tpushare/trace/recorder.py"):
+        assert "swallowed-telemetry-error" in _rules_hit(swallow, path=path)
+    # outside the telemetry files the rule does not apply
+    assert "swallowed-telemetry-error" not in _rules_hit(
+        swallow, path="tpushare/controller/controller.py")
+    # counting the drop satisfies the contract, in any accepted shape
+    for fix in ("metrics.safe_inc(metrics.EVENTS_DROPPED)",
+                "safe_inc(EVENTS_DROPPED)",
+                "self.drops.inc()",
+                "dropped += 1"):
+        src = ("try:\n"
+               "    q.put_nowait(x)\n"
+               "except Exception:\n"
+               f"    {fix}\n"
+               "    log.debug('dropping')\n")
+        assert "swallowed-telemetry-error" not in _rules_hit(
+            src, path="tpushare/k8s/events.py"), fix
+    # re-raising is not a swallow
+    assert "swallowed-telemetry-error" not in _rules_hit(
+        "try:\n    f()\nexcept Exception:\n    raise\n",
+        path="tpushare/trace/recorder.py")
+
+
 def test_catches_raw_lock_construction():
     src = "import threading\nL = threading.Lock()\n"
     assert "raw-lock" in _rules_hit(src)

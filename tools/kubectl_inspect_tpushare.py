@@ -127,6 +127,92 @@ def render(doc: dict, details: bool = False) -> str:
     return "\n".join(lines)
 
 
+def fetch_trace(endpoint: str, namespace: str, pod: str) -> dict | None:
+    """One pod's latest decision trace from the extender's flight
+    recorder; None when the recorder has nothing for it (pod never
+    scheduled here, ring already churned past it, or DEBUG_ROUTES=0)."""
+    url = f"{endpoint}/debug/trace/{namespace}/{pod}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def render_trace(doc: dict) -> str:
+    """Human-readable timeline of one placement decision."""
+    ms = 1e3
+    outcome = doc.get("outcome", "?")
+    where = f" -> {doc['node']}" if doc.get("node") else ""
+    lines = [
+        f"TRACE {doc.get('traceId', '?')}  pod "
+        f"{doc.get('namespace', '?')}/{doc.get('name', '?')}  "
+        f"outcome: {outcome}{where}  "
+        f"wall {doc.get('wallSeconds', 0) * ms:.1f} ms "
+        f"(started {doc.get('startedAt', '?')})",
+    ]
+    if doc.get("error"):
+        lines.append(f"  error: {doc['error']}")
+    header = (f"  {'PHASE':<12} {'START':>9} {'TOOK':>9} {'LOCKWAIT':>9} "
+              f"{'APISERVER':>10}")
+    lines.append(header)
+    for sp in doc.get("spans", []):
+        indent = "  " * sp.get("depth", 0)
+        api = sp.get("apiSeconds", 0) * ms
+        calls = sp.get("apiCalls", 0)
+        lines.append(
+            f"  {indent + sp.get('phase', '?'):<12} "
+            f"+{sp.get('startOffsetSeconds', 0) * ms:7.1f}ms "
+            f"{sp.get('seconds', 0) * ms:7.1f}ms "
+            f"{sp.get('lockWaitSeconds', 0) * ms:7.1f}ms "
+            f"{api:7.1f}ms" + (f" ({calls} call(s))" if calls else ""))
+        attrs = sp.get("attrs", {})
+        rejections = attrs.get("rejections")
+        if rejections:
+            for node, reason in sorted(rejections.items()):
+                lines.append(f"      rejected {node}: {reason}")
+        passed = attrs.get("passed")
+        if passed is not None:
+            lines.append(f"      passed {len(passed)} node(s): "
+                         + (", ".join(passed) or "-"))
+        scores = attrs.get("scores")
+        if scores:
+            ranked = sorted(scores.items(), key=lambda kv: -kv[1])
+            lines.append("      scores: " + ", ".join(
+                f"{n}={s}" for n, s in ranked))
+        victims = attrs.get("victimsPerNode")
+        if victims:
+            lines.append("      victims planned: " + ", ".join(
+                f"{n}:{c}" for n, c in sorted(victims.items())))
+        for key, label in (("chips", "chips"), ("hbmGiB", "HBM GiB"),
+                           ("quorum", "gang quorum")):
+            if key in attrs:
+                lines.append(f"      {label}: {attrs[key]}")
+        worst = attrs.get("worstLockSite")
+        if worst:
+            lines.append(f"      worst lock wait: {worst[0]} "
+                         f"({worst[1] * ms:.1f} ms)")
+    lines.append("  correlate: kubectl describe pod shows the same id in "
+                 "the tpushare.io/trace-id annotation and Event messages")
+    return "\n".join(lines)
+
+
+def explain(endpoint: str, target: str) -> tuple[int, str]:
+    """``explain [ns/]pod``: (exit code, rendered timeline)."""
+    namespace, _, pod = target.rpartition("/")
+    namespace = namespace or "default"
+    doc = fetch_trace(endpoint, namespace, pod)
+    if doc is None:
+        return 1, (f"no decision trace for {namespace}/{pod} — the pod "
+                   "was not scheduled by this extender recently (the "
+                   "flight recorder keeps the last "
+                   "~256 decisions), or debug routes are disabled "
+                   "(DEBUG_ROUTES=0)")
+    return 0, render_trace(doc)
+
+
 def whatif_preempt(endpoint: str, hbm: int, chips: int, priority: int,
                    node: str | None) -> str:
     """Dry-run the preempt verb: which pods would a (hypothetical)
@@ -197,7 +283,16 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="kubectl inspect tpushare",
         description="Show TPU HBM allocation across sharing nodes.")
-    parser.add_argument("node", nargs="?", help="restrict to one node")
+    parser.add_argument("node", nargs="?",
+                        help="restrict to one node; or the literal "
+                             "'explain' to render a pod's decision trace")
+    parser.add_argument("pod", nargs="?", metavar="[ns/]pod",
+                        help="with 'explain': the pod whose placement "
+                             "decision to explain (namespace defaults "
+                             "to 'default')")
+    parser.add_argument("--explain", metavar="[ns/]POD",
+                        help="render the extender's decision trace for "
+                             "POD as a timeline (same as: explain POD)")
     parser.add_argument("--endpoint", default=DEFAULT_ENDPOINT,
                         help=f"extender base URL (default {DEFAULT_ENDPOINT})")
     parser.add_argument("-d", "--details", action="store_true",
@@ -212,6 +307,32 @@ def main(argv: list[str] | None = None) -> int:
                         metavar="P", help="priority of the hypothetical "
                                           "pod (default 1000)")
     args = parser.parse_args(argv)
+    explain_target = args.explain
+    if args.explain and args.node:
+        # A node filter (or the 'explain' keyword) next to --explain is
+        # ambiguous: refuse rather than silently drop what was typed.
+        print(f"--explain cannot be combined with the positional "
+              f"{args.node!r}; use one form", file=sys.stderr)
+        return 2
+    if args.node == "explain":
+        if not args.pod:
+            print("explain needs a pod: kubectl inspect tpushare "
+                  "explain [ns/]pod", file=sys.stderr)
+            return 2
+        explain_target = args.pod
+    elif args.pod:
+        print(f"unexpected argument {args.pod!r} (a second positional "
+              "is only valid after 'explain')", file=sys.stderr)
+        return 2
+    if explain_target:
+        try:
+            rc, out = explain(args.endpoint, explain_target)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(out, file=sys.stdout if rc == 0 else sys.stderr)
+        return rc
     whatif = (args.whatif_hbm is not None or args.whatif_chips is not None)
     if args.whatif_hbm is not None and args.whatif_chips is not None:
         print("--whatif-hbm and --whatif-chips are mutually exclusive "

@@ -270,5 +270,68 @@ def raw_lock(tree: ast.AST, src: str, path: str) -> list[Violation]:
     return out
 
 
+# --------------------------------------------------------------------------
+# swallowed-telemetry-error: telemetry paths must count what they drop
+# --------------------------------------------------------------------------
+
+#: Files whose except blocks sit on telemetry paths: events emission,
+#: the metrics scrape, and the decision tracer. Swallowing an error
+#: there silently erases an observation — the operator's dashboard says
+#: "quiet fleet" when the truth is "blind fleet". Every swallow must
+#: increment a drop/error counter so the loss itself is observable.
+_TELEMETRY_PATHS = ("k8s/events.py", "routes/metrics.py")
+_TELEMETRY_DIRS = ("tpushare/trace/",)
+
+#: Call shapes that count as incrementing a drop/error counter
+#: (bare ``safe_inc(...)``, ``metrics.safe_inc(...)``, ``x.inc()``).
+_COUNTER_CALL_NAMES = {"safe_inc"}
+_COUNTER_CALL_ATTRS = {"inc", "safe_inc"}
+
+
+def _handler_raises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _handler_counts_drop(handler: ast.ExceptHandler) -> bool:
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Call):
+            fn = n.func
+            if isinstance(fn, ast.Name) and fn.id in _COUNTER_CALL_NAMES:
+                return True
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in _COUNTER_CALL_ATTRS):
+                return True
+        if isinstance(n, ast.AugAssign) and isinstance(n.op, ast.Add):
+            tgt = n.target
+            name = (tgt.attr if isinstance(tgt, ast.Attribute)
+                    else tgt.id if isinstance(tgt, ast.Name) else "")
+            if any(w in name.lower() for w in ("drop", "err")):
+                return True
+    return False
+
+
+@_rule("swallowed-telemetry-error")
+def swallowed_telemetry_error(tree: ast.AST, src: str,
+                              path: str) -> list[Violation]:
+    """In telemetry files (``k8s/events.py``, ``routes/metrics.py``,
+    ``tpushare/trace/``): an ``except`` that neither re-raises nor
+    increments a drop/error counter (``safe_inc(...)``, ``x.inc()``, or
+    ``drops/errors += n``) hides a lost observation. The counter is the
+    contract: telemetry may drop, but the drop must be countable."""
+    p = _posix(path)
+    if not (any(p.endswith(t) for t in _TELEMETRY_PATHS)
+            or any(d in p for d in _TELEMETRY_DIRS)):
+        return []
+    return [Violation(
+        path, node.lineno, node.col_offset, "swallowed-telemetry-error",
+        "except block on a telemetry path swallows the error without "
+        "incrementing a drop/error counter (use safe_inc(...) or "
+        "<counter>.inc())")
+        for node in ast.walk(tree)
+        if isinstance(node, ast.ExceptHandler)
+        and not _handler_raises(node)
+        and not _handler_counts_drop(node)]
+
+
 LINT_RULES = (annotation_literal, unlocked_mutation, bare_except,
-              sleep_in_handler, raw_lock)
+              sleep_in_handler, raw_lock, swallowed_telemetry_error)

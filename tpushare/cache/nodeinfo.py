@@ -21,6 +21,7 @@ from __future__ import annotations
 import time
 from typing import Any
 
+from tpushare import trace
 from tpushare.utils import locks
 from tpushare.api.objects import Node, Pod, binding_doc
 from tpushare.cache.chipinfo import ChipInfo
@@ -345,16 +346,22 @@ class NodeInfo:
 
         Returns the annotated pod as accepted by the apiserver.
         """
-        with self._lock:
+        # The span opens BEFORE the ledger lock so a contended acquire
+        # is attributed to this allocate phase, not its caller's.
+        with trace.span("allocate", node=self.name), self._lock:
             chip_ids = self.pick_chips(pod)  # raises AllocationError
             if podutils.get_chips_from_pod_resource(pod) > 0:
                 hbm_pod = sum(self.chips[c].total_hbm for c in chip_ids)
             else:
                 hbm_pod = podutils.get_hbm_from_pod_resource(pod)
             hbm_chip = self.chips[chip_ids[0]].total_hbm
+            trace.note("chips", list(chip_ids))
+            trace.note("hbmGiB", hbm_pod)
 
+            trace_id = trace.current_trace_id() or None
             new_pod = podutils.updated_pod_annotation_spec(
-                pod, chip_ids, hbm_pod, hbm_chip, assume_time_ns=time.time_ns()
+                pod, chip_ids, hbm_pod, hbm_chip,
+                assume_time_ns=time.time_ns(), trace_id=trace_id
             )
             try:
                 new_pod = client.update_pod(new_pod)
@@ -362,7 +369,7 @@ class NodeInfo:
                 fresh = client.get_pod(pod.namespace, pod.name)
                 new_pod = podutils.updated_pod_annotation_spec(
                     fresh, chip_ids, hbm_pod, hbm_chip,
-                    assume_time_ns=time.time_ns(),
+                    assume_time_ns=time.time_ns(), trace_id=trace_id,
                 )
                 new_pod = client.update_pod(new_pod)
 

@@ -16,6 +16,10 @@ Environment (reference cmd/main.go:23,92-98):
   (``debug.log`` … ``critical.log``, each holding exactly its level —
   the reference's beego AdapterMultiFile layout, cmd/main.go:35-54).
   Console stays at LOG_LEVEL; the files are full-fidelity.
+* ``TPUSHARE_LOG_JSON`` — set 1/true for structured console logs: one
+  JSON object per line, each tagged with the decision trace-id active
+  on the emitting thread (correlates with ``/debug/trace`` and the
+  ``tpushare.io/trace-id`` bind annotation).
 * ``DEBUG_ROUTES`` — set 0/false to disable the /debug/pprof suite
   (it shares the webhook NodePort and the profiler taxes the hot path)
 * ``LEADER_ELECT`` — set 1/true to join Lease-based leader election so
@@ -134,7 +138,8 @@ def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2):
         address, stack.predicate, stack.binder, stack.inspect,
         prioritize=stack.prioritize, preempt=stack.preempt,
         admission=stack.admission,
-        gang_planner=stack.binder.gang_planner)
+        gang_planner=stack.binder.gang_planner,
+        workqueue=stack.controller.queue)
     serve_forever(server)
     return stack, server
 
@@ -169,6 +174,16 @@ def configure_logging(level_name: str | None = None,
         # runner's pre-existing handlers are never touched.
         for handler in root.handlers:
             handler._tpushare_console = True
+    if os.environ.get("TPUSHARE_LOG_JSON", "").lower() in ("1", "true",
+                                                           "yes"):
+        # Structured console: one JSON object per line, trace-id tagged
+        # so the aggregator pivots log lines <-> /debug/trace decisions.
+        # Only OUR console handler is reformatted — a host app keeps its
+        # own format.
+        from tpushare.trace.jsonlog import TraceJsonFormatter
+        for handler in root.handlers:
+            if getattr(handler, "_tpushare_console", False):
+                handler.setFormatter(TraceJsonFormatter())
     log_dir = log_dir if log_dir is not None else os.environ.get(
         "LOG_DIR", "")
     # Idempotency: drop any per-level file handlers a previous call
@@ -256,7 +271,8 @@ def main() -> None:
                                 admission=stack.admission,
                                 leader=leader,
                                 gang_planner=stack.binder.gang_planner,
-                                debug_routes=debug_routes)
+                                debug_routes=debug_routes,
+                                workqueue=stack.controller.queue)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
     if bool(cert) != bool(key):
         log.error("TLS misconfigured: exactly one of TLS_CERT_FILE / "

@@ -36,6 +36,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from tpushare import trace
 from tpushare.utils import locks
 from tpushare.api.objects import Pod, binding_doc
 from tpushare.cache.nodeinfo import AllocationError
@@ -306,7 +307,9 @@ class GangPlanner:
             return  # already fully placed (idempotent retry)
 
         key, group = self._get_group(pod)
-        with group.lock:
+        with trace.span("gang", group=group.name), group.lock:
+            trace.note("quorum",
+                       f"{len(group.reservations)}/{group.minimum}")
             if pod.uid not in group.reservations:
                 if podutils.is_assumed(pod):
                     # Reserved in a previous life (e.g. planner restart):
@@ -377,7 +380,11 @@ class GangPlanner:
                 self.client, member_pod, events.REASON_GANG_COMMITTED,
                 f"gang {group.name} reached quorum "
                 f"({reserved_n}/{group.minimum}); "
-                f"committing to node {member_node}")
+                f"committing to node {member_node}",
+                # Each member's Event must carry ITS OWN decision's id
+                # (the one in its bind annotation) — the thread-local
+                # default here is the quorum-COMPLETING member's trace.
+                trace_id=member_pod.annotations.get(const.ANN_TRACE_ID, ""))
         # Raises only if THIS member's own binding failed.
         self._commit(key, group, current_uid=pod.uid)
 
@@ -546,7 +553,10 @@ class GangPlanner:
                         self.client, pod, events.REASON_GANG_EXPIRED,
                         f"gang {group.name} expired at "
                         f"{len(group.reservations)}/{group.minimum} members; "
-                        "reservation rolled back", event_type="Warning")
+                        "reservation rolled back", event_type="Warning",
+                        # Housekeeping thread: no thread-local trace —
+                        # correlate via the member's own annotation.
+                        trace_id=pod.annotations.get(const.ANN_TRACE_ID, ""))
                 group.reservations.clear()
                 with self._table_lock:
                     self._groups.pop(key, None)
@@ -559,7 +569,7 @@ class GangPlanner:
             ann = fresh.metadata.get("annotations") or {}
             for k in (const.ANN_CHIP_IDX, const.ANN_HBM_POD,
                       const.ANN_HBM_CHIP, const.ANN_ASSIGNED,
-                      const.ANN_ASSUME_TIME):
+                      const.ANN_ASSUME_TIME, const.ANN_TRACE_ID):
                 ann.pop(k, None)
             fresh.raw.setdefault("spec", {}).pop("nodeName", None)
             self.client.update_pod(fresh)

@@ -19,10 +19,12 @@ import os
 import queue
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.request
 from urllib.parse import quote
 
+from tpushare import trace
 from tpushare.api.objects import Node, Pod, PodDisruptionBudget
 from tpushare.k8s.errors import ApiError, ConflictError, NotFoundError
 
@@ -146,6 +148,11 @@ class ApiClient:
             req.add_header("Content-Type", "application/json")
         if self.config.token:
             req.add_header("Authorization", f"Bearer {self.config.token}")
+        # Decision tracing: attribute this round-trip (success OR error
+        # — a failed call still cost its RTT) to the caller's open span.
+        # Outside a traced decision note_api_call is a no-op, so watch
+        # threads and the controller pay one thread-local read.
+        t0 = time.perf_counter()
         try:
             with urllib.request.urlopen(req, timeout=timeout,
                                         context=self._ssl) as resp:
@@ -160,6 +167,9 @@ class ApiClient:
             raise ApiError(e.code, reason=e.reason, body=body_text) from None
         except urllib.error.URLError as e:
             raise ApiError(0, reason=str(e.reason)) from None
+        finally:
+            trace.note_api_call(time.perf_counter() - t0,
+                                method=method, path=path)
 
     # ------------------------------------------------------------------ #
     # Pods

@@ -24,7 +24,9 @@ import queue
 import threading
 import time
 
+from tpushare import trace
 from tpushare.api.objects import Pod
+from tpushare.routes import metrics
 from tpushare.utils import locks
 
 log = logging.getLogger(__name__)
@@ -35,6 +37,19 @@ _queue: "queue.Queue[tuple[object, str, dict]]" = queue.Queue(maxsize=1024)
 _worker: threading.Thread | None = None
 _worker_lock = locks.TracingRLock("events/worker")
 
+#: Monotonic stamp of the last queue-full log.warning: a saturated
+#: queue drops MANY events, and one warning per drop would make the
+#: log itself the next victim. One warning per window, the rest debug;
+#: the tpushare_events_dropped_total counter carries the real rate.
+_drop_warn_interval_s = 30.0
+_last_drop_warn = 0.0
+
+
+def queue_depth() -> int:
+    """Current emission backlog (events accepted, not yet POSTed) —
+    exported as the tpushare_events_queue_depth gauge."""
+    return _queue.qsize()
+
 
 def _drain() -> None:
     while True:
@@ -42,6 +57,9 @@ def _drain() -> None:
         try:
             client.create_event(namespace, event)
         except Exception as exc:  # noqa: BLE001 - observability must not throw
+            # An emission failure IS a dropped event: count it, or a
+            # broken events RBAC rule looks exactly like a quiet fleet.
+            metrics.safe_inc(metrics.EVENTS_DROPPED)
             log.debug("event emission failed for %s/%s: %s",
                       namespace, event["metadata"]["name"], exc)
         finally:
@@ -81,9 +99,21 @@ REASON_GANG_COMMITTED = "TPUShareGangCommitted"
 
 
 def record(client, pod: Pod, reason: str, message: str,
-           event_type: str = "Normal") -> None:
+           event_type: str = "Normal", trace_id: str | None = None) -> None:
     """Best-effort, non-blocking Event creation; never lets
-    observability break (or slow) the scheduling path."""
+    observability break (or slow) the scheduling path.
+
+    The decision trace-id is appended to the message — so ``kubectl
+    describe pod`` shows the key that looks the full story up in
+    ``/debug/trace``. It defaults to the trace active on the emitting
+    thread; pass ``trace_id`` explicitly when recording about ANOTHER
+    pod's decision (gang commit/expiry emit for every member from one
+    thread — each Event must carry ITS pod's id, the one in that pod's
+    bind annotation, or the annotation↔Event correlation breaks)."""
+    if trace_id is None:
+        trace_id = trace.current_trace_id()
+    if trace_id:
+        message = f"{message} [trace {trace_id}]"
     now_dt = datetime.datetime.now(datetime.timezone.utc)
     now = now_dt.strftime("%Y-%m-%dT%H:%M:%SZ")
     # Name like client-go's recorder: pod + a time-derived component, so
@@ -115,6 +145,20 @@ def record(client, pod: Pod, reason: str, message: str,
     try:
         _queue.put_nowait((client, pod.namespace, event))
     except queue.Full:
-        log.debug("event queue full; dropping %s for %s", reason, pod.key())
+        global _last_drop_warn
+        metrics.safe_inc(metrics.EVENTS_DROPPED)
+        now = time.monotonic()
+        if now - _last_drop_warn >= _drop_warn_interval_s:
+            # Benign race on the stamp: the worst case is one extra
+            # warning, never a missed counter increment.
+            _last_drop_warn = now
+            log.warning(
+                "event queue full (%d backlogged); dropping %s for %s "
+                "(further drops logged at debug for %.0fs — watch "
+                "tpushare_events_dropped_total)", _queue.maxsize, reason,
+                pod.key(), _drop_warn_interval_s)
+        else:
+            log.debug("event queue full; dropping %s for %s", reason,
+                      pod.key())
         return
     _ensure_worker()

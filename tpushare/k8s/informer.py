@@ -161,6 +161,11 @@ class InformerHub:
         return q is None or q.unfinished_tasks == 0
 
     def _handle_relist(self, kind: str, store: Store, objs: list) -> None:
+        # Lazy import (controller idiom): metrics pulls prometheus_client,
+        # which informer consumers like the device plugin don't need at
+        # import time.
+        from tpushare.routes import metrics
+        metrics.safe_inc(metrics.INFORMER_RELISTS)
         fresh = {Store.key_of(o): o for o in objs}
         stale = {k: o for k, o in
                  ((key, store.get(key)) for key in

@@ -24,6 +24,9 @@ class RateLimitedQueue:
         self._failures: dict[str, int] = {}
         self._delayed: list[tuple[float, str]] = []  # (ready_at, key) heap
         self._shutdown = False
+        #: Cumulative rate-limited requeues over the queue's lifetime
+        #: (monotonic; feeds the tpushare_workqueue_retries_total gauge).
+        self._retries = 0
 
     # ------------------------------------------------------------------ #
 
@@ -48,6 +51,7 @@ class RateLimitedQueue:
         with self._cond:
             fails = self._failures.get(key, 0)
             self._failures[key] = fails + 1
+            self._retries += 1
         self.add_after(key, min(self._base * (2 ** fails), self._max))
 
     def forget(self, key: str) -> None:
@@ -87,6 +91,18 @@ class RateLimitedQueue:
     def __len__(self) -> int:
         with self._cond:
             return len(self._queue) + len(self._delayed)
+
+    def stats(self) -> dict:
+        """One consistent snapshot for the /metrics scrape: ready
+        backlog, backoff-delayed keys, keys a worker currently holds,
+        and the lifetime rate-limited-requeue count."""
+        with self._cond:
+            return {
+                "depth": len(self._queue),
+                "delayed": len(self._delayed),
+                "in_flight": len(self._processing),
+                "retries": self._retries,
+            }
 
     # ------------------------------------------------------------------ #
 

@@ -82,6 +82,8 @@ def safe_inc(counter, n: float = 1) -> None:
     sites don't copy the try/except."""
     try:
         counter.inc(n)
+    # This IS the drop guard — it cannot count itself.
+    # vet: ignore[swallowed-telemetry-error]
     except Exception:  # pragma: no cover - metrics must not throw
         pass
 GANGS_REAPED = Counter(
@@ -134,6 +136,46 @@ OVERRUN_PODS = Gauge(
     "aggregate of the device plugins' per-pod tpushare_grant_overrun)",
     ["node"], registry=REGISTRY,
 )
+EVENTS_DROPPED = Counter(
+    "tpushare_events_dropped_total",
+    "k8s Events dropped: emission queue full, or the POST to the "
+    "apiserver failed. Nonzero means kubectl-describe is missing part "
+    "of the placement story (check events RBAC / apiserver load)",
+    registry=REGISTRY,
+)
+EVENTS_QUEUE_DEPTH = Gauge(
+    "tpushare_events_queue_depth",
+    "k8s Events accepted but not yet POSTed (the async emitter's "
+    "backlog; sustained growth precedes drops)",
+    registry=REGISTRY,
+)
+WORKQUEUE_DEPTH = Gauge(
+    "tpushare_workqueue_depth",
+    "Sync-controller workqueue backlog: keys ready or in backoff delay "
+    "(in-flight keys excluded). Sustained growth means the ledger is "
+    "falling behind the apiserver",
+    registry=REGISTRY,
+)
+WORKQUEUE_RETRIES = Gauge(
+    "tpushare_workqueue_retries_total",
+    "Cumulative rate-limited requeues of sync keys (failed sync_pod "
+    "attempts re-entering with backoff). Set from the queue's "
+    "monotonic counter at scrape time",
+    registry=REGISTRY,
+)
+INFORMER_RELISTS = Counter(
+    "tpushare_informer_relists_total",
+    "Watch-stream reconnect resyncs (one per kind per reconnect): the "
+    "informer diffed a fresh LIST against its store to recover events "
+    "lost in the gap. A steady rate means the watch keeps dropping",
+    registry=REGISTRY,
+)
+TELEMETRY_ERRORS = Counter(
+    "tpushare_telemetry_errors_total",
+    "Errors swallowed on telemetry paths (metrics scrape parse, trace "
+    "recording) — the code path survived, the observation was lost",
+    registry=REGISTRY,
+)
 
 
 def render() -> bytes:
@@ -176,7 +218,10 @@ def observe_cache(cache) -> None:
                             reported += float(raw)
                             saw_report = True
                         except ValueError:
-                            pass
+                            # A corrupt hbm-used annotation: skip the
+                            # pod's report but surface that telemetry
+                            # was lost.
+                            safe_inc(TELEMETRY_ERRORS)
                     if p.annotations.get(const.ANN_OVERRUN) == \
                             const.ASSIGNED_TRUE:
                         overrunning += 1
@@ -186,8 +231,13 @@ def observe_cache(cache) -> None:
                 OVERRUN_PODS.labels(node=info.name).set(overrunning)
 
 
-def scrape(cache, gang_planner=None, leader=None, demand=None) -> bytes:
+def scrape(cache, gang_planner=None, leader=None, demand=None,
+           workqueue=None) -> bytes:
     """Atomic observe+render for the /metrics handler."""
+    # Import here, not at module top: events.py imports this module for
+    # its drop counter, and a top-level back-import would cycle.
+    from tpushare.k8s import events as k8s_events
+
     with _SCRAPE_LOCK:
         observe_cache(cache)
         if demand is not None:
@@ -201,6 +251,11 @@ def scrape(cache, gang_planner=None, leader=None, demand=None) -> bytes:
             GANGS_PENDING.set(sum(
                 1 for g in gang_planner.stats().values()
                 if not g["committed"]))
+        EVENTS_QUEUE_DEPTH.set(k8s_events.queue_depth())
+        if workqueue is not None:
+            st = workqueue.stats()
+            WORKQUEUE_DEPTH.set(st["depth"] + st["delayed"])
+            WORKQUEUE_RETRIES.set(st["retries"])
         # Election off (single replica) => this replica is the binder.
         IS_LEADER.set(1 if (leader is None or leader.is_leader()) else 0)
         return render()

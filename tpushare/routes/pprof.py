@@ -281,4 +281,8 @@ def index(prefix: str = "/debug/pprof") -> str:
         "timeline as Chrome trace JSON (open in Perfetto)\n"
         f"  {prefix}/heap[?stop=1]             live-allocation snapshot "
         "(stop=1 disables tracing)\n"
-        f"  {prefix}/goroutine                 all-threads stack dump\n")
+        f"  {prefix}/goroutine                 all-threads stack dump\n"
+        "  /debug/flight[?n=K]                decision flight recorder "
+        "(last K placement decisions)\n"
+        "  /debug/trace/<ns>/<pod>            one pod's latest decision "
+        "trace\n")

@@ -14,6 +14,13 @@ Routes:
 * ``GET  /debug/pprof``     — profiling suite (reference pprof.go:10-22):
   ``/profile`` (sampled CPU, collapsed stacks), ``/heap`` (tracemalloc),
   ``/goroutine`` (= ``/debug/threads``, all-threads stack dump)
+* ``GET  /debug/flight``    — decision flight recorder: the last N
+  completed placement decisions (``?n=`` limits the dump)
+* ``GET  /debug/trace/<ns>/<pod>`` — one pod's latest decision trace
+
+The scheduling verbs run inside :mod:`tpushare.trace` phases, so every
+TPU pod's filter → prioritize → (preempt) → bind story is captured
+per-decision, not just aggregated into histograms.
 
 A malformed body is rejected with HTTP 400 *and the handler returns* —
 the reference kept executing after writing the 400 (``checkBody``,
@@ -32,14 +39,24 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import tpushare
+from tpushare import trace
 from tpushare.api.extender import (ExtenderArgs, ExtenderBindingArgs,
                                    ExtenderPreemptionArgs,
                                    host_priority_list_to_json)
 from tpushare.routes import metrics, pprof
+from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
 
 DEFAULT_PREFIX = "/tpushare-scheduler"
+
+
+def _traced_pod(pod) -> bool:
+    """Only TPU pods get decision traces: the filter passes everything
+    else through untouched, and recording those pass-throughs would
+    fill the flight recorder with non-decisions."""
+    return (podutils.is_tpu_sharing_pod(pod)
+            or podutils.is_tpu_chip_pod(pod))
 
 
 class ExtenderHTTPServer(ThreadingHTTPServer):
@@ -49,7 +66,8 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
     def __init__(self, addr, predicate, binder, inspect,
                  prefix: str = DEFAULT_PREFIX, prioritize=None,
                  preempt=None, admission=None, leader=None,
-                 gang_planner=None, debug_routes: bool = True):
+                 gang_planner=None, debug_routes: bool = True,
+                 workqueue=None):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
@@ -69,6 +87,10 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
         #: CPU profiler and tracemalloc tax the hot path, so operators
         #: can switch the routes off (DEBUG_ROUTES=0 in the manifest).
         self.debug_routes = debug_routes
+        #: The sync controller's workqueue, for the /metrics scrape's
+        #: depth/retry gauges. Optional: handler-only deployments (and
+        #: most tests) have no controller.
+        self.workqueue = workqueue
         super().__init__(addr, _Handler)
 
 
@@ -198,10 +220,32 @@ class _Handler(BaseHTTPRequestHandler):
                     metrics.scrape(self.server.inspect.cache,
                                    gang_planner=self.server.gang_planner,
                                    leader=self.server.leader,
-                                   demand=self.server.predicate.demand),
+                                   demand=self.server.predicate.demand,
+                                   workqueue=self.server.workqueue),
                     ctype="text/plain; version=0.0.4")
             elif path.startswith("/debug/") and not self.server.debug_routes:
                 self._send_json({"Error": "debug routes disabled"}, 404)
+            elif path == "/debug/flight":
+                try:
+                    limit = int(self._query().get("n", "0") or 0)
+                except ValueError:
+                    self._send_json({"Error": "n must be an integer"}, 400)
+                    return
+                self._send_json({
+                    "decisions": trace.flight(limit or None),
+                    "recordingDrops": trace.recorder().drops.value,
+                })
+            elif path.startswith("/debug/trace/"):
+                rest = path[len("/debug/trace/"):]
+                ns, sep, pod_name = rest.partition("/")
+                doc = (trace.get_trace(ns, pod_name)
+                       if sep and pod_name and "/" not in pod_name else None)
+                if doc is None:
+                    self._send_json(
+                        {"Error": f"no trace for {rest!r} (want "
+                                  "/debug/trace/<namespace>/<pod>)"}, 404)
+                else:
+                    self._send_json(doc)
             elif path in ("/debug/threads", "/debug/pprof/goroutine"):
                 self._send_text(pprof.thread_dump().encode())
             elif path == "/debug/pprof":
@@ -246,8 +290,24 @@ class _Handler(BaseHTTPRequestHandler):
                 if doc is None:
                     return
                 metrics.FILTER_REQUESTS.inc()
-                with metrics.FILTER_LATENCY.time():
-                    result = self.server.predicate.handle(ExtenderArgs.from_json(doc))
+                args = ExtenderArgs.from_json(doc)
+                with metrics.FILTER_LATENCY.time(), \
+                        trace.phase("filter", args.pod.namespace,
+                                    args.pod.name, args.pod.uid,
+                                    enabled=_traced_pod(args.pod)) as dec:
+                    result = self.server.predicate.handle(args)
+                if dec is not None:
+                    passed = (result.node_names
+                              if result.node_names is not None
+                              else [n.name for n in (result.nodes or [])])
+                    if not passed:
+                        # Rejected on every offered node: this attempt
+                        # is over — a complete story for the recorder
+                        # (the autoscaler-demand case the reference
+                        # could never explain).
+                        trace.complete(
+                            dec, "unschedulable",
+                            error="rejected on every candidate node")
                 self._send_json(result.to_json())
             elif path == f"{prefix}/prioritize":
                 doc = self._read_json()
@@ -257,9 +317,12 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"Error": "prioritize not configured"},
                                     404)
                     return
-                with metrics.PRIORITIZE_LATENCY.time():
-                    entries = self.server.prioritize.handle(
-                        ExtenderArgs.from_json(doc))
+                args = ExtenderArgs.from_json(doc)
+                with metrics.PRIORITIZE_LATENCY.time(), \
+                        trace.phase("prioritize", args.pod.namespace,
+                                    args.pod.name, args.pod.uid,
+                                    enabled=_traced_pod(args.pod)):
+                    entries = self.server.prioritize.handle(args)
                 # HostPriorityList is a bare JSON array on the wire.
                 self._send_json(host_priority_list_to_json(entries))
             elif path == f"{prefix}/preempt":
@@ -269,9 +332,12 @@ class _Handler(BaseHTTPRequestHandler):
                 if self.server.preempt is None:
                     self._send_json({"Error": "preempt not configured"}, 404)
                     return
-                with metrics.PREEMPT_LATENCY.time():
-                    result = self.server.preempt.handle(
-                        ExtenderPreemptionArgs.from_json(doc))
+                pre_args = ExtenderPreemptionArgs.from_json(doc)
+                with metrics.PREEMPT_LATENCY.time(), \
+                        trace.phase("preempt", pre_args.pod.namespace,
+                                    pre_args.pod.name, pre_args.pod.uid,
+                                    enabled=_traced_pod(pre_args.pod)):
+                    result = self.server.preempt.handle(pre_args)
                 self._send_json(result.to_json())
             elif path == f"{prefix}/validate":
                 doc = self._read_json()
@@ -303,13 +369,28 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"Error": "not the leader"}, 503,
                                     extra_headers={"Retry-After": "1"})
                     return
-                with metrics.BIND_LATENCY.time():
+                with metrics.BIND_LATENCY.time(), \
+                        trace.phase("bind", args_parsed.pod_namespace,
+                                    args_parsed.pod_name,
+                                    args_parsed.pod_uid) as dec:
                     result = self.server.binder.handle(args_parsed)
                 if result.error and not result.pending:
                     # GangPending is an expected hold (scheduler retries
                     # until quorum), not a failure — alerting on it would
                     # page during normal gang assembly.
                     metrics.BIND_ERRORS.inc()
+                # Bind always ends the decision: bound, held below gang
+                # quorum (scheduler retries with a fresh attempt), or
+                # failed outright.
+                if result.error and result.pending:
+                    trace.complete(dec, "gang-pending",
+                                   node=args_parsed.node,
+                                   error=result.error)
+                elif result.error:
+                    trace.complete(dec, "failed", node=args_parsed.node,
+                                   error=result.error)
+                else:
+                    trace.complete(dec, "bound", node=args_parsed.node)
                 # Reference returns HTTP 500 when bind fails
                 # (routes.go:139-143) so the scheduler retries.
                 self._send_json(result.to_json(), 500 if result.error else 200)

@@ -18,6 +18,7 @@ import logging
 from typing import Callable
 import time
 
+from tpushare import trace
 from tpushare.api.extender import ExtenderArgs, ExtenderFilterResult
 from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
@@ -166,6 +167,10 @@ class Predicate:
             self.demand.record_unplaceable(pod)
         else:
             self.demand.clear(pod.uid)
+        # Decision trace: the per-node WHY — the one thing the latency
+        # histogram can never answer.
+        trace.note("rejections", dict(failed))
+        trace.note("passed", list(passed_names))
         log.debug(
             "filter pod %s: %d passed, %d failed",
             pod.key(), len(passed_names), len(failed),

@@ -53,6 +53,7 @@ from __future__ import annotations
 import logging
 from typing import Callable, Iterable
 
+from tpushare import trace
 from tpushare.api.extender import (ExtenderPreemptionArgs,
                                    ExtenderPreemptionResult)
 from tpushare.api.objects import Pod
@@ -481,6 +482,8 @@ class Preempt:
             metrics.safe_inc(
                 metrics.PREEMPT_VICTIMS,
                 max(len(v) for v in result.node_victims.values()))
+        trace.note("victimsPerNode",
+                   {n: len(v) for n, v in result.node_victims.items()})
         log.debug("preempt pod %s: %s", pod.key(),
                   {n: len(v) for n, v in result.node_victims.items()})
         return result

@@ -36,6 +36,7 @@ import logging
 import statistics
 from typing import Any
 
+from tpushare import trace
 from tpushare.api.extender import ExtenderArgs, HostPriority
 from tpushare.api.objects import Pod
 from tpushare.cache.nodeinfo import NodeInfo
@@ -257,6 +258,8 @@ class Prioritize:
                    n, req_chips, req_hbm, gang_nodes, member_slices,
                    policy=policy))
                for n in names]
+        trace.note("scores", {e.host: e.score for e in out})
+        trace.note("policy", policy)
         log.debug("prioritize pod %s: %s", pod.key(),
                   {e.host: e.score for e in out})
         return out

@@ -1,0 +1,100 @@
+"""tpushare.trace — the decision flight recorder, module-level face.
+
+One process-wide :class:`~tpushare.trace.recorder.FlightRecorder`
+(module singleton, like :mod:`tpushare.k8s.events`' queue) so every
+layer — routes, scheduler verbs, gang planner, ledger, k8s client —
+reaches the same ring without constructor plumbing. Importing this
+package registers the lock-contention hook, which is what splits each
+span's time into compute vs lock-wait.
+
+Usage map:
+
+* routes wrap each verb:  ``with trace.phase("filter", ns, name, uid):``
+* library code nests:     ``with trace.span("allocate"): ...``
+* verbs attach facts:     ``trace.note("rejections", failed)``
+* the k8s client reports: ``trace.note_api_call(rtt_s, method, path)``
+* routes finalize:        ``trace.complete(dec, "bound", node=node)``
+
+See :mod:`tpushare.trace.recorder` for the model and thread contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpushare.trace.recorder import (DEFAULT_CAPACITY, Decision,
+                                     DropCounter, FlightRecorder, Span,
+                                     new_trace_id)
+from tpushare.utils import locks
+
+__all__ = [
+    "DEFAULT_CAPACITY", "Decision", "DropCounter", "FlightRecorder",
+    "Span", "complete", "current", "current_trace_id", "flight",
+    "get_trace", "new_trace_id", "note", "note_api_call", "phase",
+    "recorder", "reset", "span",
+]
+
+_recorder = FlightRecorder()
+
+
+def recorder() -> FlightRecorder:
+    return _recorder
+
+
+def reset() -> None:
+    """Drop every recorded/open decision (tests)."""
+    _recorder.reset()
+
+
+def phase(verb: str, namespace: str, name: str, uid: str = "",
+          enabled: bool = True) -> Any:
+    return _recorder.phase(verb, namespace, name, uid, enabled=enabled)
+
+
+def span(phase_name: str, **attrs: Any) -> Any:
+    return _recorder.span(phase_name, **attrs)
+
+
+def note(key: str, value: Any) -> None:
+    _recorder.note(key, value)
+
+
+def note_api_call(seconds: float, method: str = "", path: str = "") -> None:
+    _recorder.note_api_call(seconds, method=method, path=path)
+
+
+def current() -> Decision | None:
+    return _recorder.current()
+
+
+def current_trace_id() -> str:
+    return _recorder.current_trace_id()
+
+
+def complete(dec: Decision | None, outcome: str, node: str = "",
+             error: str = "") -> None:
+    _recorder.complete(dec, outcome, node=node, error=error)
+
+
+def flight(limit: int | None = None) -> list[dict]:
+    return _recorder.flight(limit)
+
+
+def get_trace(namespace: str, name: str) -> dict | None:
+    return _recorder.get_trace(namespace, name)
+
+
+def _on_contention(site: str, waited_s: float) -> None:
+    """Lock-wait attribution sink. The recorder's own lock is excluded
+    — attributing the recorder to itself would count bookkeeping as
+    scheduler contention (and the reentrant acquire under the hook
+    could recurse)."""
+    if site.startswith("trace/"):
+        return
+    try:
+        _recorder.note_lock_wait(site, waited_s)
+    except Exception:  # noqa: BLE001 - attribution must not break acquires
+        _recorder.drops.inc()
+
+
+locks.add_contention_hook(_on_contention)

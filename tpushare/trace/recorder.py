@@ -1,0 +1,360 @@
+"""Decision tracing: spans, decisions, and the flight recorder.
+
+The reference shipped pprof but could never answer the operator's
+actual question — *why did this pod land on that chip, or fail
+everywhere?* (SURVEY.md §5). Aggregate histograms
+(:mod:`tpushare.routes.metrics`) say the p99 got worse; they cannot
+explain one placement. This module records each placement attempt as a
+**decision**: a trace-id plus a list of phase **spans** (filter,
+prioritize, preempt, bind, gang, allocate) with per-phase wall time,
+lock-wait time (fed by the ``TracingRLock`` contention hook in
+:mod:`tpushare.utils.locks`), and apiserver round-trip time (fed by
+:class:`tpushare.k8s.client.ApiClient`).
+
+Completed decisions land in a bounded ring buffer — the flight
+recorder, after Go's net/http/pprof flight-recorder pattern: always on,
+fixed memory, and when something goes wrong the last N decisions are
+already captured. ``GET /debug/flight`` dumps the ring;
+``GET /debug/trace/<ns>/<pod>`` returns one pod's latest decision.
+
+Design constraints:
+
+* **stdlib-only** — the recorder must be importable from every layer
+  (cache, k8s client, gang planner) without dragging prometheus_client
+  or anything else along.
+* **Spans cannot leak** — they are opened only through context
+  managers, and closing a span force-closes anything opened under it
+  that a buggy code path failed to close.
+* **Never throws into the scheduling path** — recording trouble
+  increments :class:`DropCounter` and the decision goes on without it.
+
+A decision spans several HTTP requests (the scheduler calls filter,
+then prioritize, then bind as separate POSTs), so open decisions are
+keyed by pod (namespace, name) until an outcome finalizes them:
+``bound``, ``failed``, ``gang-pending``, ``unschedulable`` — or
+``superseded``/``abandoned`` when a new pod instance or table pressure
+retires them. The current decision is carried in a thread-local, which
+matches the server's thread-per-request model.
+"""
+
+from __future__ import annotations
+
+import datetime
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Iterator
+
+from tpushare.utils import locks
+
+#: Decisions kept in the flight-recorder ring.
+DEFAULT_CAPACITY = 256
+#: Open (not yet finalized) decisions tracked at once; beyond this the
+#: oldest is retired as "abandoned" so pods that never bind cannot grow
+#: the table without bound.
+DEFAULT_MAX_OPEN = 512
+
+
+class DropCounter:
+    """Count of recording failures (telemetry must drop, not throw).
+    A plain int bumped under the GIL: a lost increment under a race is
+    an acceptable price for staying off every hot path."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+def new_trace_id() -> str:
+    """96 bits of hex — short enough for an Event message, unique
+    enough for a fleet."""
+    return uuid.uuid4().hex[:12]
+
+
+class Span:
+    """One timed phase of a decision. ``lock_wait_s`` and ``api_s`` are
+    attributed by the contention hook / the k8s client while this span
+    is the innermost open span on its thread."""
+
+    __slots__ = ("phase", "depth", "start_offset_s", "seconds",
+                 "lock_wait_s", "api_s", "api_calls", "attrs", "_t0")
+
+    def __init__(self, phase: str, depth: int, start_offset_s: float) -> None:
+        self.phase = phase
+        self.depth = depth
+        self.start_offset_s = start_offset_s
+        self._t0 = time.perf_counter()
+        self.seconds = 0.0
+        self.lock_wait_s = 0.0
+        self.api_s = 0.0
+        self.api_calls = 0
+        self.attrs: dict[str, Any] = {}
+
+    def close(self) -> None:
+        self.seconds = max(time.perf_counter() - self._t0, 0.0)
+
+    def to_json(self) -> dict:
+        doc: dict[str, Any] = {
+            "phase": self.phase,
+            "depth": self.depth,
+            "startOffsetSeconds": round(self.start_offset_s, 6),
+            "seconds": round(self.seconds, 6),
+            "lockWaitSeconds": round(self.lock_wait_s, 6),
+            "apiSeconds": round(self.api_s, 6),
+            "apiCalls": self.api_calls,
+        }
+        if self.attrs:
+            doc["attrs"] = dict(self.attrs)
+        return doc
+
+
+class Decision:
+    """One placement attempt for one pod: a trace-id and its spans."""
+
+    def __init__(self, trace_id: str, namespace: str, name: str,
+                 uid: str) -> None:
+        self.trace_id = trace_id
+        self.namespace = namespace
+        self.name = name
+        self.uid = uid
+        self.started_at = time.time()
+        self._t0 = time.perf_counter()
+        self.outcome = "open"
+        self.node = ""
+        self.error = ""
+        self.wall_s = 0.0
+        self.done = False
+        self.spans: list[Span] = []
+        #: Open-span stack (the innermost receives lock/api attribution).
+        self._stack: list[Span] = []
+
+    # -- span lifecycle -------------------------------------------------- #
+
+    def open_span(self, phase: str, **attrs: Any) -> Span:
+        sp = Span(phase, len(self._stack),
+                  time.perf_counter() - self._t0)
+        if attrs:
+            sp.attrs.update(attrs)
+        self.spans.append(sp)
+        self._stack.append(sp)
+        return sp
+
+    def close_span(self, sp: Span) -> None:
+        """Close ``sp`` AND anything still open under it — a code path
+        that raised past an inner span must not leak it onto the stack
+        (the context-manager API makes this the only close path)."""
+        while self._stack:
+            top = self._stack.pop()
+            top.close()
+            if top is sp:
+                return
+        # sp was already off the stack (double close): idempotent.
+
+    def innermost(self) -> Span | None:
+        return self._stack[-1] if self._stack else None
+
+    # -- completion ------------------------------------------------------ #
+
+    def finish(self, outcome: str, node: str = "", error: str = "") -> None:
+        if self.done:
+            return
+        self.done = True
+        self.outcome = outcome
+        self.node = node
+        self.error = error
+        self.wall_s = max(time.perf_counter() - self._t0, 0.0)
+
+    def to_json(self) -> dict:
+        started = datetime.datetime.fromtimestamp(
+            self.started_at, datetime.timezone.utc)
+        wall = (self.wall_s if self.done
+                else max(time.perf_counter() - self._t0, 0.0))
+        doc: dict[str, Any] = {
+            "traceId": self.trace_id,
+            "namespace": self.namespace,
+            "name": self.name,
+            "uid": self.uid,
+            "startedAt": started.strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z",
+            "wallSeconds": round(wall, 6),
+            "outcome": self.outcome,
+            "node": self.node,
+            "error": self.error,
+            # list() snapshots against a concurrent append from the
+            # handler thread; Span objects are append-only after open.
+            "spans": [sp.to_json() for sp in list(self.spans)],
+        }
+        return doc
+
+
+class FlightRecorder:
+    """Bounded ring of completed decisions + the open-decision table.
+
+    Thread model: each decision is mutated only by the handler thread
+    that holds it as its thread-local current; the recorder's lock
+    guards the table and ring. Readers (``/debug/flight``) snapshot
+    under the lock and serialize outside it.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 max_open: int = DEFAULT_MAX_OPEN) -> None:
+        self._lock = locks.TracingRLock("trace/recorder")
+        self._capacity = capacity
+        self._max_open = max_open
+        self._ring: deque[Decision] = deque(maxlen=capacity)
+        self._open: dict[tuple[str, str], Decision] = {}
+        self._tls = threading.local()
+        self.drops = DropCounter()
+
+    # -- current-decision plumbing --------------------------------------- #
+
+    def current(self) -> Decision | None:
+        return getattr(self._tls, "decision", None)
+
+    def current_trace_id(self) -> str:
+        dec = self.current()
+        return dec.trace_id if dec is not None else ""
+
+    # -- phases ----------------------------------------------------------- #
+
+    @contextmanager
+    def phase(self, verb: str, namespace: str, name: str, uid: str = "",
+              enabled: bool = True) -> Iterator[Decision | None]:
+        """Enter verb ``verb`` for pod ``namespace/name``: bind the
+        pod's open decision (creating one if needed) to this thread and
+        open a span named after the verb. ``enabled=False`` is a no-op
+        pass-through so call sites keep one code path for non-TPU pods.
+        """
+        if not enabled:
+            yield None
+            return
+        dec = self._lookup_or_begin(namespace, name, uid)
+        prev = getattr(self._tls, "decision", None)
+        self._tls.decision = dec
+        sp = dec.open_span(verb)
+        try:
+            yield dec
+        finally:
+            dec.close_span(sp)
+            self._tls.decision = prev
+
+    def _lookup_or_begin(self, namespace: str, name: str,
+                         uid: str) -> Decision:
+        key = (namespace, name)
+        with self._lock:
+            dec = self._open.get(key)
+            if (dec is not None and uid and dec.uid and dec.uid != uid):
+                # Same pod name, new UID: a recreated pod. The old
+                # attempt can never complete — retire it.
+                del self._open[key]
+                dec.finish("superseded")
+                self._ring.append(dec)
+                dec = None
+            if dec is None:
+                while len(self._open) >= self._max_open:
+                    oldest = min(self._open,
+                                 key=lambda k: self._open[k].started_at)
+                    evicted = self._open.pop(oldest)
+                    evicted.finish("abandoned")
+                    self._ring.append(evicted)
+                dec = Decision(new_trace_id(), namespace, name, uid)
+                self._open[key] = dec
+            elif uid and not dec.uid:
+                dec.uid = uid
+            return dec
+
+    def complete(self, dec: Decision | None, outcome: str, node: str = "",
+                 error: str = "") -> None:
+        """Finalize ``dec`` with an outcome and move it to the ring.
+        ``None`` (a disabled phase) and double completion are no-ops."""
+        if dec is None or dec.done:
+            return
+        with self._lock:
+            if self._open.get((dec.namespace, dec.name)) is dec:
+                del self._open[(dec.namespace, dec.name)]
+            dec.finish(outcome, node, error)
+            self._ring.append(dec)
+
+    # -- sub-spans and attribution ---------------------------------------- #
+
+    @contextmanager
+    def span(self, phase: str, **attrs: Any) -> Iterator[Span | None]:
+        """A nested span on the current decision; no-op (yields None)
+        when this thread holds no decision — library code can
+        instrument unconditionally."""
+        dec = self.current()
+        if dec is None:
+            yield None
+            return
+        sp = dec.open_span(phase, **attrs)
+        try:
+            yield sp
+        finally:
+            dec.close_span(sp)
+
+    def note(self, key: str, value: Any) -> None:
+        """Attach an attribute to the innermost open span, if any."""
+        dec = self.current()
+        if dec is None:
+            return
+        sp = dec.innermost()
+        if sp is not None:
+            sp.attrs[key] = value
+
+    def note_lock_wait(self, site: str, waited_s: float) -> None:
+        """Contention-hook sink: fold a contended acquire's wait into
+        the innermost span (and remember the worst site)."""
+        dec = self.current()
+        if dec is None:
+            return
+        sp = dec.innermost()
+        if sp is None:
+            return
+        sp.lock_wait_s += max(waited_s, 0.0)
+        worst = sp.attrs.get("worstLockSite")
+        if worst is None or waited_s > worst[1]:
+            sp.attrs["worstLockSite"] = (site, waited_s)
+
+    def note_api_call(self, seconds: float, method: str = "",
+                      path: str = "") -> None:
+        """k8s-client sink: fold one apiserver round-trip into the
+        innermost span."""
+        dec = self.current()
+        if dec is None:
+            return
+        sp = dec.innermost()
+        if sp is None:
+            return
+        sp.api_s += max(seconds, 0.0)
+        sp.api_calls += 1
+
+    # -- readers ----------------------------------------------------------- #
+
+    def flight(self, limit: int | None = None) -> list[dict]:
+        """The last ``limit`` completed decisions, newest first."""
+        with self._lock:
+            decisions = list(self._ring)
+        if limit is not None and limit > 0:
+            decisions = decisions[-limit:]
+        return [d.to_json() for d in reversed(decisions)]
+
+    def get_trace(self, namespace: str, name: str) -> dict | None:
+        """The most recent decision for ``namespace/name``: completed
+        attempts win (newest first), else the still-open attempt."""
+        with self._lock:
+            for dec in reversed(self._ring):
+                if dec.namespace == namespace and dec.name == name:
+                    return dec.to_json()
+            dec = self._open.get((namespace, name))
+            return dec.to_json() if dec is not None else None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._open.clear()
+            self.drops = DropCounter()

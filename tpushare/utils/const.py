@@ -54,6 +54,13 @@ ANN_ASSIGNED = "tpushare.io/assigned"
 #: plugin's matching of pending pods (reference pod.go:198-203).
 ANN_ASSUME_TIME = "tpushare.io/assume-time"
 
+#: Decision trace-id stamped at bind time — the correlation key between
+#: ``kubectl describe pod`` (the annotation and the Event messages), the
+#: extender's ``GET /debug/trace/<ns>/<pod>`` flight recorder, and its
+#: trace-tagged structured logs. Purely observational: the ledger rebuild
+#: and the device plugin ignore it.
+ANN_TRACE_ID = "tpushare.io/trace-id"
+
 # --------------------------------------------------------------------------
 # Node annotations (new — the reference had no node-side schema beyond the
 # capacity numbers and so could not express heterogeneity or topology).

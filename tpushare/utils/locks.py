@@ -43,11 +43,29 @@ from __future__ import annotations
 import threading
 import time
 import traceback
-from typing import Any, Iterable, Mapping
+from typing import Any, Callable, Iterable, Mapping
 
 _registry_lock = threading.Lock()
 #: site -> [contention events, total seconds spent waiting]
 _registry: dict[str, list] = {}
+
+#: Extra per-contention sinks beyond the profile registry (the decision
+#: tracer attributes lock-wait to the current span through one).
+#: Appended-at-import, then read-only — iteration needs no lock.
+_contention_hooks: list[Callable[[str, float], None]] = []
+
+
+def add_contention_hook(hook: Callable[[str, float], None]) -> None:
+    """Register ``hook(site, waited_s)``, invoked on every contended
+    acquire AFTER the profile registry is updated and OUTSIDE the
+    registry lock (a hook may take its own locks)."""
+    if hook not in _contention_hooks:
+        _contention_hooks.append(hook)
+
+
+def remove_contention_hook(hook: Callable[[str, float], None]) -> None:
+    if hook in _contention_hooks:
+        _contention_hooks.remove(hook)
 
 
 def record_contention(site: str, waited_s: float) -> None:
@@ -58,6 +76,11 @@ def record_contention(site: str, waited_s: float) -> None:
         else:
             entry[0] += 1
             entry[1] += waited_s
+    for hook in _contention_hooks:
+        try:
+            hook(site, waited_s)
+        except Exception:  # noqa: BLE001 - hooks are telemetry; an
+            pass           # acquire must never fail because of one
 
 
 def contention_snapshot() -> dict[str, tuple[int, float]]:

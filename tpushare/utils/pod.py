@@ -196,13 +196,15 @@ def updated_pod_annotation_spec(
     hbm_pod: int,
     hbm_chip: int,
     assume_time_ns: int | None = None,
+    trace_id: str | None = None,
 ) -> Pod:
     """Deep-copy ``pod`` with the bind-time annotation set applied.
 
     Writes chip index/indices, granted HBM, chip HBM, assigned=false, and
     the nanosecond assume time — the durable commit record the ledger is
     rebuilt from on restart and the device plugin matches on (reference
-    ``GetUpdatedPodAnnotationSpec``, pod.go:192-206).
+    ``GetUpdatedPodAnnotationSpec``, pod.go:192-206). ``trace_id`` adds
+    the decision-trace correlation key (observational only).
     """
     new_pod = pod.deepcopy()
     ann = new_pod.metadata.setdefault("annotations", {})
@@ -214,4 +216,6 @@ def updated_pod_annotation_spec(
     ann[const.ANN_HBM_CHIP] = str(hbm_chip)
     ann[const.ANN_ASSIGNED] = const.ASSIGNED_FALSE
     ann[const.ANN_ASSUME_TIME] = str(now_ns)
+    if trace_id:
+        ann[const.ANN_TRACE_ID] = trace_id
     return new_pod
