@@ -41,6 +41,7 @@ class _Store:
         self.nodes: dict[str, dict] = {}   # name -> doc
         self.events: list[dict] = []       # v1 Events posted
         self.leases: dict[str, dict] = {}  # "ns/name" -> Lease doc
+        self.configmaps: dict[str, dict] = {}  # "ns/name" -> doc
         #: append-only watch log: (kind, type, doc, rv)
         self.watch_log: list[tuple[str, str, dict, int]] = []
 
@@ -107,6 +108,26 @@ class MiniApiServer:
             self.store.pods[key] = doc
             self.store.record("Pod", "ADDED", doc)
 
+    def seed_configmap(self, doc: dict) -> None:
+        with self.store.lock:
+            doc = copy.deepcopy(doc)
+            meta = doc.setdefault("metadata", {})
+            meta.setdefault("namespace", "default")
+            meta["resourceVersion"] = self.store.bump()
+            key = f"{meta['namespace']}/{meta['name']}"
+            self.store.configmaps[key] = doc
+            self.store.record("ConfigMap", "ADDED", doc)
+
+    def update_configmap_server_side(self, doc: dict) -> None:
+        with self.store.lock:
+            doc = copy.deepcopy(doc)
+            meta = doc.setdefault("metadata", {})
+            meta.setdefault("namespace", "default")
+            meta["resourceVersion"] = self.store.bump()
+            key = f"{meta['namespace']}/{meta['name']}"
+            self.store.configmaps[key] = doc
+            self.store.record("ConfigMap", "MODIFIED", doc)
+
     def delete_pod_server_side(self, namespace: str, name: str) -> None:
         with self.store.lock:
             doc = self.store.pods.pop(f"{namespace}/{name}", None)
@@ -120,6 +141,7 @@ _BIND_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding$")
 _PODS_NS_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods$")
 _EVENTS_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/events$")
 _NODE_RE = re.compile(r"^/api/v1/nodes/([^/]+)$")
+_CM_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/configmaps/([^/]+)$")
 _LEASE_RE = re.compile(
     r"^/apis/coordination\.k8s\.io/v1/namespaces/([^/]+)/leases/([^/]+)$")
 _LEASES_NS_RE = re.compile(
@@ -173,12 +195,23 @@ def _make_handler(server: MiniApiServer):
                 return
             path = self.path.split("?", 1)[0]
             q = self._query()
-            if path in ("/api/v1/pods", "/api/v1/nodes"):
-                kind = "Pod" if path.endswith("pods") else "Node"
+            if path in ("/api/v1/pods", "/api/v1/nodes",
+                        "/api/v1/configmaps"):
+                kind = {"pods": "Pod", "nodes": "Node",
+                        "configmaps": "ConfigMap"}[path.rsplit("/", 1)[1]]
                 if q.get("watch") == "true":
                     self._serve_watch(kind, q)
                 else:
                     self._serve_list(kind, q)
+                return
+            m = _CM_RE.match(path)
+            if m:
+                with store.lock:
+                    doc = store.configmaps.get(f"{m.group(1)}/{m.group(2)}")
+                if doc is None:
+                    self._status_error(404, "NotFound")
+                else:
+                    self._json(doc)
                 return
             m = _POD_RE.match(path)
             if m:
@@ -349,6 +382,8 @@ def _make_handler(server: MiniApiServer):
             with store.lock:
                 if kind == "Pod":
                     items = list(store.pods.values())
+                elif kind == "ConfigMap":
+                    items = list(store.configmaps.values())
                 else:
                     items = list(store.nodes.values())
                 rv = str(store.rv)

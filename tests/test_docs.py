@@ -14,6 +14,7 @@ import os
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 METRICS_PY = os.path.join(REPO_ROOT, "tpushare", "routes", "metrics.py")
 OBSERVABILITY_MD = os.path.join(REPO_ROOT, "docs", "observability.md")
+QUOTA_MD = os.path.join(REPO_ROOT, "docs", "quota.md")
 
 _METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
 
@@ -63,8 +64,38 @@ def test_observability_doc_covers_the_surfaces():
     with open(OBSERVABILITY_MD, encoding="utf-8") as f:
         doc = f.read()
     for needle in ("/debug/flight", "/debug/trace/", "/debug/pprof/mutex",
-                   "TPUSHARE_LOG_JSON", "tpushare.io/trace-id"):
+                   "TPUSHARE_LOG_JSON", "tpushare.io/trace-id",
+                   "/debug/quota"):
         assert needle in doc, needle
+
+
+def test_quota_doc_covers_the_contract():
+    """docs/quota.md is the tenancy contract: it must keep naming the
+    tenant-resolution label, the ConfigMap (name + every spec field),
+    the endpoint/CLI surfaces, and every tpushare_quota_* metric the
+    code registers."""
+    with open(QUOTA_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("tpushare.io/tenant", "tpushare-quotas",
+                   "guaranteeHBM", "limitHBM", "guaranteeChips",
+                   "limitChips", '"*"', "/debug/quota",
+                   "kubectl inspect tpushare quota", "borrow",
+                   "reclaim", "equal priority"):
+        assert needle in doc, needle
+    quota_metrics = [n for n in registered_metric_names()
+                     if n.startswith("tpushare_quota_")
+                     or n.endswith("_by_tenant")]
+    assert len(quota_metrics) >= 10
+    missing = [n for n in quota_metrics if n not in doc]
+    assert not missing, (
+        f"quota metrics absent from docs/quota.md: {missing}")
+
+
+def test_quota_doc_is_linked():
+    """README and the user guide must keep pointing at the contract."""
+    for rel in ("README.md", os.path.join("docs", "userguide.md")):
+        with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+            assert "quota.md" in f.read(), rel
 
 
 if __name__ == "__main__":
@@ -76,7 +107,9 @@ if __name__ == "__main__":
     failures = 0
     for check in (test_metrics_py_parses_some_metrics,
                   test_every_registered_metric_is_documented,
-                  test_observability_doc_covers_the_surfaces):
+                  test_observability_doc_covers_the_surfaces,
+                  test_quota_doc_covers_the_contract,
+                  test_quota_doc_is_linked):
         try:
             check()
         except AssertionError as e:

@@ -1,0 +1,748 @@
+"""Tenancy: per-tenant quota lifecycle, borrowing, and fair-share
+reclaim (tpushare/quota).
+
+Covers the acceptance story end to end over the REAL stack (fake
+apiserver + controller + HTTP verbs): tenant B borrows idle HBM beyond
+its guarantee, an under-guarantee tenant A pod that cannot fit reclaims
+a borrowed pod via the preempt verb and binds, an over-limit pod is
+denied at filter with a quota-specific reason visible in the flight
+recorder / an Event / the tpushare_quota_denied_total counter — and a
+restarted extender reconstructs identical per-tenant usage from pod
+annotations alone. Plus: ConfigMap round-trip over the real wire
+(miniapiserver), gang charge rollback atomic with TTL expiry, and the
+per-tenant demand breakdown.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tests.miniapiserver import MiniApiServer
+from tpushare import trace
+from tpushare.api.objects import ConfigMap, Pod
+from tpushare.cmd.main import build_stack, serve_stack, shutdown_stack
+from tpushare.k8s import events
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.quota import QuotaManager, parse_configmap
+from tpushare.quota.config import EMPTY, UNLIMITED
+from tpushare.utils import const
+from tpushare.utils import pod as podutils
+
+
+def quota_cm_doc(entries, namespace="kube-system"):
+    return {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": const.QUOTA_CONFIGMAP,
+                     "namespace": namespace},
+        "data": {tenant: json.dumps(spec)
+                 for tenant, spec in entries.items()},
+    }
+
+
+# ------------------------------------------------------------------------ #
+# ConfigMap parsing
+# ------------------------------------------------------------------------ #
+
+
+class TestQuotaConfig:
+    def test_parse_entries_default_and_lookup(self):
+        cm = ConfigMap(quota_cm_doc({
+            "team-a": {"guaranteeHBM": 32, "limitHBM": 48,
+                       "guaranteeChips": 2, "limitChips": 4},
+            "*": {"limitHBM": 100},
+        }))
+        cfg = parse_configmap(cm)
+        a = cfg.for_tenant("team-a")
+        assert (a.guarantee_hbm, a.limit_hbm) == (32, 48)
+        assert (a.guarantee_chips, a.limit_chips) == (2, 4)
+        # unlisted tenant falls back to the "*" default
+        other = cfg.for_tenant("someone-else")
+        assert other.limit_hbm == 100 and other.guarantee_hbm is None
+        assert cfg.configured("someone-else")
+
+    def test_no_default_means_unlimited(self):
+        cfg = parse_configmap(ConfigMap(quota_cm_doc(
+            {"team-a": {"limitHBM": 10}})))
+        assert cfg.for_tenant("free-rider") is UNLIMITED
+        assert not cfg.configured("free-rider")
+
+    def test_malformed_entries_are_skipped_not_fatal(self):
+        cm = ConfigMap({"metadata": {"name": const.QUOTA_CONFIGMAP},
+                        "data": {
+                            "good": '{"limitHBM": 10}',
+                            "not-json": "limitHBM: 10",
+                            "not-object": '["limitHBM", 10]',
+                            "not-int": '{"limitHBM": "lots"}',
+                            "negative": '{"limitHBM": -4}',
+                            "inverted": '{"guaranteeHBM": 9,'
+                                        ' "limitHBM": 4}',
+                        }})
+        cfg = parse_configmap(cm)
+        assert set(cfg.tenants) == {"good"}
+
+    def test_deleted_configmap_parses_to_empty(self):
+        assert parse_configmap(None) is EMPTY
+
+    def test_unknown_keys_skip_the_entry_fail_safe(self):
+        """A typo'd key must leave the tenant UNCONSTRAINED, never
+        silently configured with a zero guarantee (which would put
+        every one of its pods first in the reclaim tier)."""
+        cfg = parse_configmap(ConfigMap(quota_cm_doc(
+            {"team-x": {"guaranteeHbm": 64}})))  # wrong case
+        assert "team-x" not in cfg.tenants
+        assert not cfg.configured("team-x")
+
+    def test_empty_object_entry_constrains_nothing(self):
+        cfg = parse_configmap(ConfigMap(quota_cm_doc({"team-y": {}})))
+        assert not cfg.configured("team-y")
+        q = QuotaManager()
+        q.set_config(cfg)
+        q.charge(assumed_pod("y0", "team-y", hbm=16))
+        assert not q.is_borrowed(assumed_pod("y0", "team-y", hbm=16))
+
+
+# ------------------------------------------------------------------------ #
+# The tenant ledger
+# ------------------------------------------------------------------------ #
+
+
+def assumed_pod(name, ns, hbm=0, chips=0, chip_ids="0", labels=None):
+    ann = {const.ANN_CHIP_IDX: chip_ids}
+    if hbm:
+        ann[const.ANN_HBM_POD] = str(hbm)
+    doc = make_pod(name, hbm=hbm, chips=chips, namespace=ns, uid=name,
+                   annotations=ann, labels=labels)
+    return Pod(doc)
+
+
+class TestLedger:
+    def test_charge_uncharge_roundtrip(self):
+        q = QuotaManager()
+        p = assumed_pod("p1", "team-a", hbm=16)
+        q.charge(p)
+        assert q.usage("team-a") == (16, 0, 1)
+        q.charge(p)  # idempotent
+        assert q.usage("team-a") == (16, 0, 1)
+        q.uncharge(p)
+        assert q.usage("team-a") == (0, 0, 0)
+
+    def test_recharge_reprices(self):
+        q = QuotaManager()
+        q.charge(assumed_pod("p1", "team-a", hbm=16))
+        q.charge(assumed_pod("p1", "team-a", hbm=24))  # grant re-priced
+        assert q.usage("team-a") == (24, 0, 1)
+
+    def test_complete_pod_uncharges(self):
+        q = QuotaManager()
+        p = assumed_pod("p1", "team-a", hbm=16)
+        q.charge(p)
+        done = Pod(p.deepcopy().raw)
+        done.raw["status"]["phase"] = "Succeeded"
+        q.charge(done)
+        assert q.usage("team-a") == (0, 0, 0)
+
+    def test_chip_pods_charge_chip_dimension(self):
+        q = QuotaManager()
+        q.charge(assumed_pod("c1", "team-a", chips=2, chip_ids="0,1"))
+        assert q.usage("team-a") == (0, 2, 1)
+
+    def test_tenant_label_overrides_namespace(self):
+        q = QuotaManager()
+        p = assumed_pod("p1", "ns-x", hbm=8,
+                        labels={const.LABEL_TENANT: "org-shared"})
+        assert q.tenant_of(p) == "org-shared"
+        q.charge(p)
+        assert q.usage("org-shared") == (8, 0, 1)
+        assert q.usage("ns-x") == (0, 0, 0)
+
+    def test_admit_excludes_own_existing_charge(self):
+        q = QuotaManager()
+        q.set_config(parse_configmap(ConfigMap(quota_cm_doc(
+            {"team-a": {"limitHBM": 16}}))))
+        p = assumed_pod("p1", "team-a", hbm=16)
+        q.charge(p)
+        ok, _ = q.admit(p)  # bind retry of the charged pod itself
+        assert ok
+        ok, reason = q.admit(assumed_pod("p2", "team-a", hbm=16))
+        assert not ok and reason.startswith("quota:")
+
+    def test_borrowing_and_reclaim_gates(self):
+        q = QuotaManager()
+        q.set_config(parse_configmap(ConfigMap(quota_cm_doc({
+            "team-a": {"guaranteeHBM": 32},
+            "team-b": {"guaranteeHBM": 16},
+        }))))
+        b_pods = [assumed_pod(f"b{i}", "team-b", hbm=16) for i in range(4)]
+        for p in b_pods:
+            q.charge(p)
+        # 64 used over a 16 guarantee: every 16-GiB pod is pure borrow
+        assert all(q.is_borrowed(p) for p in b_pods)
+        a = assumed_pod("a0", "team-a", hbm=16)
+        assert q.under_guarantee(a)
+        assert q.reclaim_eligible(a, b_pods[0])
+        # same tenant never reclaims from itself
+        b_new = assumed_pod("b-new", "team-b", hbm=16)
+        assert not q.reclaim_eligible(b_new, b_pods[0])
+        # an over-guarantee request is not entitled to reclaim
+        a_big = assumed_pod("a-big", "team-a", hbm=48)
+        assert not q.under_guarantee(a_big)
+        assert not q.reclaim_eligible(a_big, b_pods[0])
+        # unconfigured tenants are never "borrowing"
+        q.charge(assumed_pod("x", "unconfigured", hbm=16))
+        assert not q.is_borrowed(assumed_pod("x", "unconfigured", hbm=16))
+
+    def test_score_adjust_signs(self):
+        q = QuotaManager()
+        q.set_config(parse_configmap(ConfigMap(quota_cm_doc({
+            "team-a": {"guaranteeHBM": 32},
+        }))))
+        a = assumed_pod("a0", "team-a", hbm=16)
+        assert q.score_adjust(a) == 1          # under guarantee
+        q.charge(assumed_pod("a1", "team-a", hbm=32))
+        assert q.score_adjust(a) == -1         # already at/over guarantee
+        assert q.score_adjust(
+            assumed_pod("z", "no-quota", hbm=16)) == 0
+
+    def test_reclaim_plan_never_cuts_below_guarantee(self, api):
+        """Two 16-GiB pods over a 16-GiB guarantee are each
+        individually borrowed, but only 16 GiB is actually on loan: a
+        reclaim plan needing BOTH must be refused, or fair-share
+        eviction would drive the tenant below what it is owed."""
+        from tpushare.cache.cache import SchedulerCache
+        from tpushare.scheduler.preempt import Preempt
+
+        api.create_node(make_node("n0", chips=1, hbm_per_chip=32,
+                                  topology="1"))
+        ann = {const.ANN_CHIP_IDX: "0", const.ANN_HBM_POD: "16",
+               const.ANN_ASSIGNED: "false", const.ANN_ASSUME_TIME: "1"}
+        for i in range(2):
+            api.create_pod(make_pod(f"b{i}", hbm=16, namespace="team-b",
+                                    node_name="n0", annotations=ann))
+        quota = QuotaManager()
+        quota.set_config(parse_configmap(ConfigMap(quota_cm_doc({
+            "team-a": {"guaranteeHBM": 32},
+            "team-b": {"guaranteeHBM": 16},
+        }))))
+        cache = SchedulerCache(api.get_node, api.list_pods, quota=quota)
+        cache.build()
+        preempt = Preempt(cache, quota=quota)
+        a_pod = Pod(make_pod("a0", hbm=32, namespace="team-a", uid="a0"))
+        info = cache.get_node_info("n0")
+        # needs the whole chip -> both victims -> over the 16-GiB excess
+        assert preempt.plan_node(info, a_pod, set()) is None
+        # with the guarantee dropped to 0, all 32 GiB is borrowed and
+        # the same plan is legal
+        quota.set_config(parse_configmap(ConfigMap(quota_cm_doc({
+            "team-a": {"guaranteeHBM": 32},
+            "team-b": {"guaranteeHBM": 0},
+        }))))
+        plan = preempt.plan_node(info, a_pod, set())
+        assert plan is not None and len(plan) == 2
+
+    def test_over_limit_preemptor_gets_no_victim_plan(self, api):
+        """The scheduler's PostFilter retries a quota-denied pod via
+        preemption: answering with victims would evict innocents for a
+        preemptor the filter must deny again once they are gone."""
+        from tpushare.api.extender import ExtenderPreemptionArgs
+        from tpushare.cache.cache import SchedulerCache
+        from tpushare.scheduler.preempt import Preempt
+
+        api.create_node(make_node("n0", chips=1, hbm_per_chip=16,
+                                  topology="1"))
+        api.create_pod(make_pod("victim", hbm=16, node_name="n0",
+                                annotations={
+                                    const.ANN_CHIP_IDX: "0",
+                                    const.ANN_HBM_POD: "16",
+                                    const.ANN_ASSIGNED: "true",
+                                    const.ANN_ASSUME_TIME: "1"}))
+        quota = QuotaManager()
+        quota.set_config(parse_configmap(ConfigMap(quota_cm_doc(
+            {"team-x": {"limitHBM": 8}}))))
+        cache = SchedulerCache(api.get_node, api.list_pods, quota=quota)
+        cache.build()
+        preempt = Preempt(cache, quota=quota)
+        over = Pod(make_pod("over", hbm=16, namespace="team-x",
+                            uid="over", priority=1000))
+        result = preempt.handle(ExtenderPreemptionArgs.from_json({
+            "Pod": over.raw,
+            "NodeNameToMetaVictims": {"n0": {"Pods": []}}}))
+        assert result.node_victims == {}
+
+    def test_admit_and_reserve_closes_the_race_window(self):
+        q = QuotaManager()
+        q.set_config(parse_configmap(ConfigMap(quota_cm_doc(
+            {"team-x": {"limitHBM": 24}}))))
+        p1 = Pod(make_pod("p1", hbm=16, namespace="team-x", uid="p1"))
+        p2 = Pod(make_pod("p2", hbm=16, namespace="team-x", uid="p2"))
+        # both would pass a bare admit() before either charge lands
+        assert q.admit(p1)[0] and q.admit(p2)[0]
+        ok, _ = q.admit_and_reserve(p1)
+        assert ok
+        ok, reason = q.admit(p2)  # the reservation is visible at once
+        assert not ok and reason.startswith("quota:")
+        q.uncharge(p1)
+        assert q.usage("team-x") == (0, 0, 0)
+
+    def test_bind_releases_reservation_on_failed_placement(self, api):
+        from tpushare.api.extender import ExtenderBindingArgs
+        from tpushare.cache.cache import SchedulerCache
+        from tpushare.scheduler.bind import Bind
+
+        api.create_node(make_node("n0", chips=1, hbm_per_chip=16,
+                                  topology="1"))
+        # a resident fills the only chip
+        api.create_pod(make_pod("squatter", hbm=16, node_name="n0",
+                                annotations={
+                                    const.ANN_CHIP_IDX: "0",
+                                    const.ANN_HBM_POD: "16",
+                                    const.ANN_ASSIGNED: "true",
+                                    const.ANN_ASSUME_TIME: "1"}))
+        quota = QuotaManager()
+        quota.set_config(parse_configmap(ConfigMap(quota_cm_doc(
+            {"team-x": {"limitHBM": 8}}))))
+        cache = SchedulerCache(api.get_node, api.list_pods, quota=quota)
+        cache.build()
+        binder = Bind(cache, api, quota=quota)
+        api.create_pod(make_pod("late", hbm=8, namespace="team-x"))
+        result = binder.handle(ExtenderBindingArgs(
+            pod_name="late", pod_namespace="team-x", pod_uid="",
+            node="n0"))
+        assert result.error  # no chip fits
+        # the provisional charge must not leak
+        assert quota.usage("team-x") == (0, 0, 0)
+
+    def test_snapshot_shape(self):
+        q = QuotaManager()
+        q.set_config(parse_configmap(ConfigMap(quota_cm_doc(
+            {"team-b": {"guaranteeHBM": 16, "limitHBM": 100}}))))
+        q.charge(assumed_pod("b0", "team-b", hbm=48))
+        (entry,) = q.snapshot()
+        assert entry["tenant"] == "team-b"
+        assert entry["usedHBM"] == 48 and entry["borrowedHBM"] == 32
+        assert entry["guaranteeHBM"] == 16 and entry["limitHBM"] == 100
+        assert entry["dominantShare"] == 3.0
+
+
+# ------------------------------------------------------------------------ #
+# E2E over the real stack: borrow -> reclaim -> bind; deny at limit;
+# restart-rebuild
+# ------------------------------------------------------------------------ #
+
+
+class Cluster:
+    """Fake cluster + full extender stack behind real HTTP (the
+    test_e2e harness plus the preempt/quota surfaces)."""
+
+    def __init__(self, api):
+        self.api = api
+        self.stack, self.server = serve_stack(api)
+        self.base = f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        shutdown_stack(self.stack, self.server)
+
+    def _post(self, path, doc):
+        req = urllib.request.Request(
+            f"{self.base}{path}", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def _get(self, path):
+        with urllib.request.urlopen(f"{self.base}{path}") as resp:
+            return resp.read()
+
+    def filter(self, pod):
+        names = [n.name for n in self.api.list_nodes()]
+        status, result = self._post("/tpushare-scheduler/filter", {
+            "Pod": pod.raw, "NodeNames": names})
+        assert status == 200, result
+        return result
+
+    def schedule(self, pod):
+        result = self.filter(pod)
+        candidates = result["NodeNames"] or []
+        if not candidates:
+            return False, result["FailedNodes"]
+        status, bind_result = self._post("/tpushare-scheduler/bind", {
+            "PodName": pod.name, "PodNamespace": pod.namespace,
+            "PodUID": pod.uid, "Node": candidates[0]})
+        if status != 200:
+            return False, bind_result["Error"]
+        return True, candidates[0]
+
+    def preempt(self, pod):
+        names = [n.name for n in self.api.list_nodes()]
+        status, result = self._post("/tpushare-scheduler/preempt", {
+            "Pod": pod.raw,
+            "NodeNameToMetaVictims": {n: {"Pods": []} for n in names}})
+        assert status == 200, result
+        return result.get("NodeNameToMetaVictims") or {}
+
+    def quota_doc(self):
+        return json.loads(self._get("/debug/quota"))
+
+    def metrics_text(self):
+        return self._get("/metrics").decode()
+
+
+@pytest.fixture
+def tenant_cluster(api):
+    """2 nodes x 4 chips x 16 GiB; team-a guaranteed 32/limit 48,
+    team-b guaranteed 16/limit 256 (a born borrower)."""
+    api.create_node(make_node("v5e-0"))
+    api.create_node(make_node("v5e-1"))
+    api.create_configmap(quota_cm_doc({
+        "team-a": {"guaranteeHBM": 32, "limitHBM": 48},
+        "team-b": {"guaranteeHBM": 16, "limitHBM": 256},
+    }))
+    trace.reset()
+    c = Cluster(api)
+    yield c
+    c.close()
+
+
+class TestTenancyEndToEnd:
+    def fill_with_tenant_b(self, api, cluster, count=8):
+        for i in range(count):
+            api.create_pod(make_pod(f"b-{i}", hbm=16, namespace="team-b"))
+            bound, where = cluster.schedule(
+                api.get_pod("team-b", f"b-{i}"))
+            assert bound, where
+
+    def test_borrow_reclaim_deny_and_restart(self, api, tenant_cluster):
+        cluster = tenant_cluster
+        # --- tenant B borrows the whole idle fleet (128 GiB > 16) ----- #
+        self.fill_with_tenant_b(api, cluster)
+        quota = cluster.stack.controller.quota
+        assert quota.usage("team-b") == (128, 0, 8)
+
+        # --- an under-guarantee tenant-A pod cannot fit -------------- #
+        api.create_pod(make_pod("a-0", hbm=16, namespace="team-a",
+                                uid="uid-a0"))
+        a_pod = api.get_pod("team-a", "a-0")
+        bound, detail = cluster.schedule(a_pod)
+        assert not bound and "insufficient TPU HBM" in str(detail)
+
+        # --- preempt: reclaim selects B's borrowed pod at EQUAL prio - #
+        victims = cluster.preempt(a_pod)
+        assert victims, "reclaim produced no victim plan"
+        node = sorted(victims)[0]
+        uids = [p["UID"] for p in victims[node]["Pods"]]
+        assert len(uids) == 1
+        victim = next(p for p in api.list_pods() if p.uid == uids[0])
+        assert victim.namespace == "team-b"
+        assert quota.is_borrowed(victim)
+
+        # --- evict the victim; A's pod binds -------------------------- #
+        api.delete_pod(victim.namespace, victim.name)
+        assert cluster.stack.controller.wait_idle(timeout=10)
+        bound, where = cluster.schedule(api.get_pod("team-a", "a-0"))
+        assert bound, where
+        assert quota.usage("team-a") == (16, 0, 1)
+        assert quota.usage("team-b") == (112, 0, 7)
+
+        # --- a pod pushing its tenant past `limit` is denied ---------- #
+        api.create_pod(make_pod("a-big", hbm=48, namespace="team-a",
+                                uid="uid-a-big"))
+        big = api.get_pod("team-a", "a-big")
+        bound, failed = cluster.schedule(big)
+        assert not bound
+        reasons = set(failed.values())
+        assert len(reasons) == 1
+        assert next(iter(reasons)).startswith("quota: tenant team-a")
+
+        # ... visible in the Event stream ...
+        assert events.flush(timeout=5)
+        assert any(e["reason"] == events.REASON_QUOTA_DENIED
+                   and e["involvedObject"]["name"] == "a-big"
+                   for _, e in api.events)
+
+        # ... in the denial counter and the per-tenant gauges ...
+        text = cluster.metrics_text()
+        assert ('tpushare_quota_denied_total{tenant="team-a"} 1.0'
+                in text), text
+        assert ('tpushare_quota_used_hbm_gib{tenant="team-b"} 112.0'
+                in text)
+        assert ('tpushare_quota_borrowed_hbm_gib{tenant="team-b"} 96.0'
+                in text)
+        # quota denial is policy, not missing capacity: no autoscaler
+        # demand recorded for it
+        assert "tpushare_unschedulable_pods 0.0" in text
+
+        # ... in the flight recorder, with the quota-specific reason ...
+        flight = json.loads(cluster._get("/debug/flight"))
+        denied = [d for d in flight["decisions"]
+                  if d["name"] == "a-big"
+                  and d["outcome"] == "unschedulable"]
+        assert denied, flight["decisions"]
+        rejections = denied[-1]["spans"][0]["attrs"]["rejections"]
+        assert all(r.startswith("quota:") for r in rejections.values())
+
+        # ... and in the /debug/quota snapshot ------------------------- #
+        doc = cluster.quota_doc()
+        by_tenant = {t["tenant"]: t for t in doc["tenants"]}
+        assert by_tenant["team-b"]["borrowedHBM"] == 96
+        assert by_tenant["team-a"]["usedHBM"] == 16
+
+        # --- restart: identical usage from pod annotations alone ----- #
+        before = {t["tenant"]: (t["usedHBM"], t["usedChips"], t["pods"])
+                  for t in doc["tenants"]}
+        stack2 = build_stack(api)
+        stack2.controller.start(workers=1)
+        try:
+            after = {t["tenant"]: (t["usedHBM"], t["usedChips"],
+                                   t["pods"])
+                     for t in stack2.controller.quota.snapshot()}
+            assert after == before
+            # the rebuilt config enforces the same limit
+            ok, reason = stack2.controller.quota.admit(
+                api.get_pod("team-a", "a-big"))
+            assert not ok and reason.startswith("quota:")
+        finally:
+            stack2.binder.gang_planner.stop()
+            stack2.controller.stop()
+
+    def test_fair_share_score_bias_on_the_wire(self, api, tenant_cluster):
+        cluster = tenant_cluster
+        self.fill_with_tenant_b(api, cluster, count=2)  # borrowing (32>16)
+        api.create_pod(make_pod("a-score", hbm=8, namespace="team-a",
+                                uid="uid-a-score"))
+        api.create_pod(make_pod("b-score", hbm=8, namespace="team-b",
+                                uid="uid-b-score"))
+        names = [n.name for n in api.list_nodes()]
+
+        def scores(ns, name):
+            _, ranked = cluster._post("/tpushare-scheduler/prioritize", {
+                "Pod": api.get_pod(ns, name).raw, "NodeNames": names})
+            return {e["Host"]: e["Score"] for e in ranked}
+
+        a_scores, b_scores = scores("team-a", "a-score"), \
+            scores("team-b", "b-score")
+        # identical request; the under-guarantee tenant outranks the
+        # borrower on every feasible node
+        assert all(a_scores[n] > b_scores[n] for n in names)
+
+    def test_quota_survives_configmap_rewrite(self, api, tenant_cluster):
+        cluster = tenant_cluster
+        cm = api.get_configmap("kube-system", const.QUOTA_CONFIGMAP)
+        cm.raw["data"]["team-a"] = json.dumps({"limitHBM": 8})
+        api.update_configmap(cm)
+        assert cluster.stack.controller.wait_idle(timeout=5)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if cluster.stack.controller.quota.config_for(
+                    "team-a").limit_hbm == 8:
+                break
+            time.sleep(0.02)
+        api.create_pod(make_pod("a-after", hbm=16, namespace="team-a",
+                                uid="uid-a-after"))
+        bound, failed = cluster.schedule(api.get_pod("team-a", "a-after"))
+        assert not bound
+        assert next(iter(failed.values())).startswith("quota:")
+
+
+# ------------------------------------------------------------------------ #
+# Gang: the group's charge rolls back atomically with TTL expiry
+# ------------------------------------------------------------------------ #
+
+
+class TestGangQuotaRollback:
+    def test_expiry_rolls_back_the_whole_charge(self, api):
+        from tpushare.cache.cache import SchedulerCache
+        from tpushare.gang.planner import GangPending, GangPlanner
+
+        api.create_node(make_node("host-0", chips=4, hbm_per_chip=16))
+        quota = QuotaManager()
+        quota.set_config(parse_configmap(ConfigMap(quota_cm_doc(
+            {"team-g": {"guaranteeHBM": 64, "limitHBM": 64}}))))
+        cache = SchedulerCache(api.get_node, api.list_pods, quota=quota)
+        planner = GangPlanner(cache, api, ttl=0.05, quota=quota)
+        ann = {const.ANN_POD_GROUP: "ring", const.ANN_POD_GROUP_MIN: "3"}
+        for i in range(2):
+            doc = make_pod(f"g-{i}", hbm=16, namespace="team-g",
+                           annotations=ann)
+            pod = api.create_pod(doc)
+            with pytest.raises(GangPending):
+                planner.bind_member(pod, "host-0")
+        # two reservations charged while the gang waits for quorum
+        assert quota.usage("team-g") == (32, 0, 2)
+        time.sleep(0.06)
+        assert planner.expire_stale() == 1
+        # ledger AND quota rolled back together — no residue
+        assert quota.usage("team-g") == (0, 0, 0)
+        for i in range(2):
+            fresh = api.get_pod("team-g", f"g-{i}")
+            assert not podutils.is_assumed(fresh)
+
+    def test_quota_doomed_gang_rejected_without_reserving(self, api):
+        from tpushare.cache.cache import SchedulerCache
+        from tpushare.cache.nodeinfo import AllocationError
+        from tpushare.gang.planner import GangPlanner
+
+        api.create_node(make_node("host-0", chips=4, hbm_per_chip=16))
+        quota = QuotaManager()
+        quota.set_config(parse_configmap(ConfigMap(quota_cm_doc(
+            {"team-g": {"limitHBM": 32}}))))
+        cache = SchedulerCache(api.get_node, api.list_pods, quota=quota)
+        planner = GangPlanner(cache, api, quota=quota)
+        ann = {const.ANN_POD_GROUP: "ring", const.ANN_POD_GROUP_MIN: "4"}
+        pod = api.create_pod(make_pod("g-0", hbm=16, namespace="team-g",
+                                      annotations=ann))
+        # 4 x 16 GiB can never assemble under a 32-GiB limit: refuse the
+        # FIRST member outright instead of squatting until the TTL.
+        with pytest.raises(AllocationError, match="quota"):
+            planner.bind_member(pod, "host-0")
+        assert quota.usage("team-g") == (0, 0, 0)
+        assert planner.stats() == {}
+
+
+# ------------------------------------------------------------------------ #
+# ConfigMap round-trip over the real wire (miniapiserver)
+# ------------------------------------------------------------------------ #
+
+
+class TestConfigMapNamespacePinning:
+    def test_foreign_namespace_configmap_is_ignored(self, api):
+        """A same-named ConfigMap outside TPUSHARE_QUOTA_NAMESPACE must
+        neither load nor (on deletion) erase the quota table."""
+        from tpushare.controller.controller import Controller
+
+        api.create_node(make_node("v5e-0"))
+        api.create_configmap(quota_cm_doc({"t": {"limitHBM": 5}},
+                                          namespace="default"))  # spoof
+        api.create_configmap(quota_cm_doc({"t": {"limitHBM": 7}}))
+        controller = Controller(api)
+        controller.start(workers=1)
+        try:
+            assert controller.quota.config_for("t").limit_hbm == 7
+            api.delete_configmap("default", const.QUOTA_CONFIGMAP)
+            api.create_configmap(quota_cm_doc({"t": {"limitHBM": 5}},
+                                              namespace="spoof-ns"))
+            assert controller.wait_idle(timeout=5)
+            deadline = time.monotonic() + 0.3
+            while time.monotonic() < deadline:
+                assert controller.quota.config_for("t").limit_hbm == 7
+                time.sleep(0.02)
+        finally:
+            controller.stop()
+
+
+class TestConfigMapWire:
+    def test_client_informer_controller_roundtrip(self):
+        from tpushare.controller.controller import Controller
+        from tpushare.k8s.client import ApiClient, ClusterConfig
+
+        server = MiniApiServer().start()
+        try:
+            server.seed_node(make_node("v5e-0"))
+            server.seed_configmap(quota_cm_doc(
+                {"team-a": {"limitHBM": 48}}))
+            client = ApiClient(ClusterConfig(
+                host=f"http://127.0.0.1:{server.port}"))
+            # client surface round-trips the document
+            cm = client.get_configmap("kube-system",
+                                      const.QUOTA_CONFIGMAP)
+            assert json.loads(cm.data["team-a"]) == {"limitHBM": 48}
+            assert [c.name for c in client.list_configmaps()] == [
+                const.QUOTA_CONFIGMAP]
+
+            controller = Controller(client)
+            controller.start(workers=1)
+            try:
+                assert controller.quota.config_for(
+                    "team-a").limit_hbm == 48
+                # a server-side rewrite reaches the manager via WATCH
+                doc = quota_cm_doc({"team-a": {"limitHBM": 8}})
+                server.update_configmap_server_side(doc)
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    if controller.quota.config_for(
+                            "team-a").limit_hbm == 8:
+                        break
+                    time.sleep(0.02)
+                assert controller.quota.config_for(
+                    "team-a").limit_hbm == 8
+            finally:
+                controller.stop()
+        finally:
+            server.close()
+
+
+# ------------------------------------------------------------------------ #
+# Per-tenant demand breakdown (the autoscaler attribution satellite)
+# ------------------------------------------------------------------------ #
+
+
+class TestDemandByTenant:
+    def test_by_tenant_breakdown(self):
+        from tpushare.scheduler.predicate import DemandTracker
+
+        tracker = DemandTracker()
+        tracker.record_unplaceable(Pod(make_pod(
+            "p1", hbm=24, namespace="team-a", uid="u1")))
+        tracker.record_unplaceable(Pod(make_pod(
+            "p2", chips=4, namespace="team-a", uid="u2")))
+        tracker.record_unplaceable(Pod(make_pod(
+            "p3", hbm=8, namespace="ns-x", uid="u3",
+            labels={const.LABEL_TENANT: "team-b"})))
+        assert tracker.snapshot() == (3, 32, 4)
+        assert tracker.by_tenant() == {"team-a": (2, 24, 4),
+                                       "team-b": (1, 8, 0)}
+        tracker.clear("u2")
+        assert tracker.by_tenant()["team-a"] == (1, 24, 0)
+
+
+# ------------------------------------------------------------------------ #
+# kubectl plugin: quota table rendering
+# ------------------------------------------------------------------------ #
+
+
+class TestKubectlQuota:
+    def test_render_quota_table(self):
+        import importlib
+        tool = importlib.import_module("tools.kubectl_inspect_tpushare")
+
+        doc = {"tenants": [
+            {"tenant": "team-a", "usedHBM": 16, "usedChips": 0, "pods": 1,
+             "configured": True, "borrowedHBM": 0, "borrowedChips": 0,
+             "dominantShare": 0.5, "guaranteeHBM": 32, "limitHBM": 48},
+            {"tenant": "free", "usedHBM": 8, "usedChips": 0, "pods": 1,
+             "configured": False, "borrowedHBM": 0, "borrowedChips": 0,
+             "dominantShare": 0.0},
+        ]}
+        out = tool.render_quota(doc)
+        assert "team-a" in out and "32/48" in out and "16(0)" in out
+        assert "free (no quota)" in out
+        assert tool.render_quota({"tenants": []}).startswith("no tenants")
+
+
+# ------------------------------------------------------------------------ #
+# simulate: the mixed-tenant contention scenario stays runnable
+# ------------------------------------------------------------------------ #
+
+
+class TestSimulateTenants:
+    def test_mixed_tenant_scenario(self):
+        import yaml
+
+        from tools import simulate as sim
+
+        scenario = yaml.safe_load(sim.EXAMPLE_TENANTS)
+        report = sim.simulate(scenario)
+        tenants = {t["tenant"]: t for t in report["tenants"]}
+        # the borrower got trimmed back by reclaim, the entitled tenant
+        # reached (a portion of) its guarantee
+        assert tenants["team-serve"]["borrowedHBM"] > 0
+        assert tenants["team-train"]["usedHBM"] == 96
+        # the over-limit arrival was denied with the quota reason
+        reasons = [u["reason"] for u in report["unschedulable_pods"]]
+        assert any(r.startswith("quota:") for r in reasons)
+        assert report["preemptions_executed"]

@@ -110,6 +110,42 @@ def test_unlocked_mutation_forms(snippet, expected):
     assert hit is expected, snippet
 
 
+def test_catches_unlocked_quota_mutation():
+    """The tenant ledger (tpushare/quota) is guarded like the chip
+    ledger: mutating its charge tables outside the ledger lock is the
+    seeded defect; the locked twin and reads pass."""
+    racy = ("class QuotaManager:\n"
+            "    def charge(self, uid, entry):\n"
+            "        self._pods[uid] = entry\n"
+            "        self._usage[entry[0]] = (1, 0, 1)\n")
+    vs = [v for v in check_source(racy, "tpushare/quota/fixture.py",
+                                  LINT_RULES)
+          if v.rule == "unlocked-mutation"]
+    assert len(vs) == 2
+    assert "_pods" in vs[0].message and "_usage" in vs[1].message
+    locked = ("class QuotaManager:\n"
+              "    def charge(self, uid, entry):\n"
+              "        with self._lock:\n"
+              "            self._pods[uid] = entry\n"
+              "    def usage(self, tenant):\n"
+              "        with self._lock:\n"
+              "            return self._usage.get(tenant)\n")
+    assert "unlocked-mutation" not in _rules_hit(locked)
+    # config swaps count too: set_config replaces the table wholesale
+    assert "unlocked-mutation" in _rules_hit(
+        "class QuotaManager:\n"
+        "    def set_config(self, config):\n"
+        "        self._config = config\n")
+
+
+def test_quota_package_is_strictly_typed():
+    """tpushare/quota/ joined the strict-typing core: an untyped
+    function there must fail the gate."""
+    src = "def charge(pod):\n    return 0\n"
+    vs = check_source(src, "tpushare/quota/mod.py", TYPING_RULES)
+    assert [v.rule for v in vs] == ["strict-typing"]
+
+
 def test_catches_bare_except():
     src = "try:\n    pass\nexcept:\n    pass\n"
     assert "bare-except" in _rules_hit(src)
@@ -363,6 +399,11 @@ def test_ledger_containers_are_registered():
     chip = ChipInfo(0, 16)
     assert isinstance(chip.pods, locks.GuardedDict)
     assert isinstance(chip._active, locks.GuardedSet)
+    from tpushare.quota.manager import QuotaManager
+
+    quota = QuotaManager()
+    assert isinstance(quota._pods, locks.GuardedDict)
+    assert isinstance(quota._usage, locks.GuardedDict)
 
 
 @pytest.mark.skipif(os.environ.get("TPUSHARE_RACE_DETECT") == "1",

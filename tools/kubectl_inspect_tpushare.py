@@ -4,7 +4,9 @@
 Counterpart of the reference's ``kubectl inspect gpushare`` plugin
 (reference ``docs/userguide.md:7-19``): renders the extender's inspect
 API as a per-node, per-chip allocation table plus a cluster summary;
-``-d/--details`` adds the resident pods of every chip.
+``-d/--details`` adds the resident pods of every chip; the ``quota``
+subcommand renders the per-tenant guarantee/limit/usage/borrowed table
+from ``/debug/quota`` (docs/quota.md).
 
 Install as a kubectl plugin by dropping an executable named
 ``kubectl-inspect_tpushare`` on PATH that execs this script, or run it
@@ -124,6 +126,54 @@ def render(doc: dict, details: bool = False) -> str:
                         f"{extra})")
                 if not chip.get("pods"):
                     lines.append("    (idle)")
+    return "\n".join(lines)
+
+
+def fetch_quota(endpoint: str) -> dict | None:
+    """The per-tenant quota snapshot from ``/debug/quota``; None when
+    the extender runs without a quota manager wired or with debug
+    routes disabled."""
+    try:
+        with urllib.request.urlopen(f"{endpoint}/debug/quota",
+                                    timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def render_quota(doc: dict) -> str:
+    """Per-tenant guarantee/limit/usage/borrowed table."""
+    tenants = doc.get("tenants", [])
+    if not tenants:
+        return ("no tenants known — nothing charged yet and no "
+                "tpushare-quotas ConfigMap entries (docs/quota.md)")
+
+    def cell(entry, key):
+        return str(entry[key]) if key in entry else "-"
+
+    rows = [["TENANT", "HBM G/L", "HBM USED(BORROWED)", "CHIPS G/L",
+             "CHIPS USED(BORROWED)", "PODS", "SHARE"]]
+    for t in tenants:
+        rows.append([
+            t["tenant"] + ("" if t.get("configured") else " (no quota)"),
+            f"{cell(t, 'guaranteeHBM')}/{cell(t, 'limitHBM')}",
+            f"{t['usedHBM']}({t['borrowedHBM']})",
+            f"{cell(t, 'guaranteeChips')}/{cell(t, 'limitChips')}",
+            f"{t['usedChips']}({t['borrowedChips']})",
+            str(t["pods"]),
+            f"{t['dominantShare']:.2f}" if t.get("configured") else "-",
+        ])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    lines.append("")
+    lines.append("G/L = guarantee/limit GiB (HBM) or chips; '-' = unset "
+                 "(no guarantee / unlimited). SHARE = dominant "
+                 "usage/guarantee ratio — >1.00 means the tenant is "
+                 "borrowing idle capacity, reclaimed first under "
+                 "contention.")
     return "\n".join(lines)
 
 
@@ -285,7 +335,9 @@ def main(argv: list[str] | None = None) -> int:
         description="Show TPU HBM allocation across sharing nodes.")
     parser.add_argument("node", nargs="?",
                         help="restrict to one node; or the literal "
-                             "'explain' to render a pod's decision trace")
+                             "'explain' to render a pod's decision "
+                             "trace; or the literal 'quota' for the "
+                             "per-tenant guarantee/limit/usage table")
     parser.add_argument("pod", nargs="?", metavar="[ns/]pod",
                         help="with 'explain': the pod whose placement "
                              "decision to explain (namespace defaults "
@@ -314,6 +366,24 @@ def main(argv: list[str] | None = None) -> int:
         print(f"--explain cannot be combined with the positional "
               f"{args.node!r}; use one form", file=sys.stderr)
         return 2
+    if args.node == "quota":
+        if args.pod:
+            print(f"unexpected argument {args.pod!r} after 'quota'",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = fetch_quota(args.endpoint)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        if doc is None:
+            print("quota view unavailable — the extender runs without a "
+                  "quota manager, or debug routes are disabled "
+                  "(DEBUG_ROUTES=0)", file=sys.stderr)
+            return 1
+        print(render_quota(doc))
+        return 0
     if args.node == "explain":
         if not args.pod:
             print("explain needs a pod: kubectl inspect tpushare "

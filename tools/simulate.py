@@ -29,9 +29,17 @@ Scenario schema (YAML or JSON)::
           - {key: pool, value: tpu, effect: NoSchedule}
     execute_preemptions: true    # evict + re-schedule instead of
                                  # reporting would-be victims (optional)
+    quotas:                      # per-tenant quota table  (optional) —
+      team-a:                    # becomes the tpushare-quotas ConfigMap
+        guaranteeHBM: 64         # GiB owed to the tenant
+        limitHBM: 128            # hard ceiling (filter denies past it)
+        guaranteeChips: 2
+        limitChips: 4
+      "*": {limitHBM: 256}       # default for unlisted tenants
     workload:                    # ordered arrival stream
       - count: 8                 # pods in this group      (default 1)
         name: trainer            # names name-0..          (required)
+        namespace: team-a        # tenant (default namespace 'default')
         hbm: 24                  # GiB slice  — or —
         chips: 1                 # whole chips
         group: ring              # gang name               (optional)
@@ -81,6 +89,28 @@ workload:
   - {count: 4, name: ring, chips: 4, group: ring, group_min: 4}
   - {count: 14, name: batch, hbm: 44}
   - {count: 1, name: rush, chips: 4, priority: 1000}
+"""
+
+
+EXAMPLE_TENANTS = """\
+# Mixed-tenant contention under quota: team-serve borrows far past its
+# guarantee while the fleet is idle; team-train's later arrivals are
+# entitled (under guarantee) and reclaim borrowed capacity via the
+# preempt round; a team-serve pod pushing past its hard limit is DENIED
+# at filter (see unschedulable reasons + the tenants section).
+fleet:
+  - count: 4
+    prefix: v5e
+    chips: 4
+    hbm_per_chip: 16
+quotas:
+  team-serve: {guaranteeHBM: 32, limitHBM: 176}
+  team-train: {guaranteeHBM: 128}
+execute_preemptions: true
+workload:
+  - {count: 12, name: decode, namespace: team-serve, hbm: 16}
+  - {count: 6, name: train, namespace: team-train, hbm: 16}
+  - {count: 2, name: burst, namespace: team-serve, hbm: 16}
 """
 
 
@@ -135,6 +165,8 @@ def _expand_workload(scenario: dict) -> list[dict]:
             doc = make_pod(f"{base}-{i}" if count > 1 else base,
                            hbm=int(group.get("hbm", 0)),
                            chips=int(group.get("chips", 0)),
+                           namespace=str(group.get("namespace",
+                                                   "default")),
                            annotations=ann,
                            priority=group.get("priority"))
             if group.get("tolerations"):
@@ -175,6 +207,11 @@ def simulate(scenario: dict) -> dict:
     if not node_docs:
         return {"error": "scenario has no fleet"}
     api = _fresh_api(node_docs)
+    quota_cm = _quota_configmap(scenario)
+    if quota_cm is not None:
+        # Present before the stack boots, exactly like a live cluster:
+        # the controller's informer seeds the quota table from it.
+        api.create_configmap(quota_cm)
     stack, server = serve_stack(api)
     client = _Client(*server.server_address[:2])
 
@@ -215,9 +252,14 @@ def simulate(scenario: dict) -> dict:
 
             if _file(verdict):
                 continue
-            if pod.priority:
+            # Priority pods preempt; priority-0 pods may still RECLAIM
+            # borrowed-over-guarantee capacity at equal priority when a
+            # quota table is in play — the preempt verb owns both cases
+            # (it returns an empty map when no legal victims exist).
+            if pod.priority or quota_cm is not None:
                 plan = _whatif_preempt(client, pod, candidates)
-                verdict["would_preempt"] = plan
+                if plan:
+                    verdict["would_preempt"] = plan
                 if execute and plan:
                     outcome = _execute_preemption(
                         api, client, stack.controller, pod, plan)
@@ -247,11 +289,30 @@ def simulate(scenario: dict) -> dict:
                                        "node": final.node_name,
                                        "via": "gang commit"})
         inspect_doc = client.get("/tpushare-scheduler/inspect")
+        tenants = (client.get("/debug/quota").get("tenants", [])
+                   if quota_cm is not None else [])
     finally:
         client.close()
         shutdown_stack(stack, server)
     return _report(inspect_doc, placements, held, unschedulable,
-                   latencies, executed_preemptions)
+                   latencies, executed_preemptions, tenants)
+
+
+def _quota_configmap(scenario: dict) -> dict | None:
+    """Scenario ``quotas:`` table -> the tpushare-quotas ConfigMap doc
+    (None when the scenario declares no quotas)."""
+    quotas = scenario.get("quotas")
+    if not quotas:
+        return None
+    from tpushare.utils import const
+
+    return {
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": const.QUOTA_CONFIGMAP,
+                     "namespace": "kube-system"},
+        "data": {str(tenant): json.dumps(spec)
+                 for tenant, spec in quotas.items()},
+    }
 
 
 class WireError(RuntimeError):
@@ -355,7 +416,7 @@ def _execute_preemption(api, client: _Client, controller, pod,
 
 
 def _report(inspect_doc, placements, held, unschedulable,
-            latencies, executed_preemptions=()):
+            latencies, executed_preemptions=(), tenants=()):
     nodes = []
     total_hbm = used_hbm = free_whole_chips = cordoned_hbm = 0
     for n in inspect_doc.get("nodes", []):
@@ -397,6 +458,7 @@ def _report(inspect_doc, placements, held, unschedulable,
         "unschedulable_pods": unschedulable,
         "gangs": inspect_doc.get("gangs", []),
         "preemptions_executed": list(executed_preemptions),
+        "tenants": list(tenants),
     }
 
 
@@ -436,6 +498,15 @@ def _print_human(report: dict) -> None:
         for p in report["preemptions_executed"]:
             print(f"  {p['pod']} -> {p['node']}: evicted "
                   f"{', '.join(p['evicted'])}")
+    if report.get("tenants"):
+        print("\ntenants (quota):")
+        for t in report["tenants"]:
+            spec = "/".join(str(t.get(k, "-")) for k in
+                            ("guaranteeHBM", "limitHBM"))
+            print(f"  {t['tenant']}: {t['usedHBM']} GiB used "
+                  f"({t['borrowedHBM']} borrowed), "
+                  f"{t['usedChips']} chip(s), guarantee/limit HBM "
+                  f"{spec}, {t['pods']} pod(s)")
     for g in report.get("gangs", []):
         print(f"\ngang {g.get('name')}: {g}")
 
@@ -669,6 +740,10 @@ def main() -> None:
                     help="machine-readable report on stdout")
     ap.add_argument("--example", action="store_true",
                     help="print a starter scenario and exit")
+    ap.add_argument("--example-tenants", action="store_true",
+                    help="print a mixed-tenant quota-contention "
+                         "scenario (borrowing, reclaim, limit denial) "
+                         "and exit")
     ap.add_argument("--drain", metavar="NODE",
                     help="with --defrag: ask whether NODE can be "
                          "drained — only its residents are re-packed "
@@ -683,6 +758,9 @@ def main() -> None:
     args = ap.parse_args()
     if args.example:
         print(EXAMPLE, end="")
+        return
+    if args.example_tenants:
+        print(EXAMPLE_TENANTS, end="")
         return
     if not args.scenario and not args.defrag:
         ap.error("scenario file required (or --example / --defrag)")

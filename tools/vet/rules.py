@@ -69,6 +69,11 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
                        "_node_epochs"),
     "NodeInfo": ("chips",),
     "ChipInfo": ("pods", "_contrib", "_used", "_active"),
+    # The tenant quota ledger (tpushare/quota/manager.py): charges come
+    # from the cache's pod add/remove path on sync-worker threads while
+    # the filter/bind verbs read usage on HTTP threads — the same
+    # unlocked-mutation bug class as the node map.
+    "QuotaManager": ("_pods", "_usage", "_config"),
 }
 
 #: Method calls that mutate a dict/set/list in place.

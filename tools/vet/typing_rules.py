@@ -20,7 +20,7 @@ from tools.vet.engine import Violation
 
 #: Path fragments of the strictly-typed core packages.
 CORE_PACKAGES = ("tpushare/cache/", "tpushare/scheduler/",
-                 "tpushare/utils/", "tpushare/api/")
+                 "tpushare/utils/", "tpushare/api/", "tpushare/quota/")
 
 #: Parameter names exempt from annotation (bound implicitly).
 _IMPLICIT = {"self", "cls"}

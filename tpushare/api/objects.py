@@ -277,6 +277,15 @@ class PodDisruptionBudget(K8sObject):
         return True
 
 
+class ConfigMap(K8sObject):
+    """A ``v1.ConfigMap`` view — just enough for the quota subsystem to
+    read the ``tpushare-quotas`` document the informer watches."""
+
+    @property
+    def data(self) -> dict:
+        return self.raw.get("data") or {}
+
+
 def binding_doc(pod: Pod, node_name: str) -> dict:
     """Build the ``v1.Binding`` document POSTed to ``pods/{name}/binding``
     (counterpart of reference ``nodeinfo.go:174-189``)."""

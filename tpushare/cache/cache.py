@@ -21,6 +21,7 @@ from typing import Callable
 
 from tpushare.api.objects import Node, Pod
 from tpushare.cache.nodeinfo import NodeInfo
+from tpushare.quota.manager import QuotaManager
 from tpushare.utils import locks
 from tpushare.utils import const
 from tpushare.utils import node as nodeutils
@@ -32,17 +33,24 @@ log = logging.getLogger(__name__)
 class SchedulerCache:
     def __init__(self, node_getter: Callable[[str], Node | None],
                  pod_lister: Callable[[], list[Pod]],
-                 default_scoring: str | None = None) -> None:
+                 default_scoring: str | None = None,
+                 quota: QuotaManager | None = None) -> None:
         """``node_getter(name) -> Node | None`` and
         ``pod_lister() -> list[Pod]`` abstract the informer listers the
         reference wired in (cache.go:30-38); tests pass a fake client's
         bound methods. ``default_scoring`` is the fleet scoring policy
         handed to every ledger's chip picker — the SAME value the
         prioritize verb uses, so cross-node and within-node placement
-        can never disagree on a pod's policy."""
+        can never disagree on a pod's policy. ``quota`` (a
+        :class:`tpushare.quota.manager.QuotaManager`) is charged on the
+        same add/remove path that feeds the chip ledger — including the
+        startup rebuild, which is what makes tenant usage restart-safe
+        with no extra state."""
         self._node_getter = node_getter
         self._pod_lister = pod_lister
         self._default_scoring = default_scoring
+        #: Optional tenant ledger mirroring this cache's known pods.
+        self.quota = quota
         self._lock = locks.TracingRLock("cache/table")
         # Guarded containers: `make test-race` fails any mutation of
         # these while cache/table is unheld (the reference's unlocked-
@@ -240,6 +248,11 @@ class SchedulerCache:
                 self._known_pods[pod.uid] = pod
                 # Placed: its ledger entry accounts for it from here on.
                 self._nominated.pop(pod.uid, None)
+                if self.quota is not None:
+                    # Same truth, same moment: the tenant ledger charges
+                    # exactly what the chip ledger just priced, so quota
+                    # usage rebuilds from annotations alongside it.
+                    self.quota.charge(pod)
             return added
 
     def remove_pod(self, pod: Pod) -> None:
@@ -247,6 +260,8 @@ class SchedulerCache:
         with self._lock:
             self._known_pods.pop(pod.uid, None)
             self._nominated.pop(pod.uid, None)
+            if self.quota is not None:
+                self.quota.uncharge(pod)
             info = self._nodes.get(pod.node_name)
         if info is not None:
             info.remove_pod(pod)

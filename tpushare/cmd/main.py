@@ -30,6 +30,9 @@ Environment (reference cmd/main.go:23,92-98):
   whole-free chips) or ``spread`` (emptiest placement wins — fewer
   co-tenants per chip for latency-sensitive inference fleets). Gang
   consolidation and ICI/slice affinity apply under both.
+* ``TPUSHARE_QUOTA_NAMESPACE`` — namespace the ``tpushare-quotas``
+  ConfigMap (per-tenant quota table, docs/quota.md) is trusted from;
+  default ``kube-system``.
 """
 
 from __future__ import annotations
@@ -99,27 +102,35 @@ def build_stack(client, is_leader=None) -> Stack:
     controller = Controller(client, is_leader=is_leader,
                             default_scoring=scoring)
     # Quorum pre-checks enumerate nodes from the informer store — no
-    # apiserver LIST on the bind path.
+    # apiserver LIST on the bind path. The controller's quota ledger
+    # (charged by the cache, configured from the tpushare-quotas
+    # ConfigMap) is ONE object threaded through every verb, so filter
+    # denial, bind re-check, fair-share scoring, and reclaim costing
+    # can never disagree on a tenant's standing.
     gang = GangPlanner(controller.cache, client,
                        node_lister=controller.hub.nodes.list,
-                       is_leader=is_leader)
+                       is_leader=is_leader, quota=controller.quota)
     gang.start()  # housekeeping tick: gang expiry + bind retries
     # Demand entries prune against the informer's pod view so an HA
     # peer's bind (or a user's delete) retires the autoscaler signal
     # on every replica, not just the one that saw the passing filter.
     predicate = Predicate(controller.cache, demand=DemandTracker(
-        pod_lookup=controller.hub.get_pod))
+        pod_lookup=controller.hub.get_pod),
+        quota=controller.quota, client=client)
     prioritize = Prioritize(
-        controller.cache, gang_planner=gang, policy=scoring)
+        controller.cache, gang_planner=gang, policy=scoring,
+        quota=controller.quota)
     binder = Bind(controller.cache, client, gang_planner=gang,
-                  pod_lister=controller.hub.get_pod)
+                  pod_lister=controller.hub.get_pod,
+                  quota=controller.quota)
     inspect = Inspect(controller.cache, client.list_nodes,
                       gang_planner=gang)
     # The PDB lister feeds the preempt verb's violation recount (the
     # victim sets WE author differ from the scheduler's nominations, so
     # its NumPDBViolations would be stale for them).
     preempt = Preempt(controller.cache,
-                      pdb_lister=controller.hub.pdbs.list)
+                      pdb_lister=controller.hub.pdbs.list,
+                      quota=controller.quota)
     admission = Admission(controller.cache,
                           node_lister=controller.hub.nodes.list)
     return Stack(controller, predicate, prioritize, binder, inspect,
@@ -139,7 +150,8 @@ def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2):
         prioritize=stack.prioritize, preempt=stack.preempt,
         admission=stack.admission,
         gang_planner=stack.binder.gang_planner,
-        workqueue=stack.controller.queue)
+        workqueue=stack.controller.queue,
+        quota=stack.controller.quota)
     serve_forever(server)
     return stack, server
 
@@ -272,7 +284,8 @@ def main() -> None:
                                 leader=leader,
                                 gang_planner=stack.binder.gang_planner,
                                 debug_routes=debug_routes,
-                                workqueue=stack.controller.queue)
+                                workqueue=stack.controller.queue,
+                                quota=stack.controller.quota)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
     if bool(cert) != bool(key):
         log.error("TLS misconfigured: exactly one of TLS_CERT_FILE / "

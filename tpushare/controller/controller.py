@@ -16,14 +16,17 @@ configurable for real (``THREADNESS`` was parsed to a constant 1).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 
-from tpushare.api.objects import Pod
+from tpushare.api.objects import ConfigMap, Pod
 from tpushare.cache.cache import SchedulerCache
 from tpushare.k8s import events
 from tpushare.k8s.errors import ApiError, NotFoundError
 from tpushare.k8s.informer import InformerHub
 from tpushare.k8s.workqueue import RateLimitedQueue
+from tpushare.quota import config as quota_config
+from tpushare.quota.manager import QuotaManager
 from tpushare.utils import const
 from tpushare.utils import locks
 from tpushare.utils import pod as podutils
@@ -37,11 +40,24 @@ class Controller:
         self.client = client
         self.hub = hub or InformerHub(client)
         self.queue = RateLimitedQueue()
+        #: Tenant quota ledger; charged/uncharged by the cache's pod
+        #: add/remove path, configured from the tpushare-quotas
+        #: ConfigMap watched below. Handlers (filter/prioritize/preempt/
+        #: bind) consult it via build_stack's wiring.
+        self.quota = QuotaManager()
+        #: Namespace the quota ConfigMap is trusted from. Pinned: the
+        #: watch is cluster-wide, and matching by name alone would let
+        #: anyone with ConfigMap rights in their own namespace create —
+        #: or worse, delete — a same-named document and flip the whole
+        #: fleet's quota table.
+        self._quota_namespace = os.environ.get("TPUSHARE_QUOTA_NAMESPACE",
+                                               "kube-system")
         # default_scoring flows to every ledger's chip picker so
         # within-node placement agrees with the prioritize verb's fleet
         # policy (build_stack passes the same env-derived value to both).
         self.cache = SchedulerCache(self._get_node, self._list_pods,
-                                    default_scoring=default_scoring)
+                                    default_scoring=default_scoring,
+                                    quota=self.quota)
         #: ``() -> bool`` — gates apiserver WRITES this controller
         #: originates (today: the gang reaper). Reads/ledger upkeep run
         #: on every replica; deletes from N replicas would multiply.
@@ -65,6 +81,12 @@ class Controller:
             filter_fn=self._is_relevant_pod,
         )
         self.hub.add_node_handler(on_delete=self._on_node_delete)
+        self.hub.add_configmap_handler(
+            on_add=self._on_quota_configmap,
+            on_update=lambda old, new: self._on_quota_configmap(new),
+            on_delete=lambda cm: self.quota.set_config(quota_config.EMPTY),
+            filter_fn=self._is_quota_configmap,
+        )
 
     # -- listers wired into the cache ----------------------------------- #
 
@@ -82,6 +104,19 @@ class Controller:
     def _list_pods(self):
         pods = self.hub.pods.list()
         return pods if pods else self.client.list_pods()
+
+    def _is_quota_configmap(self, cm: ConfigMap) -> bool:
+        """Only ``tpushare-quotas`` in the pinned namespace
+        (``TPUSHARE_QUOTA_NAMESPACE``, default kube-system) drives the
+        quota table."""
+        return (cm.name == const.QUOTA_CONFIGMAP
+                and cm.namespace == self._quota_namespace)
+
+    def _on_quota_configmap(self, cm: ConfigMap) -> None:
+        """Apply a (re)written quota ConfigMap. Handled inline like node
+        deletes: set_config is idempotent, needs no apiserver round-trip,
+        and a rate-limited retry would only delay enforcement."""
+        self.quota.set_config(quota_config.parse_configmap(cm))
 
     @staticmethod
     def _is_relevant_pod(pod: Pod) -> bool:
@@ -282,6 +317,12 @@ class Controller:
         self.hub.start()
         if not self.hub.wait_for_sync():
             raise RuntimeError("informer cache never synced")
+        # The initial LIST populates the stores without dispatching
+        # handlers; seed the quota table from it so limits are enforced
+        # from the very first filter request, not the first cm rewrite.
+        for cm in self.hub.configmaps.list():
+            if self._is_quota_configmap(cm):
+                self._on_quota_configmap(cm)
         self.cache.build()
         for i in range(workers):
             t = threading.Thread(target=self._worker,

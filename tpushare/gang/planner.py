@@ -83,9 +83,19 @@ class _Group:
 class GangPlanner:
     def __init__(self, cache, client, ttl: float = 120.0,
                  housekeeping_interval: float = 5.0, node_lister=None,
-                 is_leader=None):
+                 is_leader=None, quota=None):
         self.cache = cache
         self.client = client
+        #: Optional QuotaManager. The group's quota charge is atomic
+        #: with the quorum lifecycle FOR FREE: each reservation is
+        #: priced through ``cache.add_or_update_pod`` (which charges the
+        #: tenant ledger) and TTL rollback runs ``cache.remove_pod``
+        #: (which uncharges) — so a gang that never commits leaves no
+        #: quota residue. What needs the manager here is the DOOMED
+        #: check: a gang whose outstanding members must blow the
+        #: tenant's hard limit can never reach quorum, and without this
+        #: gate it would squat on reserved HBM until the TTL.
+        self.quota = quota
         #: ``() -> list[Node]`` for the quorum pre-check; an informer
         #: store when wired (no apiserver LIST per bind attempt),
         #: falling back to the client's LIST.
@@ -246,6 +256,18 @@ class GangPlanner:
         needed = group.minimum - len(group.reservations) - bound_n
         if needed <= 0:
             return True, ""
+        if self.quota is not None:
+            # Tenant hard limit over the WHOLE outstanding group
+            # (members modeled as clones of this pod, same bound as the
+            # capacity check below): per-member filtering would admit
+            # the first members and leave the gang squatting when the
+            # limit lands mid-trickle.
+            ok, reason = self.quota.admit(pod, count=needed)
+            if not ok:
+                return False, (
+                    f"gang {group.name}: quorum {group.minimum} can never "
+                    f"assemble under its tenant's quota ({reason}); "
+                    "rejecting without reserving")
         try:
             nodes = self._node_lister()
         except ApiError:

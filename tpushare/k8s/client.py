@@ -25,12 +25,19 @@ import urllib.request
 from urllib.parse import quote
 
 from tpushare import trace
-from tpushare.api.objects import Node, Pod, PodDisruptionBudget
+from tpushare.api.objects import ConfigMap, Node, Pod, PodDisruptionBudget
 from tpushare.k8s.errors import ApiError, ConflictError, NotFoundError
+from tpushare.utils import const
 
 log = logging.getLogger(__name__)
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+#: LIST/WATCH path for the quota ConfigMap: name-filtered server-side
+#: so the watch stream and informer store carry ONE document, not every
+#: ConfigMap in the cluster.
+_CONFIGMAP_PATH = ("/api/v1/configmaps?fieldSelector="
+                   + quote(f"metadata.name={const.QUOTA_CONFIGMAP}"))
 
 
 class ClusterConfig:
@@ -250,6 +257,20 @@ class ApiClient:
         doc = self._request("GET", "/apis/policy/v1/poddisruptionbudgets")
         return [PodDisruptionBudget(item) for item in doc.get("items", [])]
 
+    def get_configmap(self, namespace: str, name: str) -> ConfigMap:
+        return ConfigMap(self._request(
+            "GET", f"/api/v1/namespaces/{namespace}/configmaps/{name}"))
+
+    def list_configmaps(self) -> list[ConfigMap]:
+        """ConfigMaps named ``tpushare-quotas`` (server-side
+        fieldSelector) — the only ConfigMap surface the extender
+        consumes. An unfiltered cluster-wide LIST would drag every
+        namespace's kube-root-ca.crt (and any 1-MiB app config) into
+        the informer store forever. Needs a ``configmaps``
+        get/list/watch RBAC rule (config/tpushare-schd-extender.yaml)."""
+        doc = self._request("GET", _CONFIGMAP_PATH)
+        return [ConfigMap(item) for item in doc.get("items", [])]
+
     def update_node(self, node: Node) -> Node:
         """PUT the node object itself — metadata (annotations) changes do
         not persist through the /status subresource."""
@@ -323,7 +344,8 @@ class ApiClient:
         for kind, path in (("Pod", "/api/v1/pods"),
                            ("Node", "/api/v1/nodes"),
                            ("PodDisruptionBudget",
-                            "/apis/policy/v1/poddisruptionbudgets")):
+                            "/apis/policy/v1/poddisruptionbudgets"),
+                           ("ConfigMap", _CONFIGMAP_PATH)):
             t = threading.Thread(
                 target=self._watch_loop, args=(kind, path, q, stop),
                 name=f"tpushare-watch-{kind.lower()}", daemon=True)
@@ -349,7 +371,10 @@ class ApiClient:
                 # the reconnect gap are lost forever — e.g. a deleted pod
                 # would hold its HBM in the ledger indefinitely).
                 q.put((kind, "RELIST", listing.get("items", []) or []))
-                url = (f"{self.config.host}{path}?watch=true"
+                # The path may already carry a query (the ConfigMap
+                # fieldSelector) — extend it, don't start a second one.
+                sep = "&" if "?" in path else "?"
+                url = (f"{self.config.host}{path}{sep}watch=true"
                        f"&resourceVersion={rv}&timeoutSeconds=300"
                        "&allowWatchBookmarks=true")
                 req = urllib.request.Request(url)

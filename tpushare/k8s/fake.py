@@ -15,7 +15,7 @@ import copy
 import itertools
 import queue
 
-from tpushare.api.objects import Node, Pod, PodDisruptionBudget
+from tpushare.api.objects import ConfigMap, Node, Pod, PodDisruptionBudget
 from tpushare.utils import locks
 from tpushare.k8s.errors import ApiError, ConflictError, NotFoundError
 
@@ -42,6 +42,7 @@ class FakeApiServer:
         self._nodes: dict[str, dict] = {}  # name -> raw node
         self._leases: dict[str, dict] = {}  # "ns/name" -> raw lease
         self._pdbs: dict[str, dict] = {}   # "ns/name" -> raw pdb
+        self._configmaps: dict[str, dict] = {}  # "ns/name" -> raw cm
         self._rv = itertools.count(1)
         self._watchers: list[queue.Queue] = []
         self._uid = itertools.count(1)
@@ -267,6 +268,52 @@ class FakeApiServer:
             node = self._nodes.pop(name, None)
             if node is not None:
                 self._notify("Node", "DELETED", node)
+
+    # ------------------------------------------------------------------ #
+    # ConfigMaps (the quota table travels in one)
+    # ------------------------------------------------------------------ #
+
+    def create_configmap(self, raw: dict) -> ConfigMap:
+        with self._lock:
+            cm = _dcopy(raw)
+            meta = cm.setdefault("metadata", {})
+            meta.setdefault("namespace", "default")
+            key = f"{meta['namespace']}/{meta['name']}"
+            if key in self._configmaps:
+                raise ConflictError(reason=f"configmap {key} already exists")
+            self._bump(cm)
+            self._configmaps[key] = cm
+            self._notify("ConfigMap", "ADDED", cm)
+            return ConfigMap(_dcopy(cm))
+
+    def get_configmap(self, namespace: str, name: str) -> ConfigMap:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            if key not in self._configmaps:
+                raise NotFoundError(reason=f"configmap {key} not found")
+            return ConfigMap(_dcopy(self._configmaps[key]))
+
+    def update_configmap(self, cm: ConfigMap) -> ConfigMap:
+        with self._lock:
+            key = f"{cm.namespace}/{cm.name}"
+            if key not in self._configmaps:
+                raise NotFoundError(reason=f"configmap {key} not found")
+            updated = _dcopy(cm.raw)
+            self._bump(updated)
+            self._configmaps[key] = updated
+            self._notify("ConfigMap", "MODIFIED", updated)
+            return ConfigMap(_dcopy(updated))
+
+    def delete_configmap(self, namespace: str, name: str) -> None:
+        with self._lock:
+            cm = self._configmaps.pop(f"{namespace}/{name}", None)
+            if cm is not None:
+                self._notify("ConfigMap", "DELETED", cm)
+
+    def list_configmaps(self) -> list[ConfigMap]:
+        with self._lock:
+            return [ConfigMap(_dcopy(c))
+                    for c in self._configmaps.values()]
 
     # ------------------------------------------------------------------ #
     # PodDisruptionBudgets (policy/v1)

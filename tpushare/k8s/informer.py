@@ -13,13 +13,14 @@ from __future__ import annotations
 import logging
 import threading
 
-from tpushare.api.objects import Node, Pod, PodDisruptionBudget
+from tpushare.api.objects import ConfigMap, Node, Pod, PodDisruptionBudget
 from tpushare.utils import locks
 
 log = logging.getLogger(__name__)
 
 _WRAPPERS = {"Pod": Pod, "Node": Node,
-             "PodDisruptionBudget": PodDisruptionBudget}
+             "PodDisruptionBudget": PodDisruptionBudget,
+             "ConfigMap": ConfigMap}
 
 
 class Store:
@@ -31,7 +32,7 @@ class Store:
 
     @staticmethod
     def key_of(obj) -> str:
-        if isinstance(obj, (Pod, PodDisruptionBudget)):
+        if isinstance(obj, (Pod, PodDisruptionBudget, ConfigMap)):
             return f"{obj.namespace}/{obj.name}"
         return obj.name
 
@@ -70,8 +71,10 @@ class InformerHub:
         self.pods = Store("informer/pods")
         self.nodes = Store("informer/nodes")
         self.pdbs = Store("informer/pdbs")
+        self.configmaps = Store("informer/configmaps")
         self._handlers: dict[str, list] = {"Pod": [], "Node": [],
-                                           "PodDisruptionBudget": []}
+                                           "PodDisruptionBudget": [],
+                                           "ConfigMap": []}
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -86,6 +89,11 @@ class InformerHub:
     def add_node_handler(self, on_add=None, on_update=None, on_delete=None,
                          filter_fn=None) -> None:
         self._handlers["Node"].append((on_add, on_update, on_delete, filter_fn))
+
+    def add_configmap_handler(self, on_add=None, on_update=None,
+                              on_delete=None, filter_fn=None) -> None:
+        self._handlers["ConfigMap"].append(
+            (on_add, on_update, on_delete, filter_fn))
 
     # -- lifecycle ------------------------------------------------------ #
 
@@ -104,6 +112,15 @@ class InformerHub:
                 log.warning("PDB list failed; preempt PDB recount will "
                             "see no budgets until the watch recovers",
                             exc_info=True)
+        # ConfigMaps are equally optional (the quota table); a client
+        # without the surface, or RBAC denying it, just means no quotas.
+        list_cms = getattr(self.client, "list_configmaps", None)
+        if list_cms is not None:
+            try:
+                self.configmaps.replace(list_cms())
+            except Exception:  # pragma: no cover - RBAC may deny configmaps
+                log.warning("ConfigMap list failed; quota config will not "
+                            "load until the watch recovers", exc_info=True)
         self._synced.set()
         self._thread = threading.Thread(
             target=self._run, name="tpushare-informer", daemon=True)
@@ -132,7 +149,8 @@ class InformerHub:
                 if wrapper is None:
                     continue
                 store = {"Pod": self.pods, "Node": self.nodes,
-                         "PodDisruptionBudget": self.pdbs}[kind]
+                         "PodDisruptionBudget": self.pdbs,
+                         "ConfigMap": self.configmaps}[kind]
                 if event_type == "RELIST":
                     # Watch stream reconnected: diff the fresh LIST against
                     # the store and synthesize the events missed in the gap.

@@ -1,0 +1,28 @@
+"""tpushare.quota — multi-tenant HBM/chip arbitration.
+
+A *tenant* is a namespace (overridable per pod with the
+``tpushare.io/tenant`` label). Each tenant may carry a quota spec —
+``guarantee`` and ``limit`` in HBM GiB and whole chips — read from the
+``tpushare-quotas`` ConfigMap the informer watches. The semantics are
+the elastic-quota / fair-share-scheduler shape (Kubernetes
+capacity-scheduling, Themis NSDI'20):
+
+* **limit** is hard: the filter verb denies any pod that would push its
+  tenant past it, on every node, with a quota-specific reason.
+* **guarantee** is soft capacity the tenant is *owed*: usage beyond it
+  is **borrowing** of idle capacity — legal while nobody under their
+  guarantee needs the chips, and the first thing reclaimed (preempt
+  victim tier + equal-priority reclaim) when an under-guarantee tenant
+  cannot fit.
+* Usage is a ledger reconciled from the same pod-annotation truth the
+  scheduler cache rebuilds on restart — no durable state is added.
+
+See :mod:`tpushare.quota.manager` for the ledger and
+:mod:`tpushare.quota.config` for the ConfigMap format; docs/quota.md is
+the operator contract.
+"""
+
+from tpushare.quota.config import QuotaConfig, TenantQuota, parse_configmap
+from tpushare.quota.manager import QuotaManager
+
+__all__ = ["QuotaConfig", "QuotaManager", "TenantQuota", "parse_configmap"]

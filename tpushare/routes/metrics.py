@@ -170,6 +170,73 @@ INFORMER_RELISTS = Counter(
     "lost in the gap. A steady rate means the watch keeps dropping",
     registry=REGISTRY,
 )
+QUOTA_DENIED = Counter(
+    "tpushare_quota_denied_total",
+    "Pods denied at filter because their tenant would exceed its hard "
+    "quota limit. NOT unplaceable demand: capacity exists, the tenant "
+    "is over policy — the autoscaler must not scale for these",
+    ["tenant"], registry=REGISTRY,
+)
+QUOTA_GUARANTEE_HBM = Gauge(
+    "tpushare_quota_guarantee_hbm_gib",
+    "Guaranteed HBM share per tenant (from the tpushare-quotas "
+    "ConfigMap); usage beyond it is borrowing, reclaimed first",
+    ["tenant"], registry=REGISTRY,
+)
+QUOTA_LIMIT_HBM = Gauge(
+    "tpushare_quota_limit_hbm_gib",
+    "Hard HBM ceiling per tenant; filter denies pods past it",
+    ["tenant"], registry=REGISTRY,
+)
+QUOTA_USED_HBM = Gauge(
+    "tpushare_quota_used_hbm_gib",
+    "HBM currently charged to the tenant's ledger (granted slices of "
+    "assumed, non-terminated pods)",
+    ["tenant"], registry=REGISTRY,
+)
+QUOTA_BORROWED_HBM = Gauge(
+    "tpushare_quota_borrowed_hbm_gib",
+    "HBM the tenant holds beyond its guarantee — idle capacity on "
+    "loan, evicted first when an under-guarantee tenant cannot fit",
+    ["tenant"], registry=REGISTRY,
+)
+QUOTA_GUARANTEE_CHIPS = Gauge(
+    "tpushare_quota_guarantee_chips",
+    "Guaranteed whole-chip share per tenant",
+    ["tenant"], registry=REGISTRY,
+)
+QUOTA_LIMIT_CHIPS = Gauge(
+    "tpushare_quota_limit_chips",
+    "Hard whole-chip ceiling per tenant",
+    ["tenant"], registry=REGISTRY,
+)
+QUOTA_USED_CHIPS = Gauge(
+    "tpushare_quota_used_chips",
+    "Whole chips currently charged to the tenant's ledger",
+    ["tenant"], registry=REGISTRY,
+)
+QUOTA_BORROWED_CHIPS = Gauge(
+    "tpushare_quota_borrowed_chips",
+    "Whole chips the tenant holds beyond its guarantee",
+    ["tenant"], registry=REGISTRY,
+)
+UNSCHED_PODS_TENANT = Gauge(
+    "tpushare_unschedulable_pods_by_tenant",
+    "Per-tenant breakdown of tpushare_unschedulable_pods: WHOSE demand "
+    "is unplaceable (quota-denied pods excluded — they are policy, "
+    "not missing capacity)",
+    ["tenant"], registry=REGISTRY,
+)
+UNSCHED_HBM_TENANT = Gauge(
+    "tpushare_unschedulable_demand_hbm_gib_by_tenant",
+    "Per-tenant breakdown of the unplaceable HBM demand",
+    ["tenant"], registry=REGISTRY,
+)
+UNSCHED_CHIPS_TENANT = Gauge(
+    "tpushare_unschedulable_demand_chips_by_tenant",
+    "Per-tenant breakdown of the unplaceable whole-chip demand",
+    ["tenant"], registry=REGISTRY,
+)
 TELEMETRY_ERRORS = Counter(
     "tpushare_telemetry_errors_total",
     "Errors swallowed on telemetry paths (metrics scrape parse, trace "
@@ -231,8 +298,35 @@ def observe_cache(cache) -> None:
                 OVERRUN_PODS.labels(node=info.name).set(overrunning)
 
 
+def observe_quota(quota) -> None:
+    """Refresh per-tenant quota gauges from the tenant ledger. Rebuilt
+    from scratch each scrape (like the node gauges) so a tenant whose
+    last pod exited — or whose ConfigMap entry was removed — drops its
+    label series instead of freezing at the final value."""
+    with _SCRAPE_LOCK:
+        for gauge in (QUOTA_GUARANTEE_HBM, QUOTA_LIMIT_HBM,
+                      QUOTA_USED_HBM, QUOTA_BORROWED_HBM,
+                      QUOTA_GUARANTEE_CHIPS, QUOTA_LIMIT_CHIPS,
+                      QUOTA_USED_CHIPS, QUOTA_BORROWED_CHIPS):
+            gauge.clear()
+        for entry in quota.snapshot():
+            tenant = entry["tenant"]
+            QUOTA_USED_HBM.labels(tenant=tenant).set(entry["usedHBM"])
+            QUOTA_USED_CHIPS.labels(tenant=tenant).set(entry["usedChips"])
+            QUOTA_BORROWED_HBM.labels(tenant=tenant).set(
+                entry["borrowedHBM"])
+            QUOTA_BORROWED_CHIPS.labels(tenant=tenant).set(
+                entry["borrowedChips"])
+            for key, gauge in (("guaranteeHBM", QUOTA_GUARANTEE_HBM),
+                               ("limitHBM", QUOTA_LIMIT_HBM),
+                               ("guaranteeChips", QUOTA_GUARANTEE_CHIPS),
+                               ("limitChips", QUOTA_LIMIT_CHIPS)):
+                if key in entry:
+                    gauge.labels(tenant=tenant).set(entry[key])
+
+
 def scrape(cache, gang_planner=None, leader=None, demand=None,
-           workqueue=None) -> bytes:
+           workqueue=None, quota=None) -> bytes:
     """Atomic observe+render for the /metrics handler."""
     # Import here, not at module top: events.py imports this module for
     # its drop counter, and a top-level back-import would cycle.
@@ -240,11 +334,21 @@ def scrape(cache, gang_planner=None, leader=None, demand=None,
 
     with _SCRAPE_LOCK:
         observe_cache(cache)
+        if quota is not None:
+            observe_quota(quota)
         if demand is not None:
             pods, hbm, chips = demand.snapshot()
             UNSCHED_PODS.set(pods)
             UNSCHED_HBM.set(hbm)
             UNSCHED_CHIPS.set(chips)
+            for gauge in (UNSCHED_PODS_TENANT, UNSCHED_HBM_TENANT,
+                          UNSCHED_CHIPS_TENANT):
+                gauge.clear()
+            for tenant, (t_pods, t_hbm, t_chips) in \
+                    demand.by_tenant().items():
+                UNSCHED_PODS_TENANT.labels(tenant=tenant).set(t_pods)
+                UNSCHED_HBM_TENANT.labels(tenant=tenant).set(t_hbm)
+                UNSCHED_CHIPS_TENANT.labels(tenant=tenant).set(t_chips)
         if gang_planner is not None:
             # stats() is the cheap view (no member lists / TTL math) —
             # this runs under the scrape lock.

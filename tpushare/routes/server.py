@@ -17,6 +17,8 @@ Routes:
 * ``GET  /debug/flight``    — decision flight recorder: the last N
   completed placement decisions (``?n=`` limits the dump)
 * ``GET  /debug/trace/<ns>/<pod>`` — one pod's latest decision trace
+* ``GET  /debug/quota``     — per-tenant quota snapshot: guarantee /
+  limit / usage / borrowed (the tenancy ledger, docs/quota.md)
 
 The scheduling verbs run inside :mod:`tpushare.trace` phases, so every
 TPU pod's filter → prioritize → (preempt) → bind story is captured
@@ -67,7 +69,7 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
                  prefix: str = DEFAULT_PREFIX, prioritize=None,
                  preempt=None, admission=None, leader=None,
                  gang_planner=None, debug_routes: bool = True,
-                 workqueue=None):
+                 workqueue=None, quota=None):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
@@ -91,6 +93,11 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
         #: depth/retry gauges. Optional: handler-only deployments (and
         #: most tests) have no controller.
         self.workqueue = workqueue
+        #: Tenant quota ledger (QuotaManager), for the per-tenant
+        #: gauges in /metrics and the GET /debug/quota snapshot. Wired
+        #: explicitly like gang_planner: dropping it must fail loudly,
+        #: not freeze the tenant gauges.
+        self.quota = quota
         super().__init__(addr, _Handler)
 
 
@@ -221,7 +228,8 @@ class _Handler(BaseHTTPRequestHandler):
                                    gang_planner=self.server.gang_planner,
                                    leader=self.server.leader,
                                    demand=self.server.predicate.demand,
-                                   workqueue=self.server.workqueue),
+                                   workqueue=self.server.workqueue,
+                                   quota=self.server.quota),
                     ctype="text/plain; version=0.0.4")
             elif path.startswith("/debug/") and not self.server.debug_routes:
                 self._send_json({"Error": "debug routes disabled"}, 404)
@@ -235,6 +243,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "decisions": trace.flight(limit or None),
                     "recordingDrops": trace.recorder().drops.value,
                 })
+            elif path == "/debug/quota":
+                if self.server.quota is None:
+                    self._send_json({"Error": "quota not configured"}, 404)
+                else:
+                    self._send_json(
+                        {"tenants": self.server.quota.snapshot()})
             elif path.startswith("/debug/trace/"):
                 rest = path[len("/debug/trace/"):]
                 ns, sep, pod_name = rest.partition("/")

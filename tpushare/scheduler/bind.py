@@ -34,7 +34,7 @@ class Bind:
     def __init__(self, cache: SchedulerCache, client: Any,
                  gang_planner: Any = None,
                  pod_lister: Callable[[str, str], Pod | None] | None = None,
-                 ) -> None:
+                 quota: Any = None) -> None:
         self.cache = cache
         self.client = client
         self.gang_planner = gang_planner
@@ -42,6 +42,13 @@ class Bind:
         #: wired, reads go to the local cache first like the reference's
         #: lister path.
         self.pod_lister = pod_lister
+        #: Optional QuotaManager: re-checks the tenant hard limit at the
+        #: last moment before the ledger commit. The filter already
+        #: denied over-limit pods, but sibling binds can land between a
+        #: pod's filter pass and its bind (the same freshness race the
+        #: allocator's conflict retry exists for) — without this gate a
+        #: tenant could slip past its limit by racing itself.
+        self.quota = quota
 
     def _get_pod(self, args: ExtenderBindingArgs) -> Pod | None:
         """Lister-first pod fetch with UID-guarded apiserver fallback
@@ -70,6 +77,25 @@ class Bind:
         if info is None:
             return ExtenderBindingResult(error=f"unknown node {args.node}")
 
+        reserved = False
+        if (self.quota is not None
+                and (podutils.is_tpu_sharing_pod(pod)
+                     or podutils.is_tpu_chip_pod(pod))):
+            # Atomic check-and-reserve: a plain admit here and the
+            # charge inside the cache are separate lock acquisitions,
+            # so two same-tenant binds on concurrent HTTP threads could
+            # both pass the check and overshoot the limit together.
+            ok, reason = self.quota.admit_and_reserve(pod)
+            if not ok:
+                log.warning("bind refused for pod %s/%s: %s",
+                            args.pod_namespace, args.pod_name, reason)
+                events.record(self.client, pod,
+                              events.REASON_QUOTA_DENIED,
+                              f"node {args.node}: {reason}",
+                              event_type="Warning")
+                return ExtenderBindingResult(error=reason)
+            reserved = True
+
         try:
             if self.gang_planner is not None and podutils.is_gang_pod(pod):
                 self.gang_planner.bind_member(pod, args.node)
@@ -93,3 +119,12 @@ class Bind:
             events.record(self.client, pod, events.REASON_BIND_FAILED,
                           f"node {args.node}: {e}", event_type="Warning")
             return ExtenderBindingResult(error=str(e))
+        finally:
+            # Release the provisional charge UNLESS the ledger took
+            # ownership: a successful placement (and a reserved gang
+            # member — GangPending included) reaches the cache, whose
+            # charge replaced the reservation under the same uid. Runs
+            # in `finally` so even an unexpected exception (surfaced as
+            # HTTP 500) cannot leak a phantom charge.
+            if reserved and not self.cache.known_pod(pod.uid):
+                self.quota.uncharge(pod)

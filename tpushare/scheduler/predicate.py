@@ -22,11 +22,19 @@ from tpushare import trace
 from tpushare.api.extender import ExtenderArgs, ExtenderFilterResult
 from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
+from tpushare.quota.manager import QuotaManager
 from tpushare.utils import locks
 from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
+
+#: Seconds between quota-denial Events per tenant. The scheduler retries
+#: a denied pod every cycle; one Event per retry would melt the events
+#: pipeline for a tenant parked over its limit. One per window per
+#: tenant keeps `kubectl describe` informative without the flood (the
+#: tpushare_quota_denied_total counter carries the real rate).
+QUOTA_EVENT_INTERVAL_S = 30.0
 
 
 class DemandTracker:
@@ -58,8 +66,10 @@ class DemandTracker:
         #: Optional lister-style fetch ``(ns, name) -> Pod | None``.
         self.pod_lookup = pod_lookup
         self._lock = locks.TracingRLock("predicate/unschedulable")
-        #: uid -> (hbm GiB, chips, (ns, name), last-seen monotonic)
-        self._entries: dict[str, tuple[int, int, tuple, float]] = {}
+        #: uid -> (hbm GiB, chips, (ns, name), last-seen monotonic,
+        #: tenant) — the tenant rides along so the autoscaler signal can
+        #: say WHOSE demand is unplaceable (`by_tenant`).
+        self._entries: dict[str, tuple[int, int, tuple, float, str]] = {}
 
     def record_unplaceable(self, pod: Pod) -> None:
         hbm = podutils.get_hbm_from_pod_resource(pod)
@@ -67,7 +77,8 @@ class DemandTracker:
         with self._lock:
             self._entries[pod.uid] = (hbm, chips,
                                       (pod.namespace, pod.name),
-                                      time.monotonic())
+                                      time.monotonic(),
+                                      podutils.get_tenant(pod))
 
     def clear(self, uid: str) -> None:
         with self._lock:
@@ -100,7 +111,7 @@ class DemandTracker:
             entries = dict(self._entries)
         dead = {
             uid: seen
-            for uid, (_, _, ns_name, seen) in entries.items()
+            for uid, (_, _, ns_name, seen, _) in entries.items()
             if now - seen > self.ttl
             or (self.pod_lookup is not None
                 and not self._still_pending(uid, ns_name))
@@ -115,14 +126,73 @@ class DemandTracker:
             chips = sum(e[1] for e in self._entries.values())
         return pods, hbm, chips
 
+    def by_tenant(self) -> dict[str, tuple[int, int, int]]:
+        """tenant -> (pods, hbm GiB, chips) of the CURRENT entries —
+        whose demand the fleet cannot place. Call after :meth:`snapshot`
+        (which prunes); this is a pure read so the two views a scrape
+        renders always agree."""
+        out: dict[str, tuple[int, int, int]] = {}
+        with self._lock:
+            for hbm, chips, _, _, tenant in self._entries.values():
+                pods_n, hbm_n, chips_n = out.get(tenant, (0, 0, 0))
+                out[tenant] = (pods_n + 1, hbm_n + hbm, chips_n + chips)
+        return out
+
 
 class Predicate:
     name = "tpushare-filter"
 
     def __init__(self, cache: SchedulerCache,
-                 demand: DemandTracker | None = None) -> None:
+                 demand: DemandTracker | None = None,
+                 quota: QuotaManager | None = None,
+                 client: object | None = None) -> None:
+        """``quota`` arms the hard-limit gate (None = no tenancy, the
+        pre-quota behavior). ``client`` is only used to emit the
+        rate-limited quota-denial Events; without it denial is still
+        enforced, traced, and counted — just not kubectl-visible."""
         self.cache = cache
         self.demand = demand or DemandTracker()
+        self.quota = quota
+        self.client = client
+        self._quota_event_lock = locks.TracingRLock("predicate/quota-events")
+        #: tenant -> monotonic stamp of its last denial Event.
+        self._quota_event_at: dict[str, float] = {}
+
+    def _deny_quota(self, args: ExtenderArgs, pod: Pod,
+                    reason: str) -> ExtenderFilterResult:
+        """Reject on every candidate with the quota reason: counted per
+        tenant, traced (the flight recorder's WHY), and surfaced as a
+        rate-limited Event. Deliberately NOT recorded as unplaceable
+        demand — capacity exists, the tenant is over policy, and the
+        autoscaler must not add nodes for it."""
+        tenant = podutils.get_tenant(pod)
+        failed = {name: reason for name in args.candidate_names()}
+        # Same trace shape as a capacity rejection (`kubectl inspect
+        # tpushare explain` renders rejections per node), plus the
+        # tenant-level WHY.
+        trace.note("rejections", dict(failed))
+        trace.note("passed", [])
+        trace.note("quotaDenied", {"tenant": tenant, "reason": reason})
+        from tpushare.routes import metrics
+        metrics.safe_inc(metrics.QUOTA_DENIED.labels(tenant=tenant))
+        self.demand.clear(pod.uid)
+        if self.client is not None:
+            now = time.monotonic()
+            with self._quota_event_lock:
+                due = (now - self._quota_event_at.get(tenant, 0.0)
+                       >= QUOTA_EVENT_INTERVAL_S)
+                if due:
+                    self._quota_event_at[tenant] = now
+            if due:
+                from tpushare.k8s import events
+                events.record(self.client, pod, events.REASON_QUOTA_DENIED,
+                              reason, event_type="Warning")
+        log.debug("filter pod %s: quota-denied (%s)", pod.key(), reason)
+        return ExtenderFilterResult(
+            node_names=[] if args.node_names is not None else None,
+            nodes=[] if args.nodes is not None else None,
+            failed_nodes=failed,
+        )
 
     def filter_node(self, pod: Pod, node_name: str) -> tuple[bool, str]:
         """The per-node admission check (reference
@@ -148,6 +218,14 @@ class Predicate:
             return ExtenderFilterResult(
                 node_names=args.node_names, nodes=args.nodes, failed_nodes={}
             )
+
+        if self.quota is not None:
+            # Tenant hard limit FIRST: no point pricing per-node fits
+            # for a pod its tenant may not place anywhere.
+            with trace.span("quota"):
+                ok, reason = self.quota.admit(pod)
+            if not ok:
+                return self._deny_quota(args, pod, reason)
 
         passed_names: list[str] = []
         passed_nodes: list = []

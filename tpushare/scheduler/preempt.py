@@ -35,6 +35,15 @@ Victim-selection policy (TPU-first):
 * Victims the scheduler already nominated (for its own resources) are
   preferred at equal cost — those pods are being evicted anyway, so
   reusing them keeps the total blast radius minimal.
+* Tenancy (tpushare/quota): victims *borrowing over their tenant's
+  guarantee* form a preferred tier — among legal victims, borrowed pods
+  die before pods running inside their tenant's guaranteed share, so
+  elastic borrowing stays cheap to reclaim. And when the preemptor's
+  own tenant is asking within its guarantee, borrowed pods of OTHER
+  tenants are evictable even at EQUAL priority (fair-share reclaim, the
+  Themis shape): a guarantee would be worthless if a borrower at the
+  same priority class could squat on it. Both behaviors vanish when no
+  quota config exists — a quota-free fleet preempts exactly as before.
 * Gang victims are priced at their gang's FULL cluster footprint:
   evicting one member of a committed gang bricks the whole job while the
   surviving members squat on their chips, so the real cost of that
@@ -60,6 +69,7 @@ from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
 from tpushare.cache.chipinfo import ChipInfo
 from tpushare.cache.nodeinfo import NodeInfo, apply_nominated_demand
+from tpushare.quota.manager import QuotaManager
 from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
@@ -69,38 +79,97 @@ class Preempt:
     name = "tpushare-preempt"
 
     def __init__(self, cache: SchedulerCache,
-                 pdb_lister: Callable[[], list] | None = None) -> None:
+                 pdb_lister: Callable[[], list] | None = None,
+                 quota: QuotaManager | None = None) -> None:
         self.cache = cache
         #: Zero-arg callable returning the current PodDisruptionBudgets
         #: (wired to the informer's pdbs store). None = no PDB view:
         #: the handler then echoes the scheduler's violation counts
         #: (the pre-round-4 behavior) instead of recounting.
         self.pdb_lister = pdb_lister
+        #: Optional tenant ledger: arms the borrowed-victim tier and
+        #: equal-priority fair-share reclaim (module docstring).
+        self.quota = quota
+
+    def _borrowed(self, pod: Pod) -> bool:
+        return self.quota is not None and self.quota.is_borrowed(pod)
+
+    def _reclaim_ok(self, plan_pods: Iterable[Pod], preemptor: Pod,
+                    memo: dict | None = None) -> bool:
+        """Plan-level fair-share bound: per-victim ``is_borrowed`` is
+        static against the live ledger, so a plan evicting SEVERAL
+        equal-priority victims of one tenant could cut that tenant
+        below its guarantee (two 16-GiB pods over a 16-GiB guarantee
+        are each individually borrowed — but only 16 GiB is actually
+        on loan). Cap each tenant's equal-priority reclaim total at
+        its current beyond-guarantee excess; lower-priority victims
+        are ordinary preemption and consume no budget.
+
+        ``memo`` (one dict per plan_node request) caches each victim's
+        tenant/demand and each tenant's excess: the chip-combination
+        search evaluates thousands of candidate plans, and per-plan
+        quota-lock round-trips would contend with the filter/bind hot
+        path. The memo also pins ONE excess reading per request, so
+        every candidate plan is judged against the same ledger view."""
+        if self.quota is None:
+            return True
+        if memo is None:
+            memo = {}
+        victims: dict = memo.setdefault("victims", {})
+        excess: dict = memo.setdefault("excess", {})
+        taking: dict[str, list[int]] = {}
+        for pod in plan_pods:
+            if pod.priority != preemptor.priority:
+                continue
+            entry = victims.get(pod.uid)
+            if entry is None:
+                tenant = self.quota.tenant_of(pod)
+                entry = victims[pod.uid] = (
+                    tenant, self.quota.granted_demand(pod))
+                if tenant not in excess:
+                    excess[tenant] = self.quota.reclaimable_excess(tenant)
+            tenant, (hbm, chips) = entry
+            acc = taking.setdefault(tenant, [0, 0])
+            acc[0] += hbm
+            acc[1] += chips
+        return all(hbm <= excess[tenant][0] and chips <= excess[tenant][1]
+                   for tenant, (hbm, chips) in taking.items())
 
     # ------------------------------------------------------------------ #
     # Per-chip planning
     # ------------------------------------------------------------------ #
 
-    @staticmethod
-    def _evictable(pod: Pod, preemptor: Pod) -> bool:
+    def _evictable(self, pod: Pod, preemptor: Pod) -> bool:
         if podutils.is_complete_pod(pod):
             return False  # already free; never a victim
-        return pod.priority < preemptor.priority
+        if pod.priority < preemptor.priority:
+            return True
+        # Fair-share reclaim: an equal-priority victim is legal ONLY
+        # when it sits wholly in borrowed territory and the preemptor's
+        # tenant is asking within its guarantee (QuotaManager gates all
+        # three conditions; no quota config -> never).
+        return (self.quota is not None
+                and pod.priority == preemptor.priority
+                and self.quota.reclaim_eligible(preemptor, pod))
 
-    @staticmethod
-    def _victim_order(pod: Pod, contrib: int,
-                      preferred: set[str]) -> tuple[int, int, int, int]:
-        """Sort key: lowest priority first (same criteria order as
+    def _victim_order(self, pod: Pod, contrib: int,
+                      preferred: set[str]) -> tuple[int, int, int, int, int]:
+        """Sort key: BORROWED pods first (quota tier — usage beyond a
+        tenant's guarantee is the cheapest thing on the chip to take
+        back), then lowest priority (same criteria order as
         ``_plan_cost``); among equals prefer non-gang pods, then pods the
         scheduler already nominated, then the largest contribution
         (fewest victims to reach the target)."""
-        return (pod.priority,
+        return (0 if self._borrowed(pod) else 1,
+                pod.priority,
                 1 if podutils.is_gang_pod(pod) else 0,
                 0 if pod.uid in preferred else 1,
                 -contrib)
 
     def _plan_chip_hbm(self, chip: ChipInfo, need: int, preemptor: Pod,
-                       preferred: set[str]) -> list[tuple[Pod, int]] | None:
+                       preferred: set[str],
+                       reclaim_memo: dict | None = None,
+                       ) -> list[tuple[Pod, int]] | None:
         """Cheapest victim set on one chip that frees ≥ ``need`` GiB
         beyond what is already free; None when even evicting every legal
         victim falls short. ``need <= 0`` means the chip already fits."""
@@ -113,6 +182,9 @@ class Preempt:
         chosen: list[tuple[Pod, int]] = []
         freed = 0
         for pod, contrib in candidates:
+            if not self._reclaim_ok([p for p, _ in chosen] + [pod],
+                                    preemptor, reclaim_memo):
+                continue  # would overdraw its tenant's borrowed excess
             chosen.append((pod, contrib))
             freed += contrib
             if freed >= need:
@@ -172,13 +244,15 @@ class Preempt:
         search never rescans the cluster pod table."""
         if gang_memo is None:
             gang_memo = {}
+        reclaim_memo: dict = {}  # per-request victim/excess cache
         avail, earmarked, unmet = self._nominated_view(info, preemptor)
         if unmet:
             return None  # a nominee's grant is still materializing here
         req_chips = podutils.get_chips_from_pod_resource(preemptor)
         if req_chips > 0:
             return self._plan_node_chips(info, req_chips, preemptor,
-                                         preferred, gang_memo, earmarked)
+                                         preferred, gang_memo, earmarked,
+                                         reclaim_memo)
         req_hbm = podutils.get_hbm_from_pod_resource(preemptor)
         if req_hbm <= 0:
             return None  # not a TPU pod; caller handles pass-through
@@ -187,7 +261,7 @@ class Preempt:
             if chip.total_hbm < req_hbm:
                 continue  # can never fit, even empty
             plan = self._plan_chip_hbm(chip, req_hbm - avail.get(idx, 0),
-                                       preemptor, preferred)
+                                       preemptor, preferred, reclaim_memo)
             if plan is None:
                 continue
             if best is None or (
@@ -200,6 +274,7 @@ class Preempt:
                          preemptor: Pod, preferred: set[str],
                          gang_memo: dict,
                          earmarked: set[int] = frozenset(),
+                         reclaim_memo: dict | None = None,
                          ) -> list[Pod] | None:
         """The N-chip set whose *distinct-victim union* is cheapest.
 
@@ -239,12 +314,25 @@ class Preempt:
         # comb(16,8)=12870: exact search covers every real host form
         # factor (up to 16 chips); the greedy is the >16-chip fallback
         # (exercised by tests/test_preempt.py's synthetic 32-chip host).
+        # Either way a candidate plan must pass the fair-share reclaim
+        # bound (_reclaim_ok) — a chip set whose union over-drains one
+        # tenant's borrowed excess is not a legal plan at all.
         if math.comb(len(clearable), req_chips) <= 13000:
-            best = min(
-                (union_plan(combo) for combo in
-                 itertools.combinations(sorted(clearable), req_chips)),
-                key=lambda pl: self._plan_cost(pl, preferred, info,
-                                               gang_memo))
+            try:
+                # Lazy: min() streams the combination space; the memoed
+                # reclaim bound filters inline without materializing
+                # thousands of candidate plans.
+                best = min(
+                    (pl for pl in
+                     (union_plan(combo) for combo in
+                      itertools.combinations(sorted(clearable),
+                                             req_chips))
+                     if self._reclaim_ok([p for p, _ in pl], preemptor,
+                                         reclaim_memo)),
+                    key=lambda pl: self._plan_cost(pl, preferred, info,
+                                                   gang_memo))
+            except ValueError:  # every combination over-reclaims
+                return None
         else:
             chosen: list[int] = []
             while len(chosen) < req_chips:
@@ -259,8 +347,15 @@ class Preempt:
                     (p.namespace, podutils.get_pod_group(p)[0])
                     for p, _ in held_pods
                     if podutils.get_pod_group(p)[0])
+                options = [
+                    i for i in sorted(clearable) if i not in chosen
+                    and self._reclaim_ok(
+                        [p for p, _ in union_plan(chosen + [i])],
+                        preemptor, reclaim_memo)]
+                if not options:
+                    return None
                 nxt = min(
-                    (i for i in sorted(clearable) if i not in chosen),
+                    options,
                     key=lambda i: self._plan_cost(
                         [(p, c) for p, c in clearable[i]
                          if p.uid not in held], preferred, info,
@@ -311,13 +406,17 @@ class Preempt:
     def _plan_cost(self, plan: list[tuple[Pod, int]], preferred: set[str],
                    info: NodeInfo | None, gang_memo: dict,
                    doomed: frozenset = frozenset(),
-                   ) -> tuple[int, int, int, int, int]:
+                   ) -> tuple[int, int, int, int, int, int]:
         """Compare eviction plans across chips. Criteria order follows
         upstream k8s preemption (``pickOneNodeForPreemption``): the
         highest victim priority is minimized FIRST — disruption lands on
         the lowest-priority workloads even when that means more victims
         (two priority-0 slices die before one priority-5 trainer). Then
-        fewest GANG MEMBERS STRANDED — a gang victim drags its whole
+        fewest NON-BORROWED victims (quota tier: at equal priority a
+        plan draining beyond-guarantee borrowing beats one that cuts
+        into a tenant's guaranteed share; zero everywhere when no quota
+        config exists). Then fewest GANG MEMBERS STRANDED — a gang
+        victim drags its whole
         group down, so it counts every cluster-wide member while a lone
         pod counts 0: a lone pod always beats a same-priority gang member
         at any size, and a 4-member gang beats a 16-member one. Then
@@ -341,6 +440,7 @@ class Preempt:
             else:
                 hbm += self._pod_footprint(p, info) or c
         return (max((p.priority for p, _ in plan), default=-1),
+                sum(1 for p, _ in plan if not self._borrowed(p)),
                 stranded,
                 sum(1 for p, _ in plan if p.uid not in preferred),
                 len(plan),
@@ -439,6 +539,19 @@ class Preempt:
                 result.node_victims[name] = victims.victim_uids()
                 result.pdb_violations[name] = victims.num_pdb_violations
             return result
+
+        if self.quota is not None:
+            # Tenant hard limit mirrors the filter: the scheduler's
+            # PostFilter falls back to preemption after OUR quota
+            # denial, and authoring a victim plan here would evict
+            # innocents for a preemptor the filter must deny again the
+            # moment they are gone (capacity exists; the tenant is over
+            # policy). Empty map = no node can be helped by eviction.
+            ok, reason = self.quota.admit(pod)
+            if not ok:
+                trace.note("quotaDenied", reason)
+                log.debug("preempt pod %s refused: %s", pod.key(), reason)
+                return result
 
         gang_memo: dict = {}  # per-request (ns, group) pricing cache
         for name, victims in args.node_victims.items():

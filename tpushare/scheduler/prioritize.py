@@ -54,7 +54,8 @@ class Prioritize:
     name = "tpushare-prioritize"
 
     def __init__(self, cache: SchedulerCache, gang_planner: Any = None,
-                 policy: str = "binpack") -> None:
+                 policy: str = "binpack",
+                 quota: Any = None) -> None:
         """``policy``: ``"binpack"`` (default — tightest fit, maximizes
         whole-free chips for future multi-chip pods; the policy the
         whole bench story is built on) or ``"spread"`` (inverted fit —
@@ -70,6 +71,14 @@ class Prioritize:
         self.cache = cache
         self.gang_planner = gang_planner
         self.policy = policy
+        #: Optional QuotaManager: biases this extender's contribution to
+        #: the scheduler's combined score by the pod's TENANT standing —
+        #: +1 on feasible nodes while the tenant asks within its
+        #: guarantee (least-served tenant wins ties), -1 once it is
+        #: borrowing beyond it. Cross-POD ordering belongs to the
+        #: kube-scheduler; fleet fairness rides the magnitude of every
+        #: node's score, which is the extender's only lever.
+        self.quota = quota
 
     def _policy_for(self, pod: Pod) -> str:
         """Effective policy: the pod's ``tpushare.io/scoring`` annotation
@@ -258,6 +267,18 @@ class Prioritize:
                    n, req_chips, req_hbm, gang_nodes, member_slices,
                    policy=policy))
                for n in names]
+        if self.quota is not None:
+            adjust = self.quota.score_adjust(pod)
+            if adjust:
+                # Only FEASIBLE nodes move: a zero score means "cannot
+                # host", and fairness must never promote an infeasible
+                # node (or bury a feasible one to look like it).
+                out = [HostPriority(host=e.host,
+                                    score=min(max(e.score + adjust, 1),
+                                              MAX_SCORE))
+                       if e.score > 0 else e
+                       for e in out]
+                trace.note("quotaFairShare", adjust)
         trace.note("scores", {e.host: e.score for e in out})
         trace.note("policy", policy)
         log.debug("prioritize pod %s: %s", pod.key(),

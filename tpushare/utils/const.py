@@ -105,6 +105,27 @@ GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 GKE_NODEPOOL_LABEL = "cloud.google.com/gke-nodepool"
 
 # --------------------------------------------------------------------------
+# Multi-tenant quota (tpushare/quota/): guaranteed shares, elastic
+# borrowing of idle capacity, and fair-share reclaim.
+# --------------------------------------------------------------------------
+
+#: Pod label overriding the pod's tenant for quota accounting. Default
+#: tenant is the pod's NAMESPACE; this label lets several namespaces
+#: share one budget (or one namespace split across budgets).
+LABEL_TENANT = "tpushare.io/tenant"
+
+#: Name of the ConfigMap holding per-tenant quota specs (watched through
+#: the informer; any namespace — conventionally kube-system). Each data
+#: key is a tenant name (or QUOTA_DEFAULT_KEY for the default applied to
+#: tenants without an entry); each value is a JSON object with optional
+#: ``guaranteeHBM`` / ``limitHBM`` (GiB) and ``guaranteeChips`` /
+#: ``limitChips`` fields. See docs/quota.md.
+QUOTA_CONFIGMAP = "tpushare-quotas"
+
+#: ConfigMap data key whose spec applies to tenants without their own.
+QUOTA_DEFAULT_KEY = "*"
+
+# --------------------------------------------------------------------------
 # Gang scheduling (pod groups spanning a multi-host slice).
 # --------------------------------------------------------------------------
 

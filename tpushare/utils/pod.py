@@ -60,6 +60,15 @@ def is_gang_pod(pod: Pod) -> bool:
     return const.ANN_POD_GROUP in pod.annotations
 
 
+def get_tenant(pod: Pod) -> str:
+    """The tenant a pod's TPU usage is charged to: the
+    ``tpushare.io/tenant`` label when set, else the namespace. ONE
+    definition shared by the quota ledger, the filter's denial path,
+    and the demand tracker — the three must never disagree on whose
+    budget a pod hits."""
+    return pod.labels.get(const.LABEL_TENANT) or pod.namespace
+
+
 # --------------------------------------------------------------------------
 # Resource readers (reference pod.go:145-155)
 # --------------------------------------------------------------------------
