@@ -49,6 +49,18 @@ def race_detector():
         locks.reset_race_detector()
 
 
+@pytest.fixture(autouse=True)
+def _fresh_slo():
+    """Journeys and SLO windows live in module singletons (like the
+    flight recorder); clearing them after every test keeps reused test
+    uids ('u1', 'uid-1' …) from one test's closed-journey dedupe set
+    leaking into the next test's journey opens."""
+    yield
+    from tpushare import slo
+
+    slo.reset()
+
+
 @pytest.fixture
 def api():
     return FakeApiServer()

@@ -392,6 +392,12 @@ def _make_handler(server: MiniApiServer):
                 want = unquote(selector.split("=", 1)[1])
                 items = [i for i in items
                          if i.get("spec", {}).get("nodeName") == want]
+            elif selector.startswith("metadata.name="):
+                # The real apiserver filters server-side; the client's
+                # per-ConfigMap name-scoped streams rely on it.
+                want = unquote(selector.split("=", 1)[1])
+                items = [i for i in items
+                         if i.get("metadata", {}).get("name") == want]
             meta = {"resourceVersion": rv}
             if server.page_size > 0 and kind == "Pod":
                 start = 0

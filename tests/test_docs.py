@@ -15,6 +15,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 METRICS_PY = os.path.join(REPO_ROOT, "tpushare", "routes", "metrics.py")
 OBSERVABILITY_MD = os.path.join(REPO_ROOT, "docs", "observability.md")
 QUOTA_MD = os.path.join(REPO_ROOT, "docs", "quota.md")
+SLO_MD = os.path.join(REPO_ROOT, "docs", "slo.md")
 
 _METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
 
@@ -98,6 +99,42 @@ def test_quota_doc_is_linked():
             assert "quota.md" in f.read(), rel
 
 
+def test_slo_doc_covers_the_contract():
+    """docs/slo.md is the alerting contract: it must keep naming the
+    ConfigMap (name + every spec field), both signals, the journey
+    outcomes, the endpoints/CLI, the alert Event with its runbook, and
+    every SLO/journey metric the code registers."""
+    with open(SLO_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("tpushare-slos", "TPUSHARE_SLO_NAMESPACE",
+                   "pod_e2e", "filter_latency", "objective",
+                   "thresholdSeconds", "fastBurn", "signal",
+                   "/debug/slo", "/debug/journey/",
+                   "kubectl inspect tpushare slo", "TPUShareSLOBurn",
+                   "Runbook", "burn", "error budget",
+                   "creationTimestamp", "assume-time",
+                   "bound", "deleted", "abandoned",
+                   "queue wait", "trace-id"):
+        assert needle in doc, needle
+    slo_metrics = [n for n in registered_metric_names()
+                   if n.startswith("tpushare_slo_")
+                   or n.startswith("tpushare_pod_")]
+    assert len(slo_metrics) >= 4
+    missing = [n for n in slo_metrics if n not in doc]
+    assert not missing, (
+        f"SLO/journey metrics absent from docs/slo.md: {missing}")
+
+
+def test_slo_doc_is_linked():
+    """observability.md (the catalogue), the README, and the user
+    guide must keep pointing at the SLO contract."""
+    for path in (OBSERVABILITY_MD,
+                 os.path.join(REPO_ROOT, "README.md"),
+                 os.path.join(REPO_ROOT, "docs", "userguide.md")):
+        with open(path, encoding="utf-8") as f:
+            assert "slo.md" in f.read(), path
+
+
 if __name__ == "__main__":
     # CI's lint job runs this file as a plain script (no pytest, no
     # project install — tests/conftest.py would drag jax in); the same
@@ -109,7 +146,9 @@ if __name__ == "__main__":
                   test_every_registered_metric_is_documented,
                   test_observability_doc_covers_the_surfaces,
                   test_quota_doc_covers_the_contract,
-                  test_quota_doc_is_linked):
+                  test_quota_doc_is_linked,
+                  test_slo_doc_covers_the_contract,
+                  test_slo_doc_is_linked):
         try:
             check()
         except AssertionError as e:

@@ -1,0 +1,756 @@
+"""Pod-journey SLOs: the ISSUE-4 acceptance contract.
+
+Covers: journey lifecycle (open on informer/filter, close on
+bind/delete/abandonment, queue-wait vs in-verb split), restart
+semantics over annotation truth (bound pods reconstruct into the same
+e2e bucket; mid-journey deletions land in outcome="deleted"), the SLO
+engine's window/burn/budget math with an injected clock, the
+rate-limited TPUShareSLOBurn Event, and the full e2e story: one
+tenant's pods retry under quota pressure — verb histograms stay flat,
+the e2e histogram degrades, the 5m burn gauge trips, exactly one Event
+fires, and every attempt's trace-id in /debug/journey resolves via
+/debug/trace?id=.
+"""
+
+import bisect
+import datetime
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tpushare import slo, trace
+from tpushare.api.objects import ConfigMap, Pod
+from tpushare.k8s import events
+from tpushare.slo import config as slo_config
+from tpushare.slo.engine import BURN_EVENT_INTERVAL_S, SLOEngine
+from tpushare.slo.journey import JourneyTracker, parse_k8s_time
+from tpushare.utils import const
+
+
+@pytest.fixture(autouse=True)
+def fresh_slo_and_trace():
+    slo.reset()
+    trace.reset()
+    yield
+    slo.reset()
+    trace.reset()
+
+
+def _stamp(seconds_ago: float) -> str:
+    return (datetime.datetime.now(datetime.timezone.utc)
+            - datetime.timedelta(seconds=seconds_ago)
+            ).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _aged_pod_doc(name, seconds_ago, **kw):
+    doc = make_pod(name, **kw)
+    doc["metadata"]["creationTimestamp"] = _stamp(seconds_ago)
+    return doc
+
+
+def _e2e_bucket(seconds: float) -> int:
+    """Index of the histogram bucket ``seconds`` lands in — 'same
+    bucket' is the restart-semantics acceptance criterion."""
+    from tpushare.routes.metrics import _E2E_BUCKETS
+    return bisect.bisect_left(list(_E2E_BUCKETS), seconds)
+
+
+# ------------------------------------------------------------------------ #
+# Config parsing
+# ------------------------------------------------------------------------ #
+
+
+def _cm(data: dict) -> ConfigMap:
+    return ConfigMap({"metadata": {"name": const.SLO_CONFIGMAP,
+                                   "namespace": "kube-system"},
+                      "data": {k: json.dumps(v) if not isinstance(v, str)
+                               else v for k, v in data.items()}})
+
+
+class TestConfig:
+    def test_absent_configmap_means_defaults(self):
+        cfg = slo_config.parse_configmap(None)
+        assert cfg is slo_config.DEFAULTS
+        assert set(cfg.slos) == {"pod-bind-30s", "filter-p99-5ms"}
+        spec = cfg.slos["pod-bind-30s"]
+        assert spec.signal == "pod_e2e"
+        assert spec.objective == 0.99
+        assert spec.threshold_seconds == 30.0
+
+    def test_valid_entries_replace_defaults_wholesale(self):
+        cfg = slo_config.parse_configmap(_cm({
+            "bind-5s": {"signal": "pod_e2e", "objective": 0.95,
+                        "thresholdSeconds": 5, "fastBurn": 2},
+        }))
+        assert set(cfg.slos) == {"bind-5s"}
+        assert cfg.slos["bind-5s"].fast_burn == 2.0
+
+    @pytest.mark.parametrize("raw", [
+        "not json",
+        '{"signal": "nope", "thresholdSeconds": 1}',
+        '{"signal": "pod_e2e", "thresholdSeconds": 0}',
+        '{"signal": "pod_e2e", "objective": 1.5, "thresholdSeconds": 1}',
+        '{"signal": "pod_e2e", "thresholdSeconds": 1, "typo": 3}',
+        '{"signal": "pod_e2e", "thresholdSeconds": "soon"}',
+    ])
+    def test_malformed_entry_skipped(self, raw):
+        cfg = slo_config.parse_configmap(_cm({
+            "bad": raw,
+            "good": {"signal": "pod_e2e", "thresholdSeconds": 5},
+        }))
+        assert set(cfg.slos) == {"good"}
+
+    def test_all_malformed_falls_back_to_defaults(self):
+        cfg = slo_config.parse_configmap(_cm({"bad": "not json"}))
+        assert cfg is slo_config.DEFAULTS
+
+    def test_parse_k8s_time(self):
+        assert parse_k8s_time("") == 0.0
+        assert parse_k8s_time("yesterday-ish") == 0.0
+        stamp = parse_k8s_time("2026-08-04T00:00:00Z")
+        assert stamp > 0
+        assert parse_k8s_time("2026-08-04T00:00:01.500000Z") == \
+            pytest.approx(stamp + 1.5)
+
+
+# ------------------------------------------------------------------------ #
+# Journey tracker unit behavior
+# ------------------------------------------------------------------------ #
+
+
+class TestJourneyTracker:
+    def _decision(self, name="p", uid="u1", outcome=None):
+        with trace.phase("filter", "default", name, uid) as dec:
+            pass
+        if outcome:
+            trace.complete(dec, outcome)
+        return dec
+
+    def test_open_link_close_bound_with_queue_wait_split(self):
+        tracker = JourneyTracker()
+        pod = Pod(_aged_pod_doc("p", 10, hbm=8, uid="u1"))
+        tracker.open_journey(pod)
+        dec = self._decision()
+        tracker.note_decision("default", "p", "u1", dec)
+        trace.complete(dec, "bound", node="n1")
+        tracker.pod_bound_key("default", "p")
+        doc = tracker.get_journey("default", "p")
+        assert doc["outcome"] == "bound"
+        assert doc["source"] == "informer"
+        assert doc["attemptsTotal"] == 1
+        assert doc["attempts"][0]["traceId"] == dec.trace_id
+        # the clock started at creationTimestamp, ~10s ago
+        assert 9.0 <= doc["e2eSeconds"] <= 12.0
+        assert doc["queueWaitSeconds"] == pytest.approx(
+            doc["e2eSeconds"] - doc["inVerbSeconds"], abs=1e-6)
+
+    def test_one_decision_spanning_verbs_is_one_attempt(self):
+        tracker = JourneyTracker()
+        with trace.phase("filter", "default", "p", "u1") as dec:
+            pass
+        tracker.note_decision("default", "p", "u1", dec)
+        with trace.phase("bind", "default", "p", "u1") as dec2:
+            pass
+        assert dec2 is dec
+        tracker.note_decision("default", "p", "u1", dec2)
+        doc = tracker.get_journey("default", "p")
+        assert doc["attemptsTotal"] == 1
+
+    def test_first_filter_opens_when_informer_has_not(self):
+        tracker = JourneyTracker()
+        dec = self._decision()
+        tracker.note_decision("default", "p", "u1", dec,
+                              pod=Pod(_aged_pod_doc("p", 30, hbm=8,
+                                                    uid="u1")))
+        doc = tracker.get_journey("default", "p")
+        assert doc["source"] == "filter"
+        assert doc["outcome"] == "open"
+        assert doc["e2eSeconds"] >= 29.0
+
+    def test_bind_never_opens_a_journey(self):
+        tracker = JourneyTracker()
+        dec = self._decision(outcome="bound")
+        tracker.note_decision("default", "p", "u1", dec, open_new=False)
+        assert tracker.get_journey("default", "p") is None
+
+    def test_bind_uid_mismatch_supersedes_without_opening(self):
+        """open_new=False holds even when the open journey belongs to
+        a PREVIOUS pod instance: the stale story is retired, but the
+        bind verb must not stamp a ~0s journey for the new uid (review
+        finding) — reconstruction/informer own that pod's clock."""
+        tracker = JourneyTracker()
+        tracker.open_journey(Pod(make_pod("p", hbm=8, uid="u-old")))
+        dec = self._decision(uid="u-new", outcome="bound")
+        tracker.note_decision("default", "p", "u-new", dec,
+                              open_new=False)
+        doc = tracker.get_journey("default", "p")
+        assert doc["outcome"] == "superseded" and doc["uid"] == "u-old"
+        # bookkeeping only: no bound/deleted/abandoned was measured
+        assert tracker.stats()["closed"] == {"superseded": 1}
+
+    def test_deleted_mid_journey(self):
+        tracker = JourneyTracker()
+        pod = Pod(make_pod("p", hbm=8, uid="u1"))
+        tracker.open_journey(pod)
+        tracker.pod_deleted(pod)
+        doc = tracker.get_journey("default", "p")
+        assert doc["outcome"] == "deleted"
+        # bound after close is a no-op (sync echo of the deletion race)
+        tracker.pod_bound(pod)
+        assert tracker.get_journey("default", "p")["outcome"] == "deleted"
+
+    def test_open_table_bounded_evicts_as_abandoned(self):
+        tracker = JourneyTracker(max_open=4)
+        for i in range(6):
+            tracker.open_journey(Pod(make_pod(f"p{i}", hbm=8,
+                                              uid=f"u{i}")))
+        stats = tracker.stats()
+        assert stats["open"] == 4
+        assert stats["closed"].get("abandoned") == 2
+
+    def test_recreated_pod_supersedes(self):
+        tracker = JourneyTracker()
+        tracker.open_journey(Pod(make_pod("p", hbm=8, uid="u-old")))
+        tracker.open_journey(Pod(make_pod("p", hbm=8, uid="u-new")))
+        doc = tracker.get_journey("default", "p")
+        assert doc["uid"] == "u-new" and doc["outcome"] == "open"
+        # superseded journeys are bookkeeping, not measured outcomes
+        with tracker._lock:
+            ring_outcomes = [j.outcome for j in tracker._ring]
+        assert ring_outcomes == ["superseded"]
+
+    def test_reconstruct_from_annotations(self):
+        tracker = JourneyTracker()
+        created = _stamp(100)
+        assume_ns = int((time.time() - 25) * 1e9)
+        doc = make_pod("done", hbm=8, uid="u-done", node_name="n1",
+                       phase="Running", annotations={
+                           const.ANN_CHIP_IDX: "0",
+                           const.ANN_HBM_POD: "8",
+                           const.ANN_HBM_CHIP: "16",
+                           const.ANN_ASSIGNED: const.ASSIGNED_TRUE,
+                           const.ANN_ASSUME_TIME: str(assume_ns)})
+        doc["metadata"]["creationTimestamp"] = created
+        tracker.reconstruct(Pod(doc))
+        j = tracker.get_journey("default", "done")
+        assert j["reconstructed"] is True
+        assert j["outcome"] == "bound"
+        assert j["e2eSeconds"] == pytest.approx(75, abs=2)
+        # idempotent: a second reconstruct (sync echo) adds nothing
+        tracker.reconstruct(Pod(doc))
+        assert tracker.stats()["closed"] == {"bound": 1}
+
+    def test_reconstructed_journeys_skip_the_burn_windows(self):
+        """Reconstruction refills the HISTOGRAM a restart wiped, but
+        must not replay yesterday's outcomes into the rolling windows
+        stamped 'now' — that would fire (or mask) a burn alert about
+        the past."""
+        closed = []
+        tracker = JourneyTracker(on_close=closed.append)
+        doc = make_pod("old", hbm=8, uid="u-old", node_name="n1",
+                       annotations={
+                           const.ANN_CHIP_IDX: "0",
+                           const.ANN_HBM_POD: "8",
+                           const.ANN_HBM_CHIP: "16",
+                           const.ANN_ASSIGNED: const.ASSIGNED_TRUE,
+                           const.ANN_ASSUME_TIME: str(
+                               int((time.time() - 10) * 1e9))})
+        doc["metadata"]["creationTimestamp"] = _stamp(100)
+        tracker.reconstruct(Pod(doc))
+        assert tracker.get_journey("default", "old")["outcome"] == "bound"
+        assert closed == []  # histogram only, no engine intake
+        # a LIVE close still feeds the engine
+        live = Pod(make_pod("fresh", hbm=8, uid="u-fresh"))
+        tracker.open_journey(live)
+        tracker.pod_bound(live)
+        assert [j.name for j in closed] == ["fresh"]
+
+    def test_tracker_methods_never_throw_into_handlers(self):
+        """The informer handlers call open_journey/pod_deleted inline
+        before enqueueing sync work; journey trouble must become a
+        counted drop, not a swallowed handler exception that skips the
+        enqueue."""
+        tracker = JourneyTracker()
+
+        def boom():
+            raise RuntimeError("clock broke")
+
+        tracker._now = boom
+        tracker.open_journey(Pod(make_pod("p", hbm=8, uid="u1")))
+        tracker.pod_deleted(Pod(make_pod("p", hbm=8, uid="u1")))
+        tracker.pod_bound(Pod(make_pod("p", hbm=8, uid="u1")))
+        assert tracker.drops.value >= 1
+
+    def test_reconstruct_without_annotation_truth_is_silent(self):
+        tracker = JourneyTracker()
+        tracker.reconstruct(Pod(make_pod("x", hbm=8, uid="ux",
+                                         node_name="n1")))
+        assert tracker.get_journey("default", "x") is None
+
+
+# ------------------------------------------------------------------------ #
+# SLO engine math (injected clock)
+# ------------------------------------------------------------------------ #
+
+
+def _engine(now, slos=None):
+    cfg = slo_config.SLOConfig(slos={s.name: s for s in (slos or [
+        slo_config.SLOSpec(name="bind-1s", signal="pod_e2e",
+                           objective=0.9, threshold_seconds=1.0,
+                           fast_burn=2.0)])})
+    eng = SLOEngine(config=cfg, now_fn=lambda: now[0])
+    return eng
+
+
+class TestEngine:
+    def test_burn_and_budget_math(self):
+        now = [10_000.0]
+        eng = _engine(now)
+        # 8 good, 2 bad in the 5m window: error rate 0.2, allowed 0.1
+        for _ in range(8):
+            eng.observe_pod_e2e(0.5, "bound", "ns", "p", "u")
+        for _ in range(2):
+            eng.observe_pod_e2e(5.0, "bound", "ns", "p", "u")
+        row = {r["slo"]: r for r in eng.evaluate()}["bind-1s"]
+        assert row["windows"]["5m"] == {"bad": 2, "total": 10,
+                                        "burnRate": 2.0}
+        assert row["windows"]["1h"]["burnRate"] == 2.0
+        # budget over 1h: consumed = 2 / (10 * 0.1) = 2.0 -> clamped 0
+        assert row["errorBudgetRemaining"] == 0.0
+        assert row["burning"] is True
+
+    def test_windows_roll(self):
+        now = [10_000.0]
+        eng = _engine(now)
+        eng.observe_pod_e2e(5.0, "bound", "ns", "p", "u")  # bad
+        now[0] += 400  # out of the 5m window, inside 1h
+        eng.observe_pod_e2e(0.5, "bound", "ns", "p", "u")  # good
+        row = eng.evaluate()[0]
+        assert row["windows"]["5m"] == {"bad": 0, "total": 1,
+                                        "burnRate": 0.0}
+        assert row["windows"]["1h"]["bad"] == 1
+        assert row["burning"] is False  # 5m quiet: blip, not a page
+        now[0] += 3601  # everything ages past the 1h horizon
+        row = eng.evaluate()[0]
+        assert row["windows"]["1h"] == {"bad": 0, "total": 0,
+                                        "burnRate": 0.0}
+        assert row["errorBudgetRemaining"] == 1.0
+
+    def test_deleted_counts_bad_only_past_threshold(self):
+        now = [10_000.0]
+        eng = _engine(now)
+        eng.observe_pod_e2e(0.2, "deleted", "ns", "p", "u")  # withdrawn early
+        eng.observe_pod_e2e(9.0, "deleted", "ns", "p", "u")  # outlived SLO
+        row = eng.evaluate()[0]
+        assert row["windows"]["5m"] == {"bad": 1, "total": 1,
+                                        "burnRate": 10.0}
+
+    def test_filter_latency_signal(self):
+        now = [10_000.0]
+        eng = _engine(now, slos=[slo_config.SLOSpec(
+            name="f", signal="filter_latency", objective=0.5,
+            threshold_seconds=0.01)])
+        eng.observe_filter(0.001)
+        eng.observe_filter(0.5)
+        row = eng.evaluate()[0]
+        assert row["windows"]["5m"] == {"bad": 1, "total": 2,
+                                        "burnRate": 1.0}
+
+    def test_burn_event_rate_limited(self, api):
+        now = [10_000.0]
+        eng = _engine(now)
+        eng.set_client(api)
+        for _ in range(3):
+            eng.observe_pod_e2e(9.0, "bound", "team-x", "victim", "u9")
+        eng.evaluate()
+        eng.evaluate()  # still inside the rate-limit window
+        assert events.flush()
+        burns = [e for _ns, e in api.events
+                 if e["reason"] == "TPUShareSLOBurn"]
+        assert len(burns) == 1
+        assert burns[0]["involvedObject"]["name"] == "victim"
+        assert "bind-1s" in burns[0]["message"]
+        # past the cooldown the still-burning SLO pages again
+        now[0] += BURN_EVENT_INTERVAL_S + 60
+        eng.observe_pod_e2e(9.0, "bound", "team-x", "victim", "u9")
+        eng.evaluate()
+        assert events.flush()
+        burns = [e for _ns, e in api.events
+                 if e["reason"] == "TPUShareSLOBurn"]
+        assert len(burns) == 2
+
+    def test_reset_disarms_the_client(self, api):
+        now = [10_000.0]
+        eng = _engine(now)
+        eng.set_client(api)
+        eng.reset()
+        with eng._lock:
+            assert eng._client is None
+
+
+# ------------------------------------------------------------------------ #
+# Restart semantics over the real wire (miniapiserver round-trip)
+# ------------------------------------------------------------------------ #
+
+
+class TestRestartSemantics:
+    def test_rebuild_reconstructs_bound_and_deletes_land_deleted(self):
+        from tests.miniapiserver import MiniApiServer
+        from tpushare.controller.controller import Controller
+        from tpushare.k8s.client import ApiClient, ClusterConfig
+
+        server = MiniApiServer().start()
+        try:
+            server.seed_node(make_node("v5e-0"))
+            bound = make_pod("done", hbm=8, uid="u-done",
+                             node_name="v5e-0", phase="Running",
+                             annotations={
+                                 const.ANN_CHIP_IDX: "0",
+                                 const.ANN_HBM_POD: "8",
+                                 const.ANN_HBM_CHIP: "16",
+                                 const.ANN_ASSIGNED: const.ASSIGNED_TRUE,
+                                 const.ANN_ASSUME_TIME: str(
+                                     int((time.time() - 25) * 1e9))})
+            bound["metadata"]["creationTimestamp"] = _stamp(100)
+            server.seed_pod(bound)
+            pending = make_pod("waiting", hbm=8, uid="u-wait")
+            pending["metadata"]["creationTimestamp"] = _stamp(50)
+            server.seed_pod(pending)
+
+            client = ApiClient(ClusterConfig(
+                host=f"http://127.0.0.1:{server.port}"))
+            controller = Controller(client)
+            controller.start(workers=1)
+            try:
+                # the bound pod reconstructed from annotation truth ...
+                j = slo.get_journey("default", "done")
+                assert j is not None and j["reconstructed"] is True
+                assert j["outcome"] == "bound"
+                # ... reports the same e2e latency bucket a crash never
+                # happened to: assume-time - creationTimestamp = 75s.
+                assert _e2e_bucket(j["e2eSeconds"]) == _e2e_bucket(75.0)
+                # the pending pod re-opened on its original clock
+                open_j = slo.get_journey("default", "waiting")
+                assert open_j["outcome"] == "open"
+                assert open_j["e2eSeconds"] >= 49.0
+
+                # a mid-journey deletion arrives over the real WATCH
+                server.delete_pod_server_side("default", "waiting")
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    j = slo.get_journey("default", "waiting")
+                    if j and j["outcome"] != "open":
+                        break
+                    time.sleep(0.02)
+                assert j["outcome"] == "deleted", j
+            finally:
+                controller.stop()
+        finally:
+            server.close()
+
+    def test_slo_configmap_round_trip(self):
+        from tests.miniapiserver import MiniApiServer
+        from tpushare.controller.controller import Controller
+        from tpushare.k8s.client import ApiClient, ClusterConfig
+
+        server = MiniApiServer().start()
+        try:
+            server.seed_node(make_node("v5e-0"))
+            server.seed_configmap({
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": const.SLO_CONFIGMAP,
+                             "namespace": "kube-system"},
+                "data": {"bind-5s": json.dumps(
+                    {"signal": "pod_e2e", "thresholdSeconds": 5})}})
+            client = ApiClient(ClusterConfig(
+                host=f"http://127.0.0.1:{server.port}"))
+            controller = Controller(client)
+            controller.start(workers=1)
+            try:
+                assert set(slo.engine().config().slos) == {"bind-5s"}
+                # a server-side rewrite reaches the engine via WATCH
+                server.update_configmap_server_side({
+                    "apiVersion": "v1", "kind": "ConfigMap",
+                    "metadata": {"name": const.SLO_CONFIGMAP,
+                                 "namespace": "kube-system"},
+                    "data": {"bind-9s": json.dumps(
+                        {"signal": "pod_e2e", "thresholdSeconds": 9})}})
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    if set(slo.engine().config().slos) == {"bind-9s"}:
+                        break
+                    time.sleep(0.02)
+                assert set(slo.engine().config().slos) == {"bind-9s"}
+            finally:
+                controller.stop()
+        finally:
+            server.close()
+
+    def test_foreign_namespace_slo_configmap_ignored(self, api):
+        from tpushare.controller.controller import Controller
+
+        api.create_node(make_node("v5e-0"))
+        api.create_configmap({
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": const.SLO_CONFIGMAP,
+                         "namespace": "mallory"},
+            "data": {"bind-1ms": json.dumps(
+                {"signal": "pod_e2e", "thresholdSeconds": 0.001})}})
+        controller = Controller(api)
+        controller.start(workers=1)
+        try:
+            assert slo.engine().config() is slo_config.DEFAULTS
+        finally:
+            controller.stop()
+
+
+# ------------------------------------------------------------------------ #
+# The acceptance story: quota pressure burns the pod-e2e budget
+# ------------------------------------------------------------------------ #
+
+
+def _hist_counts(metrics_text: str, name: str) -> dict[str, float]:
+    """bucket le -> cumulative count, labels collapsed."""
+    out: dict[str, float] = {}
+    for line in metrics_text.splitlines():
+        if line.startswith(name + "_bucket"):
+            le = line.split('le="')[1].split('"')[0]
+            out[le] = out.get(le, 0.0) + float(line.rsplit(" ", 1)[1])
+    return out
+
+
+def _gauge(metrics_text: str, prefix: str) -> float:
+    for line in metrics_text.splitlines():
+        if line.startswith(prefix):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"no gauge line starts with {prefix!r}")
+
+
+class TestAcceptanceQuotaPressure:
+    def test_retries_under_quota_flat_verbs_degraded_e2e_burn(self, api):
+        from tests.test_quota import Cluster, quota_cm_doc
+
+        api.create_node(make_node("v5e-0"))
+        api.create_configmap(quota_cm_doc({"team-x": {"limitHBM": 16}}))
+        cluster = Cluster(api)
+        try:
+            # Saturate team-x's hard limit ...
+            api.create_pod(make_pod("b-0", hbm=16, namespace="team-x"))
+            ok, _where = cluster.schedule(api.get_pod("team-x", "b-0"))
+            assert ok
+            # ... then a pod that has ALREADY waited 60s arrives and is
+            # denied on every retry: per-attempt latencies stay tiny
+            # while its journey ages past the 30s objective.
+            api.create_pod(_aged_pod_doc("p-burn", 60, hbm=16,
+                                         namespace="team-x"))
+            burn_pod = api.get_pod("team-x", "p-burn")
+            denials = 0
+            for _ in range(3):
+                result = cluster.filter(burn_pod)
+                assert not (result["NodeNames"] or [])
+                assert any(
+                    r.startswith("quota:")
+                    for r in result["FailedNodes"].values())
+                denials += 1
+            # capacity frees, the tenant drops under its limit, and the
+            # 4th attempt binds
+            api.delete_pod("team-x", "b-0")
+            cluster.stack.controller.wait_idle(timeout=10)
+            ok, where = cluster.schedule(
+                api.get_pod("team-x", "p-burn"))
+            assert ok, where
+
+            # -- the journey tells the macro story ------------------- #
+            with urllib.request.urlopen(
+                    f"{cluster.base}/debug/journey/team-x/p-burn") as r:
+                journey = json.loads(r.read())
+            assert journey["outcome"] == "bound"
+            assert journey["attemptsTotal"] == denials + 1 == 4
+            trace_ids = [a["traceId"] for a in journey["attempts"]]
+            assert len(set(trace_ids)) == 4
+            assert journey["e2eSeconds"] >= 60.0
+            assert journey["queueWaitSeconds"] > 0.9 * journey["e2eSeconds"]
+
+            # every attempt's trace-id resolves in the flight recorder
+            for tid in trace_ids:
+                with urllib.request.urlopen(
+                        f"{cluster.base}/debug/trace/team-x/p-burn"
+                        f"?id={tid}") as r:
+                    assert json.loads(r.read())["traceId"] == tid
+
+            # -- metrics: flat verbs, degraded e2e, burning gauge ---- #
+            text = cluster.metrics_text()
+            filter_hist = _hist_counts(
+                text, "tpushare_filter_latency_seconds")
+            # every filter call finished within 250ms: per-verb FLAT
+            assert filter_hist["0.25"] == filter_hist["+Inf"] > 0
+            e2e = _hist_counts(text,
+                               "tpushare_pod_e2e_scheduling_seconds")
+            # DEGRADED e2e: at least one journey past the 30s objective
+            # boundary (buckets are cumulative: b-0's instant bind sits
+            # under 30s; p-burn's 60s+ journey lands between 60 and 120)
+            assert e2e["120.0"] - e2e["60.0"] >= 1.0
+            burn_5m = _gauge(
+                text, 'tpushare_slo_burn_rate{slo="pod-bind-30s",'
+                      'window="5m"}')
+            assert burn_5m > 14.4
+            assert _gauge(
+                text, 'tpushare_slo_error_budget_remaining'
+                      '{slo="pod-bind-30s"}') < 1.0
+
+            # -- exactly one rate-limited TPUShareSLOBurn Event ------ #
+            cluster.metrics_text()  # second scrape, same burn
+            assert events.flush()
+            burns = [e for _ns, e in api.events
+                     if e["reason"] == "TPUShareSLOBurn"]
+            assert len(burns) == 1
+            assert burns[0]["involvedObject"]["name"] == "p-burn"
+        finally:
+            cluster.close()
+
+
+# ------------------------------------------------------------------------ #
+# Debug surfaces
+# ------------------------------------------------------------------------ #
+
+
+class TestDebugSurfaces:
+    def test_journey_404_shapes_and_debug_gate(self, api):
+        from tests.test_handlers import build_stack
+        from tpushare.routes.server import (ExtenderHTTPServer,
+                                            serve_forever)
+
+        api.create_node(make_node("v5e-0"))
+        _, pred, prio, binder, inspect = build_stack(api)
+        server = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder,
+                                    inspect, prioritize=prio)
+        serve_forever(server)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            for path in ("/debug/journey/default/ghost",
+                         "/debug/journey/default",
+                         "/debug/journey/a/b/c"):
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(f"{base}{path}")
+                assert exc.value.code == 404, path
+            with urllib.request.urlopen(f"{base}/debug/slo") as r:
+                doc = json.loads(r.read())
+            assert {row["slo"] for row in doc["slos"]} == {
+                "pod-bind-30s", "filter-p99-5ms"}
+            assert doc["journeys"]["open"] == 0
+            # telemetry loss is itself observable (review finding)
+            assert doc["recordingDrops"] == {"journeys": 0, "engine": 0}
+        finally:
+            server.shutdown()
+
+        off = ExtenderHTTPServer(("127.0.0.1", 0), pred, binder,
+                                 inspect, prioritize=prio,
+                                 debug_routes=False)
+        serve_forever(off)
+        base = f"http://127.0.0.1:{off.server_address[1]}"
+        try:
+            for path in ("/debug/slo", "/debug/journey/default/p"):
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    urllib.request.urlopen(f"{base}{path}")
+                assert exc.value.code == 404
+                assert "disabled" in json.loads(exc.value.read())["Error"]
+        finally:
+            off.shutdown()
+
+
+# ------------------------------------------------------------------------ #
+# kubectl plugin: slo table + explain's journey header
+# ------------------------------------------------------------------------ #
+
+
+class TestKubectlSlo:
+    def _doc(self):
+        return {
+            "slos": [{
+                "slo": "pod-bind-30s", "signal": "pod_e2e",
+                "objective": 0.99, "thresholdSeconds": 30.0,
+                "fastBurn": 14.4, "errorBudgetRemaining": 0.42,
+                "windows": {"5m": {"bad": 1, "total": 2,
+                                   "burnRate": 50.0},
+                            "1h": {"bad": 1, "total": 8,
+                                   "burnRate": 12.5}},
+                "burning": False,
+            }],
+            "journeys": {"open": 1, "closed": {"bound": 3, "deleted": 1},
+                         "meanAttempts": 2.3, "p50E2eSeconds": 1.5,
+                         "p99E2eSeconds": 62.0},
+        }
+
+    def test_render_slo_table(self):
+        import importlib
+        tool = importlib.import_module("tools.kubectl_inspect_tpushare")
+
+        out = tool.render_slo(self._doc())
+        assert "pod-bind-30s" in out and "42.0%" in out
+        assert "50.0x" in out and "12.5x" in out
+        assert "3 bound" in out and "1 deleted" in out
+        assert "p99 62.00s" in out
+
+    def test_explain_journey_header(self):
+        import importlib
+        tool = importlib.import_module("tools.kubectl_inspect_tpushare")
+
+        journey = {
+            "attempts": [{"traceId": "aaa"}, {"traceId": "bbb"},
+                         {"traceId": "ccc"}],
+            "attemptsTotal": 3, "outcome": "open",
+            "e2eSeconds": 42.5, "queueWaitSeconds": 42.0,
+            "inVerbSeconds": 0.5,
+        }
+        header = tool.journey_header(journey, {"traceId": "bbb"})
+        assert "attempt 2 of 3" in header
+        assert "queue-wait 42.0s" in header
+        rendered = tool.render_trace(
+            {"traceId": "bbb", "namespace": "ns", "name": "p",
+             "outcome": "unschedulable", "wallSeconds": 0.001,
+             "startedAt": "t", "spans": []},
+            journey=journey)
+        assert rendered.splitlines()[0].startswith("JOURNEY attempt 2 of 3")
+
+
+# ------------------------------------------------------------------------ #
+# simulate + bench surfaces
+# ------------------------------------------------------------------------ #
+
+
+class TestToolingSurfaces:
+    def test_simulate_report_carries_slo_section(self):
+        from tools import simulate as sim
+
+        report = sim.simulate({
+            "fleet": [{"count": 1, "prefix": "v5e", "chips": 4,
+                       "hbm_per_chip": 16}],
+            "workload": [{"count": 2, "name": "w", "hbm": 8}],
+        })
+        assert report["bound"] == 2
+        slos = {s["slo"] for s in report["slo"]["slos"]}
+        assert "pod-bind-30s" in slos
+        assert report["slo"]["journeys"]["closed"].get("bound") == 2
+
+    def test_bench_pod_e2e_quantile_reads_the_histogram(self):
+        import bench
+        from tpushare.routes import metrics
+
+        # dominate the (freshly reset) registry view with a known shape:
+        # 99 fast journeys and one 45s straggler put p99 in the 60 bucket
+        before = bench._pod_e2e_p99_s()
+        for _ in range(99):
+            metrics.POD_E2E.labels(tenant="bench",
+                                   outcome="bound").observe(0.05)
+        metrics.POD_E2E.labels(tenant="bench",
+                               outcome="bound").observe(45.0)
+        after = bench._pod_e2e_p99_s()
+        assert after is not None
+        assert after >= (before or 0.0)
+        gates = bench._gates(1.0, 2.0, after)
+        assert "pod_e2e_p99_s" in gates
+        assert gates["pod_e2e_p99_s"]["limit"] == bench.GATE_POD_E2E_P99_S

@@ -220,6 +220,33 @@ def test_catches_swallowed_telemetry_error():
         path="tpushare/trace/recorder.py")
 
 
+def test_catches_unbounded_metric_cardinality():
+    """The seeded defect: a .labels(...) value derived from pod
+    identity (pod name / uid / trace-id) — one Prometheus series per
+    pod, unbounded. Bounded label sets (tenant, node, outcome) pass."""
+    # every unbounded shape is seen
+    for bad in ("USED.labels(pod=pod.name).set(1)",
+                "USED.labels(pod.key()).set(1)",
+                "COUNTER.labels(uid=pod.uid).inc()",
+                "COUNTER.labels(trace=dec.trace_id).inc()",
+                "COUNTER.labels(pod_name).inc()",
+                "GAUGE.labels(id=trace_id).set(0)"):
+        assert "unbounded-metric-cardinality" in _rules_hit(bad), bad
+    # bounded labels pass — including node names via a ledger receiver
+    for ok in ("USED.labels(tenant=tenant).set(1)",
+               "HBM.labels(node=info.name).set(2)",
+               "E2E.labels(tenant=t, outcome='bound').observe(3)",
+               "BURN.labels(slo=row['slo'], window=w).set(4)"):
+        assert "unbounded-metric-cardinality" not in _rules_hit(ok), ok
+    # a non-labels call carrying pod identity is not this rule's business
+    assert "unbounded-metric-cardinality" not in _rules_hit(
+        "log.warning('pod %s', pod.name)\n")
+    # the pragma escape hatch works (the node-local watchdog's case)
+    assert "unbounded-metric-cardinality" not in _rules_hit(
+        "# vet: ignore[unbounded-metric-cardinality]\n"
+        "USED.labels(pod=pod.name).set(1)\n")
+
+
 def test_catches_raw_lock_construction():
     src = "import threading\nL = threading.Lock()\n"
     assert "raw-lock" in _rules_hit(src)
@@ -404,6 +431,14 @@ def test_ledger_containers_are_registered():
     quota = QuotaManager()
     assert isinstance(quota._pods, locks.GuardedDict)
     assert isinstance(quota._usage, locks.GuardedDict)
+    from tpushare.slo.engine import SLOEngine
+    from tpushare.slo.journey import JourneyTracker
+
+    tracker = JourneyTracker()
+    assert isinstance(tracker._open, locks.GuardedDict)
+    assert isinstance(tracker._closed_uids, locks.GuardedSet)
+    engine = SLOEngine()
+    assert isinstance(engine._events, locks.GuardedDict)
 
 
 @pytest.mark.skipif(os.environ.get("TPUSHARE_RACE_DETECT") == "1",

@@ -6,7 +6,10 @@ Counterpart of the reference's ``kubectl inspect gpushare`` plugin
 API as a per-node, per-chip allocation table plus a cluster summary;
 ``-d/--details`` adds the resident pods of every chip; the ``quota``
 subcommand renders the per-tenant guarantee/limit/usage/borrowed table
-from ``/debug/quota`` (docs/quota.md).
+from ``/debug/quota`` (docs/quota.md); the ``slo`` subcommand renders
+the error-budget / burn-rate table from ``/debug/slo`` (docs/slo.md);
+``explain`` heads its span timeline with the pod's journey (attempt N
+of M, cumulative queue-wait).
 
 Install as a kubectl plugin by dropping an executable named
 ``kubectl-inspect_tpushare`` on PATH that execs this script, or run it
@@ -191,12 +194,48 @@ def fetch_trace(endpoint: str, namespace: str, pod: str) -> dict | None:
         raise
 
 
-def render_trace(doc: dict) -> str:
-    """Human-readable timeline of one placement decision."""
+def fetch_journey(endpoint: str, namespace: str, pod: str) -> dict | None:
+    """The pod's journey (every attempt, queue-wait split) from
+    ``/debug/journey``; None when untracked or debug routes are off."""
+    url = f"{endpoint}/debug/journey/{namespace}/{pod}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def journey_header(journey: dict, trace_doc: dict) -> str:
+    """The macro line above the micro timeline: which attempt of how
+    many this trace is, and the journey's queue-wait so far."""
+    attempts = journey.get("attempts", [])
+    total = journey.get("attemptsTotal", len(attempts))
+    tid = trace_doc.get("traceId")
+    number = next((i + 1 for i, a in enumerate(attempts)
+                   if a.get("traceId") == tid), total)
+    wait = journey.get("queueWaitSeconds", 0.0)
+    e2e = journey.get("e2eSeconds", 0.0)
+    state = (f"journey {journey.get('outcome', 'open')}"
+             if journey.get("outcome") != "open" else "journey open")
+    return (f"JOURNEY attempt {number} of {total}  "
+            f"({state}; e2e {e2e:.1f}s, queue-wait {wait:.1f}s, "
+            f"in-verb {journey.get('inVerbSeconds', 0.0) * 1e3:.1f}ms "
+            f"across all attempts)")
+
+
+def render_trace(doc: dict, journey: dict | None = None) -> str:
+    """Human-readable timeline of one placement decision; with a
+    journey, the macro story (attempt N of M, cumulative queue-wait)
+    heads the micro one (spans)."""
     ms = 1e3
     outcome = doc.get("outcome", "?")
     where = f" -> {doc['node']}" if doc.get("node") else ""
-    lines = [
+    lines = []
+    if journey is not None:
+        lines.append(journey_header(journey, doc))
+    lines += [
         f"TRACE {doc.get('traceId', '?')}  pod "
         f"{doc.get('namespace', '?')}/{doc.get('name', '?')}  "
         f"outcome: {outcome}{where}  "
@@ -250,7 +289,10 @@ def render_trace(doc: dict) -> str:
 
 
 def explain(endpoint: str, target: str) -> tuple[int, str]:
-    """``explain [ns/]pod``: (exit code, rendered timeline)."""
+    """``explain [ns/]pod``: (exit code, rendered timeline). One
+    command, both altitudes: the journey header says attempt N of M
+    and the cumulative queue wait (macro), the span table says where
+    THIS attempt's time went (micro)."""
     namespace, _, pod = target.rpartition("/")
     namespace = namespace or "default"
     doc = fetch_trace(endpoint, namespace, pod)
@@ -260,7 +302,67 @@ def explain(endpoint: str, target: str) -> tuple[int, str]:
                    "flight recorder keeps the last "
                    "~256 decisions), or debug routes are disabled "
                    "(DEBUG_ROUTES=0)")
-    return 0, render_trace(doc)
+    journey = fetch_journey(endpoint, namespace, pod)
+    return 0, render_trace(doc, journey=journey)
+
+
+def fetch_slo(endpoint: str) -> dict | None:
+    """The SLO budget/burn snapshot from ``/debug/slo``; None when
+    debug routes are disabled."""
+    try:
+        with urllib.request.urlopen(f"{endpoint}/debug/slo",
+                                    timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def render_slo(doc: dict) -> str:
+    """Budget/burn table plus the journey aggregates."""
+    slos = doc.get("slos", [])
+    if not slos:
+        return "no SLOs configured (and no built-in defaults?!)"
+    rows = [["SLO", "SIGNAL", "OBJECTIVE", "THRESHOLD", "BUDGET LEFT",
+             "BURN 5m", "BURN 1h", "STATUS"]]
+    for s in slos:
+        threshold = s["thresholdSeconds"]
+        rows.append([
+            s["slo"], s["signal"],
+            f"{s['objective'] * 100:g}%",
+            (f"{threshold * 1e3:g}ms" if threshold < 1
+             else f"{threshold:g}s"),
+            f"{s['errorBudgetRemaining'] * 100:.1f}%",
+            f"{s['windows'].get('5m', {}).get('burnRate', 0):.1f}x",
+            f"{s['windows'].get('1h', {}).get('burnRate', 0):.1f}x",
+            "BURNING" if s.get("burning") else "ok",
+        ])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    journeys = doc.get("journeys") or {}
+    closed = journeys.get("closed") or {}
+    if journeys:
+        lines.append("")
+        outcome_bits = ", ".join(f"{n} {outcome}" for outcome, n in
+                                 sorted(closed.items())) or "none closed"
+        lines.append(f"journeys: {journeys.get('open', 0)} open; "
+                     f"{outcome_bits}")
+        if journeys.get("p50E2eSeconds") is not None:
+            lines.append(
+                f"  bound e2e p50 {journeys['p50E2eSeconds']:.2f}s / "
+                f"p99 {journeys['p99E2eSeconds']:.2f}s, "
+                f"mean {journeys.get('meanAttempts')} attempt(s)")
+    lines.append("")
+    lines.append("BURN = error-budget burn-rate multiple per rolling "
+                 "window (1.0x = exactly the objective's allowance); "
+                 "both windows over the SLO's fastBurn fires a "
+                 "TPUShareSLOBurn Event. Objectives come from the "
+                 "tpushare-slos ConfigMap (docs/slo.md); "
+                 "per-pod stories: kubectl inspect tpushare explain "
+                 "<pod>.")
+    return "\n".join(lines)
 
 
 def whatif_preempt(endpoint: str, hbm: int, chips: int, priority: int,
@@ -337,7 +439,9 @@ def main(argv: list[str] | None = None) -> int:
                         help="restrict to one node; or the literal "
                              "'explain' to render a pod's decision "
                              "trace; or the literal 'quota' for the "
-                             "per-tenant guarantee/limit/usage table")
+                             "per-tenant guarantee/limit/usage table; "
+                             "or the literal 'slo' for the error-budget "
+                             "/ burn-rate table")
     parser.add_argument("pod", nargs="?", metavar="[ns/]pod",
                         help="with 'explain': the pod whose placement "
                              "decision to explain (namespace defaults "
@@ -366,6 +470,23 @@ def main(argv: list[str] | None = None) -> int:
         print(f"--explain cannot be combined with the positional "
               f"{args.node!r}; use one form", file=sys.stderr)
         return 2
+    if args.node == "slo":
+        if args.pod:
+            print(f"unexpected argument {args.pod!r} after 'slo'",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = fetch_slo(args.endpoint)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        if doc is None:
+            print("SLO view unavailable — debug routes are disabled "
+                  "(DEBUG_ROUTES=0)", file=sys.stderr)
+            return 1
+        print(render_slo(doc))
+        return 0
     if args.node == "quota":
         if args.pod:
             print(f"unexpected argument {args.pod!r} after 'quota'",

@@ -206,6 +206,11 @@ def simulate(scenario: dict) -> dict:
     node_docs = _expand_fleet(scenario)
     if not node_docs:
         return {"error": "scenario has no fleet"}
+    # Journeys/SLO windows are process singletons (like the flight
+    # recorder); a replay must report ITS pods' journeys, not a
+    # previous run's.
+    from tpushare import slo as slo_mod
+    slo_mod.reset()
     api = _fresh_api(node_docs)
     quota_cm = _quota_configmap(scenario)
     if quota_cm is not None:
@@ -291,11 +296,15 @@ def simulate(scenario: dict) -> dict:
         inspect_doc = client.get("/tpushare-scheduler/inspect")
         tenants = (client.get("/debug/quota").get("tenants", [])
                    if quota_cm is not None else [])
+        # The user-facing latency story: SLO budget/burn plus journey
+        # aggregates (e2e percentiles, attempts) — the numbers a real
+        # fleet would alert on, read from the same /debug/slo surface.
+        slo_doc = client.get("/debug/slo")
     finally:
         client.close()
         shutdown_stack(stack, server)
     return _report(inspect_doc, placements, held, unschedulable,
-                   latencies, executed_preemptions, tenants)
+                   latencies, executed_preemptions, tenants, slo_doc)
 
 
 def _quota_configmap(scenario: dict) -> dict | None:
@@ -416,7 +425,8 @@ def _execute_preemption(api, client: _Client, controller, pod,
 
 
 def _report(inspect_doc, placements, held, unschedulable,
-            latencies, executed_preemptions=(), tenants=()):
+            latencies, executed_preemptions=(), tenants=(),
+            slo_doc=None):
     nodes = []
     total_hbm = used_hbm = free_whole_chips = cordoned_hbm = 0
     for n in inspect_doc.get("nodes", []):
@@ -459,6 +469,7 @@ def _report(inspect_doc, placements, held, unschedulable,
         "gangs": inspect_doc.get("gangs", []),
         "preemptions_executed": list(executed_preemptions),
         "tenants": list(tenants),
+        "slo": slo_doc or {},
     }
 
 
@@ -498,6 +509,24 @@ def _print_human(report: dict) -> None:
         for p in report["preemptions_executed"]:
             print(f"  {p['pod']} -> {p['node']}: evicted "
                   f"{', '.join(p['evicted'])}")
+    slo_doc = report.get("slo") or {}
+    journeys = slo_doc.get("journeys") or {}
+    if journeys.get("closed"):
+        closed = ", ".join(f"{n} {outcome}" for outcome, n in
+                           sorted(journeys["closed"].items()))
+        extra = ""
+        if journeys.get("p50E2eSeconds") is not None:
+            extra = (f"; bound e2e p50 "
+                     f"{journeys['p50E2eSeconds'] * 1e3:.0f} ms / p99 "
+                     f"{journeys['p99E2eSeconds'] * 1e3:.0f} ms, mean "
+                     f"{journeys.get('meanAttempts')} attempt(s)")
+        print(f"\njourneys: {closed}{extra}")
+    burning = [s for s in slo_doc.get("slos", []) if s.get("burning")]
+    for s in burning:
+        print(f"SLO BURNING: {s['slo']} — "
+              + ", ".join(f"{w}={v['burnRate']}x"
+                          for w, v in s["windows"].items())
+              + f" (budget {s['errorBudgetRemaining'] * 100:.0f}% left)")
     if report.get("tenants"):
         print("\ntenants (quota):")
         for t in report["tenants"]:

@@ -74,6 +74,11 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
     # the filter/bind verbs read usage on HTTP threads — the same
     # unlocked-mutation bug class as the node map.
     "QuotaManager": ("_pods", "_usage", "_config"),
+    # The pod-journey tables (tpushare/slo/): informer threads open and
+    # close journeys while HTTP verb threads link attempts and the
+    # scrape thread reads windows — every mutation is cross-thread.
+    "JourneyTracker": ("_open", "_ring", "_closed_uids"),
+    "SLOEngine": ("_events", "_burn_event_at", "_config"),
 }
 
 #: Method calls that mutate a dict/set/list in place.
@@ -285,7 +290,7 @@ def raw_lock(tree: ast.AST, src: str, path: str) -> list[Violation]:
 #: "quiet fleet" when the truth is "blind fleet". Every swallow must
 #: increment a drop/error counter so the loss itself is observable.
 _TELEMETRY_PATHS = ("k8s/events.py", "routes/metrics.py")
-_TELEMETRY_DIRS = ("tpushare/trace/",)
+_TELEMETRY_DIRS = ("tpushare/trace/", "tpushare/slo/")
 
 #: Call shapes that count as incrementing a drop/error counter
 #: (bare ``safe_inc(...)``, ``metrics.safe_inc(...)``, ``x.inc()``).
@@ -338,5 +343,73 @@ def swallowed_telemetry_error(tree: ast.AST, src: str,
         and not _handler_counts_drop(node)]
 
 
+# --------------------------------------------------------------------------
+# unbounded-metric-cardinality: pod identity must never become a label
+# --------------------------------------------------------------------------
+
+#: Identifier fragments that mean "per-pod identity" wherever they
+#: appear inside a ``.labels(...)`` argument. A label series per pod
+#: name/uid/trace-id grows without bound (every churned pod leaves a
+#: series behind) until the scrape — and Prometheus itself — drowns;
+#: only bounded sets (tenant, node, outcome, slo, window, verb) may
+#: label a metric. The journey/flight recorder surfaces exist precisely
+#: so per-pod detail has a home that is NOT a label.
+_UNBOUNDED_IDENTIFIERS = {"uid", "trace_id", "traceid", "pod_name",
+                          "podname", "pod_key", "pod_uid", "poduid"}
+
+#: Receivers whose ``.name``/``.key``/``.uid`` attributes identify one
+#: pod (``info.name`` — a node ledger — stays legal; ``pod.name`` does
+#: not).
+_POD_RECEIVERS = {"pod", "p", "new_pod", "victim", "preemptor", "dec",
+                  "decision", "journey"}
+
+
+def _unbounded_source(expr: ast.AST) -> str | None:
+    """The first sub-expression of ``expr`` that derives from pod
+    identity, rendered for the message; None when the value looks
+    bounded."""
+    for node in ast.walk(expr):
+        if (isinstance(node, ast.Name)
+                and node.id.lower() in _UNBOUNDED_IDENTIFIERS):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            if node.attr.lower() in _UNBOUNDED_IDENTIFIERS:
+                return f"<...>.{node.attr}"
+            if (node.attr in ("name", "key", "uid")
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in _POD_RECEIVERS):
+                return f"{node.value.id}.{node.attr}"
+    return None
+
+
+@_rule("unbounded-metric-cardinality")
+def unbounded_metric_cardinality(tree: ast.AST, src: str,
+                                 path: str) -> list[Violation]:
+    """``.labels(...)`` calls whose label value derives from a pod
+    name, uid, or trace-id create one time series per pod — unbounded
+    cardinality that outlives the pod. Label only bounded sets (tenant,
+    node, outcome, slo, window); per-pod detail belongs in the flight
+    recorder / journey surfaces, not in Prometheus."""
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "labels"):
+            continue
+        values = list(node.args) + [kw.value for kw in node.keywords]
+        for value in values:
+            source = _unbounded_source(value)
+            if source:
+                out.append(Violation(
+                    path, node.lineno, node.col_offset,
+                    "unbounded-metric-cardinality",
+                    f"label value derives from pod identity ({source}): "
+                    "one series per pod is unbounded cardinality — use "
+                    "a bounded label set (tenant/node/outcome) and put "
+                    "per-pod detail in the flight recorder or journey"))
+    return out
+
+
 LINT_RULES = (annotation_literal, unlocked_mutation, bare_except,
-              sleep_in_handler, raw_lock, swallowed_telemetry_error)
+              sleep_in_handler, raw_lock, swallowed_telemetry_error,
+              unbounded_metric_cardinality)

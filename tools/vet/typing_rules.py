@@ -20,7 +20,8 @@ from tools.vet.engine import Violation
 
 #: Path fragments of the strictly-typed core packages.
 CORE_PACKAGES = ("tpushare/cache/", "tpushare/scheduler/",
-                 "tpushare/utils/", "tpushare/api/", "tpushare/quota/")
+                 "tpushare/utils/", "tpushare/api/", "tpushare/quota/",
+                 "tpushare/slo/")
 
 #: Parameter names exempt from annotation (bound implicitly).
 _IMPLICIT = {"self", "cls"}

@@ -102,6 +102,14 @@ class K8sObject:
     def deletion_timestamp(self) -> str | None:
         return self.metadata.get("deletionTimestamp")
 
+    @property
+    def creation_timestamp(self) -> str:
+        """RFC-3339 ``metadata.creationTimestamp`` ("" when absent).
+        The pod-journey clock starts here: time-to-bind is measured
+        from when the USER created the pod, not from when this replica
+        first heard about it."""
+        return self.metadata.get("creationTimestamp") or ""
+
     def deepcopy(self: _K) -> _K:
         return type(self)(copy.deepcopy(self.raw))
 

@@ -33,6 +33,10 @@ Environment (reference cmd/main.go:23,92-98):
 * ``TPUSHARE_QUOTA_NAMESPACE`` — namespace the ``tpushare-quotas``
   ConfigMap (per-tenant quota table, docs/quota.md) is trusted from;
   default ``kube-system``.
+* ``TPUSHARE_SLO_NAMESPACE`` — namespace the ``tpushare-slos``
+  ConfigMap (SLO objectives: error budgets + burn-rate alerting,
+  docs/slo.md) is trusted from; default ``kube-system``. Absent
+  ConfigMap = the built-in default objectives.
 """
 
 from __future__ import annotations

@@ -19,6 +19,7 @@ import logging
 import os
 import threading
 
+from tpushare import slo
 from tpushare.api.objects import ConfigMap, Pod
 from tpushare.cache.cache import SchedulerCache
 from tpushare.k8s import events
@@ -27,6 +28,7 @@ from tpushare.k8s.informer import InformerHub
 from tpushare.k8s.workqueue import RateLimitedQueue
 from tpushare.quota import config as quota_config
 from tpushare.quota.manager import QuotaManager
+from tpushare.slo import config as slo_config
 from tpushare.utils import const
 from tpushare.utils import locks
 from tpushare.utils import pod as podutils
@@ -87,6 +89,23 @@ class Controller:
             on_delete=lambda cm: self.quota.set_config(quota_config.EMPTY),
             filter_fn=self._is_quota_configmap,
         )
+        #: Namespace the SLO-objective ConfigMap is trusted from (same
+        #: trust model as the quota table: matching by name alone would
+        #: let any namespace rewrite the fleet's alert thresholds).
+        self._slo_namespace = os.environ.get("TPUSHARE_SLO_NAMESPACE",
+                                             "kube-system")
+        self.hub.add_configmap_handler(
+            on_add=self._on_slo_configmap,
+            on_update=lambda old, new: self._on_slo_configmap(new),
+            # Deleted ConfigMap -> the built-in default objectives, NOT
+            # "no SLOs" (an undeclared fleet still gets the two signals
+            # the north star cares about).
+            on_delete=lambda cm: slo.engine().set_config(
+                slo_config.DEFAULTS),
+            filter_fn=self._is_slo_configmap,
+        )
+        # Arm burn-alert Event emission (gauge + log work without it).
+        slo.engine().set_client(client)
 
     # -- listers wired into the cache ----------------------------------- #
 
@@ -118,6 +137,16 @@ class Controller:
         and a rate-limited retry would only delay enforcement."""
         self.quota.set_config(quota_config.parse_configmap(cm))
 
+    def _is_slo_configmap(self, cm: ConfigMap) -> bool:
+        """Only ``tpushare-slos`` in the pinned namespace
+        (``TPUSHARE_SLO_NAMESPACE``, default kube-system) drives the
+        objective table."""
+        return (cm.name == const.SLO_CONFIGMAP
+                and cm.namespace == self._slo_namespace)
+
+    def _on_slo_configmap(self, cm: ConfigMap) -> None:
+        slo.engine().set_config(slo_config.parse_configmap(cm))
+
     @staticmethod
     def _is_relevant_pod(pod: Pod) -> bool:
         """Informer-side filter (reference controller.go:77-100 filters on
@@ -128,7 +157,23 @@ class Controller:
 
     # -- event handlers (reference controller.go:233-332) ---------------- #
 
+    @staticmethod
+    def _journey_candidate(pod: Pod) -> bool:
+        """An unassigned, live TPU-share pod: the moment its journey
+        clock becomes our problem (docs/slo.md)."""
+        return ((podutils.is_tpu_sharing_pod(pod)
+                 or podutils.is_tpu_chip_pod(pod))
+                and not podutils.is_assumed(pod)
+                and not pod.node_name
+                and not podutils.is_complete_pod(pod))
+
     def _on_pod_add(self, pod: Pod) -> None:
+        if self._journey_candidate(pod):
+            # Informer-first journey open (the filter verb is the other
+            # opener — whichever sees the pod first wins; both use the
+            # pod's creationTimestamp as the clock so there is no race
+            # on the number itself).
+            slo.tracker().open_journey(pod)
         self.queue.add(pod.key())
 
     @staticmethod
@@ -168,6 +213,10 @@ class Controller:
             self.queue.add(new.key())
 
     def _on_pod_delete(self, pod: Pod) -> None:
+        # A pod deleted while its journey is still open never bound:
+        # that is the journey's "deleted" outcome (a no-op for pods
+        # whose journey already closed as bound).
+        slo.tracker().pod_deleted(pod)
         with self._removed_lock:
             self._removed[pod.key()] = pod
         self.queue.add(pod.key())
@@ -202,6 +251,12 @@ class Controller:
             log.info("sync: pod %s complete, freed its HBM", key)
         elif podutils.is_assumed(pod) and pod.node_name:
             self.cache.add_or_update_pod(pod)
+            # Close (or, after a restart, RECONSTRUCT from annotations)
+            # the pod's journey: gang members bound by the planner's
+            # commit thread and binds taken by an HA peer both reach
+            # the e2e histogram through this sync, not only through
+            # this replica's own /bind route.
+            slo.tracker().pod_bound(pod)
         elif not podutils.is_assumed(pod):
             # Pending: track (or drop) its preemption nomination so the
             # eviction→bind window is honored by admission.
@@ -323,7 +378,20 @@ class Controller:
         for cm in self.hub.configmaps.list():
             if self._is_quota_configmap(cm):
                 self._on_quota_configmap(cm)
+            elif self._is_slo_configmap(cm):
+                self._on_slo_configmap(cm)
         self.cache.build()
+        # Journey restart semantics (docs/slo.md): pods already BOUND
+        # reconstruct their e2e from annotation truth (assume-time vs
+        # creationTimestamp), pods still PENDING re-open with their
+        # original creation clock — the histogram a restart interrupts
+        # picks up where it left off, like the chip ledger.
+        for pod in self.hub.pods.list():
+            if podutils.is_assumed(pod) and pod.node_name \
+                    and not podutils.is_complete_pod(pod):
+                slo.tracker().reconstruct(pod)
+            elif self._journey_candidate(pod):
+                slo.tracker().open_journey(pod)
         for i in range(workers):
             t = threading.Thread(target=self._worker,
                                  name=f"tpushare-sync-{i}", daemon=True)

@@ -138,8 +138,15 @@ class GrantWatchdog:
             entry["overrun"] = over
             labels = (pod.namespace, pod.name)
             live_series.add(labels)
+            # Per-pod series are legal HERE and only here: this is the
+            # node-local device plugin's own registry, cardinality is
+            # bounded by the pods RESIDENT on one host, and dead series
+            # are GC'd below each sweep — none of which holds for the
+            # extender's fleet registry the vet rule protects.
+            # vet: ignore[unbounded-metric-cardinality]
             self._used.labels(pod.namespace, pod.name,
                               self.node_name).set(round(used_gib, 3))
+            # vet: ignore[unbounded-metric-cardinality]
             self._overrun.labels(pod.namespace, pod.name,
                                  self.node_name).set(1 if over else 0)
             streak = self._over_streak.get(pod.uid, 0)

@@ -33,11 +33,17 @@ log = logging.getLogger(__name__)
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
-#: LIST/WATCH path for the quota ConfigMap: name-filtered server-side
-#: so the watch stream and informer store carry ONE document, not every
-#: ConfigMap in the cluster.
-_CONFIGMAP_PATH = ("/api/v1/configmaps?fieldSelector="
-                   + quote(f"metadata.name={const.QUOTA_CONFIGMAP}"))
+#: ConfigMap names the extender consumes (quota table + SLO
+#: objectives). Each gets its OWN name-filtered LIST/WATCH stream: a
+#: fieldSelector cannot OR two names, and an unfiltered cluster-wide
+#: watch would drag every namespace's kube-root-ca.crt (and any 1-MiB
+#: app config) into the informer store forever.
+_WATCHED_CONFIGMAPS = (const.QUOTA_CONFIGMAP, const.SLO_CONFIGMAP)
+
+
+def _configmap_path(name: str) -> str:
+    return ("/api/v1/configmaps?fieldSelector="
+            + quote(f"metadata.name={name}"))
 
 
 class ClusterConfig:
@@ -262,14 +268,18 @@ class ApiClient:
             "GET", f"/api/v1/namespaces/{namespace}/configmaps/{name}"))
 
     def list_configmaps(self) -> list[ConfigMap]:
-        """ConfigMaps named ``tpushare-quotas`` (server-side
-        fieldSelector) — the only ConfigMap surface the extender
-        consumes. An unfiltered cluster-wide LIST would drag every
-        namespace's kube-root-ca.crt (and any 1-MiB app config) into
-        the informer store forever. Needs a ``configmaps``
-        get/list/watch RBAC rule (config/tpushare-schd-extender.yaml)."""
-        doc = self._request("GET", _CONFIGMAP_PATH)
-        return [ConfigMap(item) for item in doc.get("items", [])]
+        """ConfigMaps named ``tpushare-quotas`` or ``tpushare-slos``
+        (one server-side name fieldSelector per LIST) — the only
+        ConfigMap surface the extender consumes. An unfiltered
+        cluster-wide LIST would drag every namespace's
+        kube-root-ca.crt (and any 1-MiB app config) into the informer
+        store forever. Needs a ``configmaps`` get/list/watch RBAC rule
+        (config/tpushare-schd-extender.yaml)."""
+        out: list[ConfigMap] = []
+        for name in _WATCHED_CONFIGMAPS:
+            doc = self._request("GET", _configmap_path(name))
+            out.extend(ConfigMap(item) for item in doc.get("items", []))
+        return out
 
     def update_node(self, node: Node) -> Node:
         """PUT the node object itself — metadata (annotations) changes do
@@ -341,14 +351,23 @@ class ApiClient:
         q: queue.Queue = queue.Queue()
         stop = threading.Event()
         threads = []
-        for kind, path in (("Pod", "/api/v1/pods"),
-                           ("Node", "/api/v1/nodes"),
-                           ("PodDisruptionBudget",
-                            "/apis/policy/v1/poddisruptionbudgets"),
-                           ("ConfigMap", _CONFIGMAP_PATH)):
+        streams: list[tuple[str, str, str]] = [
+            ("Pod", "/api/v1/pods", ""),
+            ("Node", "/api/v1/nodes", ""),
+            ("PodDisruptionBudget",
+             "/apis/policy/v1/poddisruptionbudgets", ""),
+        ]
+        # One stream PER watched ConfigMap name (a fieldSelector cannot
+        # OR names). Each stream's RELIST carries its name as a scope so
+        # the informer diffs only that document's slot — an unscoped
+        # diff would let the quota stream's relist "delete" the SLO
+        # document from the shared store, and vice versa.
+        streams += [("ConfigMap", _configmap_path(name), name)
+                    for name in _WATCHED_CONFIGMAPS]
+        for i, (kind, path, scope) in enumerate(streams):
             t = threading.Thread(
-                target=self._watch_loop, args=(kind, path, q, stop),
-                name=f"tpushare-watch-{kind.lower()}", daemon=True)
+                target=self._watch_loop, args=(kind, path, q, stop, scope),
+                name=f"tpushare-watch-{kind.lower()}-{i}", daemon=True)
             t.start()
             threads.append(t)
         self._watch_threads[id(q)] = (stop, threads)
@@ -360,7 +379,7 @@ class ApiClient:
             entry[0].set()
 
     def _watch_loop(self, kind: str, path: str, q: queue.Queue,
-                    stop: threading.Event) -> None:
+                    stop: threading.Event, scope: str = "") -> None:
         rv = ""
         while not stop.is_set():
             try:
@@ -369,8 +388,13 @@ class ApiClient:
                 # Replay the LIST into the stream so consumers resync state
                 # that changed while the watch was down (otherwise events in
                 # the reconnect gap are lost forever — e.g. a deleted pod
-                # would hold its HBM in the ledger indefinitely).
-                q.put((kind, "RELIST", listing.get("items", []) or []))
+                # would hold its HBM in the ledger indefinitely). A
+                # name-scoped stream says so, so the relist diff stays
+                # inside its own slice of the store.
+                items = listing.get("items", []) or []
+                q.put((kind, "RELIST",
+                       {"scope": scope, "items": items} if scope
+                       else items))
                 # The path may already carry a query (the ConfigMap
                 # fieldSelector) — extend it, don't start a second one.
                 sep = "&" if "?" in path else "?"

@@ -97,6 +97,7 @@ REASON_GANG_EXPIRED = "TPUShareGangExpired"
 REASON_GANG_REAPED = "TPUShareGangReaped"
 REASON_GANG_COMMITTED = "TPUShareGangCommitted"
 REASON_QUOTA_DENIED = "TPUShareQuotaDenied"
+REASON_SLO_BURN = "TPUShareSLOBurn"
 
 
 def record(client, pod: Pod, reason: str, message: str,

@@ -77,11 +77,20 @@ class FakeApiServer:
     # ------------------------------------------------------------------ #
 
     def create_pod(self, raw: dict) -> Pod:
+        import datetime
+
         with self._lock:
             pod = _dcopy(raw)
             meta = pod.setdefault("metadata", {})
             meta.setdefault("namespace", "default")
             meta.setdefault("uid", f"uid-{next(self._uid)}")
+            # Like the real apiserver: every object gets a creation
+            # stamp (the pod-journey SLO clock starts here). Tests may
+            # pre-set it to model pods that have been Pending a while.
+            meta.setdefault(
+                "creationTimestamp",
+                datetime.datetime.now(datetime.timezone.utc).strftime(
+                    "%Y-%m-%dT%H:%M:%SZ"))
             key = f"{meta['namespace']}/{meta['name']}"
             if key in self._pods:
                 raise ConflictError(reason=f"pod {key} already exists")

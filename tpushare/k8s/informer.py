@@ -154,8 +154,17 @@ class InformerHub:
                 if event_type == "RELIST":
                     # Watch stream reconnected: diff the fresh LIST against
                     # the store and synthesize the events missed in the gap.
+                    # A name-scoped relist (the per-ConfigMap streams) diffs
+                    # only its own document's slot — an unscoped diff would
+                    # let one stream's relist "delete" the other stream's
+                    # object from the shared store.
+                    scope = ""
+                    if isinstance(raw, dict):
+                        scope = raw.get("scope") or ""
+                        raw = raw.get("items", [])
                     self._handle_relist(kind, store,
-                                        [wrapper(r) for r in raw])
+                                        [wrapper(r) for r in raw],
+                                        scope=scope)
                     continue
                 obj = wrapper(raw)
                 old = store.get(Store.key_of(obj))
@@ -178,7 +187,8 @@ class InformerHub:
         q = self._watch_queue
         return q is None or q.unfinished_tasks == 0
 
-    def _handle_relist(self, kind: str, store: Store, objs: list) -> None:
+    def _handle_relist(self, kind: str, store: Store, objs: list,
+                       scope: str = "") -> None:
         # Lazy import (controller idiom): metrics pulls prometheus_client,
         # which informer consumers like the device plugin don't need at
         # import time.
@@ -188,7 +198,8 @@ class InformerHub:
         stale = {k: o for k, o in
                  ((key, store.get(key)) for key in
                   [Store.key_of(o) for o in store.list()])
-                 if k not in fresh and o is not None}
+                 if k not in fresh and o is not None
+                 and (not scope or getattr(o, "name", "") == scope)}
         for obj in objs:
             old = store.get(Store.key_of(obj))
             store.upsert(obj)

@@ -53,12 +53,9 @@ def _fmt(dt: datetime) -> str:
 
 
 def _parse(raw: str) -> datetime | None:
-    for fmt in (_RFC3339, "%Y-%m-%dT%H:%M:%SZ"):
-        try:
-            return datetime.strptime(raw, fmt).replace(tzinfo=timezone.utc)
-        except (ValueError, TypeError):
-            continue
-    return None
+    # Shared with the pod-journey clock: one format-tolerance story.
+    from tpushare.utils.k8stime import parse_rfc3339
+    return parse_rfc3339(raw)
 
 
 class LeaderElector:

@@ -86,6 +86,19 @@ def safe_inc(counter, n: float = 1) -> None:
     # vet: ignore[swallowed-telemetry-error]
     except Exception:  # pragma: no cover - metrics must not throw
         pass
+
+
+def safe_observe(histogram, value: float) -> None:
+    """Histogram twin of :func:`safe_inc`: an observation that can
+    never break the calling code path."""
+    try:
+        histogram.observe(value)
+    # Same drop guard as safe_inc — it cannot count itself.
+    # vet: ignore[swallowed-telemetry-error]
+    except Exception:  # pragma: no cover - metrics must not throw
+        pass
+
+
 GANGS_REAPED = Counter(
     "tpushare_gangs_reaped_total",
     "Gangs whose below-quorum survivors were reclaimed by the "
@@ -244,6 +257,71 @@ TELEMETRY_ERRORS = Counter(
     registry=REGISTRY,
 )
 
+# -- Pod-journey SLOs (tpushare/slo/, docs/slo.md) ------------------------- #
+
+#: Journey latencies run from sub-second (an idle fleet binds in one
+#: attempt) to many minutes (quota pressure, missing capacity) — the
+#: buckets must resolve both the 30s default objective's boundary and
+#: the long tail that burns its budget.
+_E2E_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                120.0, 300.0, 600.0, 1800.0)
+
+POD_E2E = Histogram(
+    "tpushare_pod_e2e_scheduling_seconds",
+    "End-to-end scheduling latency per pod JOURNEY: pod creation to "
+    "bound (outcome=bound), or to deletion/abandonment while still "
+    "unbound. THE user-facing latency — per-verb histograms stay flat "
+    "while a pod is denied 40 times; this one degrades. Rebuilt from "
+    "the tpushare.io/assume-time annotation after a restart",
+    ["tenant", "outcome"], registry=REGISTRY, buckets=_E2E_BUCKETS,
+)
+POD_ATTEMPTS = Histogram(
+    "tpushare_pod_scheduling_attempts",
+    "Placement attempts (flight-recorder decisions) per closed pod "
+    "journey. A rising tail means pods are retrying their way to a "
+    "bind instead of landing first try",
+    ["tenant", "outcome"], registry=REGISTRY,
+    buckets=(1, 2, 3, 5, 8, 13, 21, 40, 80),
+)
+SLO_BUDGET_REMAINING = Gauge(
+    "tpushare_slo_error_budget_remaining",
+    "Fraction of the SLO's error budget left over the 1h window (1.0 = "
+    "untouched, 0.0 = exhausted). Objectives come from the "
+    "tpushare-slos ConfigMap (built-in defaults when absent)",
+    ["slo"], registry=REGISTRY,
+)
+SLO_BURN_RATE = Gauge(
+    "tpushare_slo_burn_rate",
+    "Error-budget burn-rate multiple per rolling window (1.0 = burning "
+    "exactly at the objective's allowance). Both windows >= the SLO's "
+    "fastBurn threshold fires a rate-limited TPUShareSLOBurn Event — "
+    "see the docs/slo.md runbook",
+    ["slo", "window"], registry=REGISTRY,
+)
+
+# -- Telemetry self-observability ------------------------------------------ #
+
+SCRAPE_DURATION = Histogram(
+    "tpushare_scrape_duration_seconds",
+    "Wall time of a full /metrics scrape (gauge refresh + render). "
+    "Growth means the scrape lock is taxing the verbs that share it",
+    registry=REGISTRY, buckets=_BUCKETS,
+)
+SCRAPE_ERRORS = Counter(
+    "tpushare_scrape_errors_total",
+    "Scrapes that raised instead of rendering — Prometheus saw a gap "
+    "where a sample should be",
+    registry=REGISTRY,
+)
+TRACE_ABANDONED = Counter(
+    "tpushare_trace_abandoned_total",
+    "Open flight-recorder decisions evicted by table pressure before "
+    "any outcome (retired as 'abandoned'). Sustained growth means pods "
+    "start attempts that never finish — the recorder is losing the "
+    "ends of stories",
+    registry=REGISTRY,
+)
+
 
 def render() -> bytes:
     with _SCRAPE_LOCK:
@@ -325,41 +403,77 @@ def observe_quota(quota) -> None:
                     gauge.labels(tenant=tenant).set(entry[key])
 
 
+def observe_slo() -> None:
+    """Refresh the SLO budget/burn gauges from the engine's rolling
+    windows (this evaluation is also what fires the rate-limited
+    TPUShareSLOBurn alert). Rebuilt each scrape so a renamed or removed
+    objective drops its series instead of freezing. The journey/engine
+    drop counters are surfaced on GET /debug/slo (recordingDrops)."""
+    # Import here, not at module top: the slo package lazily imports
+    # this module on its journey-close path (same cycle-avoidance as
+    # k8s.events below).
+    from tpushare import slo as slo_mod
+
+    with _SCRAPE_LOCK:
+        SLO_BUDGET_REMAINING.clear()
+        SLO_BURN_RATE.clear()
+        for row in slo_mod.engine().evaluate():
+            SLO_BUDGET_REMAINING.labels(slo=row["slo"]).set(
+                row["errorBudgetRemaining"])
+            for window, view in row["windows"].items():
+                SLO_BURN_RATE.labels(slo=row["slo"], window=window).set(
+                    view["burnRate"])
+
+
 def scrape(cache, gang_planner=None, leader=None, demand=None,
            workqueue=None, quota=None) -> bytes:
-    """Atomic observe+render for the /metrics handler."""
+    """Atomic observe+render for the /metrics handler, timed and
+    error-counted (a scrape that raises is a sample Prometheus never
+    saw — that loss must itself be countable)."""
     # Import here, not at module top: events.py imports this module for
     # its drop counter, and a top-level back-import would cycle.
     from tpushare.k8s import events as k8s_events
+    import time as _time
 
-    with _SCRAPE_LOCK:
-        observe_cache(cache)
-        if quota is not None:
-            observe_quota(quota)
-        if demand is not None:
-            pods, hbm, chips = demand.snapshot()
-            UNSCHED_PODS.set(pods)
-            UNSCHED_HBM.set(hbm)
-            UNSCHED_CHIPS.set(chips)
-            for gauge in (UNSCHED_PODS_TENANT, UNSCHED_HBM_TENANT,
-                          UNSCHED_CHIPS_TENANT):
-                gauge.clear()
-            for tenant, (t_pods, t_hbm, t_chips) in \
-                    demand.by_tenant().items():
-                UNSCHED_PODS_TENANT.labels(tenant=tenant).set(t_pods)
-                UNSCHED_HBM_TENANT.labels(tenant=tenant).set(t_hbm)
-                UNSCHED_CHIPS_TENANT.labels(tenant=tenant).set(t_chips)
-        if gang_planner is not None:
-            # stats() is the cheap view (no member lists / TTL math) —
-            # this runs under the scrape lock.
-            GANGS_PENDING.set(sum(
-                1 for g in gang_planner.stats().values()
-                if not g["committed"]))
-        EVENTS_QUEUE_DEPTH.set(k8s_events.queue_depth())
-        if workqueue is not None:
-            st = workqueue.stats()
-            WORKQUEUE_DEPTH.set(st["depth"] + st["delayed"])
-            WORKQUEUE_RETRIES.set(st["retries"])
-        # Election off (single replica) => this replica is the binder.
-        IS_LEADER.set(1 if (leader is None or leader.is_leader()) else 0)
-        return render()
+    t0 = _time.perf_counter()
+    try:
+        with _SCRAPE_LOCK:
+            observe_cache(cache)
+            observe_slo()
+            if quota is not None:
+                observe_quota(quota)
+            if demand is not None:
+                pods, hbm, chips = demand.snapshot()
+                UNSCHED_PODS.set(pods)
+                UNSCHED_HBM.set(hbm)
+                UNSCHED_CHIPS.set(chips)
+                for gauge in (UNSCHED_PODS_TENANT, UNSCHED_HBM_TENANT,
+                              UNSCHED_CHIPS_TENANT):
+                    gauge.clear()
+                for tenant, (t_pods, t_hbm, t_chips) in \
+                        demand.by_tenant().items():
+                    UNSCHED_PODS_TENANT.labels(tenant=tenant).set(t_pods)
+                    UNSCHED_HBM_TENANT.labels(tenant=tenant).set(t_hbm)
+                    UNSCHED_CHIPS_TENANT.labels(tenant=tenant).set(t_chips)
+            if gang_planner is not None:
+                # stats() is the cheap view (no member lists / TTL math)
+                # — this runs under the scrape lock.
+                GANGS_PENDING.set(sum(
+                    1 for g in gang_planner.stats().values()
+                    if not g["committed"]))
+            EVENTS_QUEUE_DEPTH.set(k8s_events.queue_depth())
+            if workqueue is not None:
+                st = workqueue.stats()
+                WORKQUEUE_DEPTH.set(st["depth"] + st["delayed"])
+                WORKQUEUE_RETRIES.set(st["retries"])
+            # Election off (single replica) => this replica binds.
+            IS_LEADER.set(1 if (leader is None or leader.is_leader())
+                          else 0)
+            return render()
+    except Exception:
+        # The re-raise surfaces as the handler's HTTP 500 — Prometheus
+        # records the failed scrape; this counter records that we did.
+        safe_inc(SCRAPE_ERRORS)
+        raise
+    finally:
+        safe_observe(SCRAPE_DURATION, _time.perf_counter() - t0)

@@ -17,8 +17,13 @@ Routes:
 * ``GET  /debug/flight``    — decision flight recorder: the last N
   completed placement decisions (``?n=`` limits the dump)
 * ``GET  /debug/trace/<ns>/<pod>`` — one pod's latest decision trace
+  (``?id=<trace-id>`` resolves a specific attempt from the journey)
 * ``GET  /debug/quota``     — per-tenant quota snapshot: guarantee /
   limit / usage / borrowed (the tenancy ledger, docs/quota.md)
+* ``GET  /debug/slo``       — SLO objectives: error-budget remaining,
+  burn rates per window, journey aggregates (docs/slo.md)
+* ``GET  /debug/journey/<ns>/<pod>`` — the pod's journey: creation to
+  bound, every attempt's trace-id, queue-wait vs in-verb split
 
 The scheduling verbs run inside :mod:`tpushare.trace` phases, so every
 TPU pod's filter → prioritize → (preempt) → bind story is captured
@@ -38,10 +43,11 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import tpushare
-from tpushare import trace
+from tpushare import slo, trace
 from tpushare.api.extender import (ExtenderArgs, ExtenderBindingArgs,
                                    ExtenderPreemptionArgs,
                                    host_priority_list_to_json)
@@ -252,12 +258,30 @@ class _Handler(BaseHTTPRequestHandler):
             elif path.startswith("/debug/trace/"):
                 rest = path[len("/debug/trace/"):]
                 ns, sep, pod_name = rest.partition("/")
-                doc = (trace.get_trace(ns, pod_name)
+                trace_id = self._query().get("id", "")
+                doc = (trace.get_trace(ns, pod_name, trace_id=trace_id)
                        if sep and pod_name and "/" not in pod_name else None)
                 if doc is None:
                     self._send_json(
                         {"Error": f"no trace for {rest!r} (want "
-                                  "/debug/trace/<namespace>/<pod>)"}, 404)
+                                  "/debug/trace/<namespace>/<pod>"
+                                  "[?id=<trace-id>])"}, 404)
+                else:
+                    self._send_json(doc)
+            elif path == "/debug/slo":
+                self._send_json(slo.snapshot())
+            elif path.startswith("/debug/journey/"):
+                rest = path[len("/debug/journey/"):]
+                ns, sep, pod_name = rest.partition("/")
+                doc = (slo.get_journey(ns, pod_name)
+                       if sep and pod_name and "/" not in pod_name else None)
+                if doc is None:
+                    self._send_json(
+                        {"Error": f"no journey for {rest!r} (want "
+                                  "/debug/journey/<namespace>/<pod>; "
+                                  "the tracker keeps the last "
+                                  f"~{slo.journey.DEFAULT_CAPACITY} "
+                                  "closed journeys)"}, 404)
                 else:
                     self._send_json(doc)
             elif path in ("/debug/threads", "/debug/pprof/goroutine"):
@@ -305,12 +329,16 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 metrics.FILTER_REQUESTS.inc()
                 args = ExtenderArgs.from_json(doc)
+                t0 = time.perf_counter()
                 with metrics.FILTER_LATENCY.time(), \
                         trace.phase("filter", args.pod.namespace,
                                     args.pod.name, args.pod.uid,
                                     enabled=_traced_pod(args.pod)) as dec:
                     result = self.server.predicate.handle(args)
                 if dec is not None:
+                    # The per-verb half of the SLO story: one filter
+                    # observation for the filter-latency objective ...
+                    slo.observe_filter(time.perf_counter() - t0)
                     passed = (result.node_names
                               if result.node_names is not None
                               else [n.name for n in (result.nodes or [])])
@@ -322,6 +350,11 @@ class _Handler(BaseHTTPRequestHandler):
                         trace.complete(
                             dec, "unschedulable",
                             error="rejected on every candidate node")
+                    # ... and the journey half: link this attempt's
+                    # trace-id (opening the journey if the informer has
+                    # not — first filter wins the race, per docs/slo.md).
+                    slo.note_decision(args.pod.namespace, args.pod.name,
+                                      args.pod.uid, dec, pod=args.pod)
                 self._send_json(result.to_json())
             elif path == f"{prefix}/prioritize":
                 doc = self._read_json()
@@ -405,6 +438,14 @@ class _Handler(BaseHTTPRequestHandler):
                                    error=result.error)
                 else:
                     trace.complete(dec, "bound", node=args_parsed.node)
+                # Journey: link the attempt; a bound outcome closes the
+                # pod's journey (open_new=False — a bind with no journey
+                # is the restart case, owned by the controller's
+                # annotation-truth reconstruction).
+                slo.note_decision(args_parsed.pod_namespace,
+                                  args_parsed.pod_name,
+                                  args_parsed.pod_uid, dec,
+                                  open_new=False)
                 # Reference returns HTTP 500 when bind fails
                 # (routes.go:139-143) so the scheduler retries.
                 self._send_json(result.to_json(), 500 if result.error else 200)

@@ -80,8 +80,9 @@ def flight(limit: int | None = None) -> list[dict]:
     return _recorder.flight(limit)
 
 
-def get_trace(namespace: str, name: str) -> dict | None:
-    return _recorder.get_trace(namespace, name)
+def get_trace(namespace: str, name: str,
+              trace_id: str = "") -> dict | None:
+    return _recorder.get_trace(namespace, name, trace_id=trace_id)
 
 
 def _on_contention(site: str, waited_s: float) -> None:

@@ -256,12 +256,25 @@ class FlightRecorder:
                 self._ring.append(dec)
                 dec = None
             if dec is None:
+                abandoned = 0
                 while len(self._open) >= self._max_open:
                     oldest = min(self._open,
                                  key=lambda k: self._open[k].started_at)
                     evicted = self._open.pop(oldest)
                     evicted.finish("abandoned")
                     self._ring.append(evicted)
+                    abandoned += 1
+                if abandoned:
+                    # Table-pressure evictions were SILENT before the
+                    # SLO PR: stories losing their endings with no
+                    # metric. Lazy import keeps this module free of
+                    # prometheus at import time (its design contract).
+                    try:
+                        from tpushare.routes import metrics
+                        metrics.safe_inc(metrics.TRACE_ABANDONED,
+                                         abandoned)
+                    except Exception:  # noqa: BLE001 - must not throw
+                        self.drops.inc()
                 dec = Decision(new_trace_id(), namespace, name, uid)
                 self._open[key] = dec
             elif uid and not dec.uid:
@@ -343,10 +356,23 @@ class FlightRecorder:
             decisions = decisions[-limit:]
         return [d.to_json() for d in reversed(decisions)]
 
-    def get_trace(self, namespace: str, name: str) -> dict | None:
+    def get_trace(self, namespace: str, name: str,
+                  trace_id: str = "") -> dict | None:
         """The most recent decision for ``namespace/name``: completed
-        attempts win (newest first), else the still-open attempt."""
+        attempts win (newest first), else the still-open attempt. With
+        ``trace_id``, return exactly that attempt — the pod-journey
+        surface lists every attempt's id, and each must resolve here
+        for as long as the ring holds it."""
         with self._lock:
+            if trace_id:
+                dec = self._open.get((namespace, name))
+                if dec is not None and dec.trace_id == trace_id:
+                    return dec.to_json()
+                for dec in reversed(self._ring):
+                    if (dec.namespace == namespace and dec.name == name
+                            and dec.trace_id == trace_id):
+                        return dec.to_json()
+                return None
             for dec in reversed(self._ring):
                 if dec.namespace == namespace and dec.name == name:
                     return dec.to_json()

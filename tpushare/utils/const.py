@@ -126,6 +126,20 @@ QUOTA_CONFIGMAP = "tpushare-quotas"
 QUOTA_DEFAULT_KEY = "*"
 
 # --------------------------------------------------------------------------
+# Pod-journey SLOs (tpushare/slo/): end-to-end scheduling latency
+# objectives, error budgets, and burn-rate alerting.
+# --------------------------------------------------------------------------
+
+#: Name of the ConfigMap declaring SLO objectives (watched through the
+#: informer from the namespace pinned by ``TPUSHARE_SLO_NAMESPACE``,
+#: default kube-system — the same trust model as QUOTA_CONFIGMAP). Each
+#: data key is an SLO name; each value a JSON object with ``signal``
+#: (``pod_e2e`` or ``filter_latency``), ``objective`` (e.g. 0.99),
+#: ``thresholdSeconds``, and optional ``fastBurn``. Absent ConfigMap =
+#: the built-in defaults in tpushare/slo/config.py. See docs/slo.md.
+SLO_CONFIGMAP = "tpushare-slos"
+
+# --------------------------------------------------------------------------
 # Gang scheduling (pod groups spanning a multi-host slice).
 # --------------------------------------------------------------------------
 

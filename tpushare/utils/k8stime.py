@@ -1,0 +1,37 @@
+"""Apiserver RFC-3339 timestamp parsing — one shared implementation.
+
+Kubernetes serializes ``metadata.creationTimestamp`` and Lease
+``renewTime`` in two RFC-3339 shapes (with and without fractional
+seconds, always Zulu). The leader elector and the pod-journey clock
+both consume them; a single parser keeps the two clocks from ever
+diverging on format tolerance.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+
+#: The shape this codebase WRITES (Lease renewTime).
+RFC3339_FRACTIONAL = "%Y-%m-%dT%H:%M:%S.%fZ"
+_FORMATS = (RFC3339_FRACTIONAL, "%Y-%m-%dT%H:%M:%SZ")
+
+
+def parse_rfc3339(raw: str) -> datetime | None:
+    """Apiserver timestamp -> aware UTC datetime, or None when absent
+    or unparseable (callers choose their own fallback clock)."""
+    for fmt in _FORMATS:
+        try:
+            return datetime.strptime(raw, fmt).replace(
+                tzinfo=timezone.utc)
+        # Format probe, not a swallowed observation: the None sentinel
+        # is the loud, typed "could not parse" answer.
+        # vet: ignore[swallowed-telemetry-error]
+        except (ValueError, TypeError):
+            continue
+    return None
+
+
+def parse_rfc3339_epoch(raw: str) -> float:
+    """Same parse, as epoch seconds; 0.0 when absent/unparseable."""
+    dt = parse_rfc3339(raw)
+    return dt.timestamp() if dt is not None else 0.0
