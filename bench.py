@@ -238,9 +238,25 @@ def run_churn(scored: bool, seed: int = 42):
 
     large_bound = sum(1 for rec in live if rec["kind"] == "chip")
     large_blocked = sum(1 for item in backlog if item["kind"] == "chip")
+    # Fragmentation at end of churn: how much of the FLEET's capacity
+    # sits free-but-unusable for the still-backlogged demand? Same math
+    # the extender exports as tpushare_cluster_stranded_hbm_gib
+    # (tpushare/defrag/frag.py), against the filter verb's live
+    # DemandTracker shapes — normalized by total HBM, not by free HBM:
+    # on a saturating mix dominated by 4-chip pods, free capacity is
+    # ~all splinters (stranded/free ≈ 1.0 by construction), while
+    # stranded/total separates a tight packer (~1%) from a scattering
+    # regression (unscored spreading strands ~30% of the fleet).
+    from tpushare.defrag import frag
+    infos = fleet.stack.controller.cache.sharing_node_infos()
+    frag_report = frag.cluster_report(
+        infos, fleet.stack.predicate.demand.shapes())
+    total_hbm = sum(i.total_hbm for i in infos)
+    stranded_ratio = (frag_report["strandedHBM"] / total_hbm
+                      if total_hbm else 0.0)
     fleet.close()
     return (statistics.mean(samples), latencies, bound,
-            large_bound, large_blocked, verb_ms)
+            large_bound, large_blocked, verb_ms, stranded_ratio)
 
 
 def bench_gang(hosts: int = 16,
@@ -590,6 +606,13 @@ GATE_P99_MS = 6.0
 #: Per-verb gates above catch a slow HANDLER; this one catches a slow
 #: EXPERIENCE (verbs flat while pods retry for minutes).
 GATE_POD_E2E_P99_S = 30.0
+#: Fragmentation gate (the defrag PR): end-of-churn stranded HBM as a
+#: fraction of FLEET capacity (see run_churn). The scored packer lands
+#: ~0.01 (98%+ util leaves almost nothing free, splinters included);
+#: the unscored least-allocated spreader strands ~0.3 of the fleet. A
+#: gate at 0.15 catches a policy change that starts scattering slices
+#: long before it shows up as a utilization headline drop.
+GATE_STRANDED_RATIO = 0.15
 
 
 def _pod_e2e_p99_s() -> float | None:
@@ -620,7 +643,8 @@ def _pod_e2e_p99_s() -> float | None:
     return float("inf")  # pragma: no cover - +Inf bucket always >= count
 
 
-def _gates(p50: float, p99: float, pod_e2e_p99: float | None) -> dict:
+def _gates(p50: float, p99: float, pod_e2e_p99: float | None,
+           stranded_ratio: float | None = None) -> dict:
     import os
     try:
         load1 = round(os.getloadavg()[0], 2)
@@ -637,6 +661,11 @@ def _gates(p50: float, p99: float, pod_e2e_p99: float | None) -> dict:
                           "limit": GATE_POD_E2E_P99_S,
                           "pass": (pod_e2e_p99 is None
                                    or pod_e2e_p99 <= GATE_POD_E2E_P99_S)},
+        "stranded_hbm_ratio": {"value": stranded_ratio,
+                               "limit": GATE_STRANDED_RATIO,
+                               "pass": (stranded_ratio is None
+                                        or stranded_ratio
+                                        <= GATE_STRANDED_RATIO)},
         "loadavg_1m": load1,
     }
 
@@ -654,8 +683,9 @@ def main() -> None:
     logging.disable(logging.WARNING)
 
     (scored_util, latencies, bound,
-     s_large, s_blocked, verb_ms) = run_churn(scored=True)
-    unscored_util, _, _, u_large, u_blocked, _ = run_churn(scored=False)
+     s_large, s_blocked, verb_ms, stranded_ratio) = run_churn(scored=True)
+    (unscored_util, _, _, u_large, u_blocked, _,
+     _u_stranded) = run_churn(scored=False)
     gang_ms, gang_wave_ms, gang_hosts = bench_gang()
     preempt_ms = bench_preempt()
     gang_preempt_ms, gang_preempt_victims = bench_gang_preempt()
@@ -667,7 +697,7 @@ def main() -> None:
     p50 = statistics.median(latencies)
     p99 = latencies[int(len(latencies) * 0.99) - 1]
     pod_e2e_p99 = _pod_e2e_p99_s()
-    gates = _gates(p50, p99, pod_e2e_p99)
+    gates = _gates(p50, p99, pod_e2e_p99, stranded_ratio)
     doc = {
         "metric": "hbm_binpack_utilization",
         "value": round(scored_util, 2),
@@ -689,6 +719,11 @@ def main() -> None:
         # medians cannot see — a pod retried across churn rounds ages
         # here while filter/bind stay flat (docs/slo.md).
         "pod_e2e_p99_s": pod_e2e_p99,
+        # End-of-churn fragmentation: stranded HBM (free but unusable
+        # by the blocked demand) as a fraction of fleet capacity
+        # (tpushare/defrag/frag.py math over the live ledger +
+        # DemandTracker — docs/defrag.md).
+        "stranded_hbm_ratio": round(stranded_ratio, 4),
         "gates": gates,
         "pods_bound": bound,
         "nodes": NODES,

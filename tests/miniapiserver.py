@@ -138,6 +138,8 @@ class MiniApiServer:
 
 _POD_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)$")
 _BIND_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding$")
+_EVICT_RE = re.compile(
+    r"^/api/v1/namespaces/([^/]+)/pods/([^/]+)/eviction$")
 _PODS_NS_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/pods$")
 _EVENTS_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/events$")
 _NODE_RE = re.compile(r"^/api/v1/nodes/([^/]+)$")
@@ -247,6 +249,22 @@ def _make_handler(server: MiniApiServer):
                 self._status_error(401, "Unauthorized")
                 return
             path = self.path.split("?", 1)[0]
+            m = _EVICT_RE.match(path)
+            if m:
+                # pods/eviction subresource: the defrag executor's (and
+                # the watchdog's) PDB-honoring kill path. This store
+                # holds no PDBs, so eviction == delete; 429 injection
+                # lives in FakeApiServer, which models budgets.
+                ns, name = m.group(1), m.group(2)
+                with store.lock:
+                    doc = store.pods.pop(f"{ns}/{name}", None)
+                    if doc is None:
+                        self._status_error(404, "NotFound")
+                        return
+                    store.bump()
+                    store.record("Pod", "DELETED", doc)
+                self._json({"kind": "Status", "status": "Success"}, 201)
+                return
             m = _BIND_RE.match(path)
             if m:
                 ns, name = m.group(1), m.group(2)

@@ -1,0 +1,643 @@
+"""Defragmentation tests: stranded-HBM detection, the rebalance
+planner's invariants (gang-atomic, quota-safe, checkpoint-aware,
+budgeted), and the executor's posture contract (dry-run evicts nothing;
+active migrates under budgets; a burning SLO aborts the plan).
+
+The acceptance story (ISSUE 5): a fragmented fake cluster where a
+pending pod is unschedulable despite sufficient total free HBM → the
+frag index flags stranded capacity → the planner emits a gang-safe,
+quota-safe plan → the active-mode executor migrates over the
+miniapiserver and the pending pod binds.
+"""
+
+import json
+import time
+
+import pytest
+
+from tpushare import slo, trace
+from tpushare.cache.cache import SchedulerCache
+from tpushare.defrag import frag
+from tpushare.defrag.executor import DefragExecutor
+from tpushare.defrag.planner import RebalancePlanner
+from tpushare.k8s import events, eviction
+from tpushare.k8s.builders import make_node, make_pod
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.api.objects import Pod
+from tpushare.quota.manager import QuotaManager
+from tpushare.routes import metrics
+from tpushare.utils import const
+
+
+def _bound(name, hbm, node, chips, uid=None, ns="default",
+           annotations=None, labels=None, hbm_chip=16):
+    """A bound, running HBM-slice pod with its full commit record."""
+    ann = {
+        const.ANN_CHIP_IDX: ",".join(str(c) for c in chips),
+        const.ANN_HBM_POD: str(hbm),
+        const.ANN_HBM_CHIP: str(hbm_chip),
+        const.ANN_ASSIGNED: const.ASSIGNED_TRUE,
+        const.ANN_ASSUME_TIME: "1",
+    }
+    ann.update(annotations or {})
+    return make_pod(name, hbm=hbm, namespace=ns, node_name=node,
+                    phase="Running", uid=uid or f"uid-{name}",
+                    annotations=ann, labels=labels)
+
+
+def _pod(name, **kw):
+    """A Pod OBJECT (make_pod returns the raw doc) for direct planner
+    and tracker calls."""
+    from tpushare.api.objects import Pod as _P
+    return _P(make_pod(name, **kw))
+
+
+def _cache(api):
+    cache = SchedulerCache(api.get_node, api.list_pods)
+    for node in api.list_nodes():
+        cache.get_node_info(node.name)
+    cache.build()
+    return cache
+
+
+def _fragmented(api):
+    """3 nodes x 4 chips x 16 GiB. n0 holds two 6-GiB slices on chips
+    0/1 (only 2 whole chips free); n1 and n2 hold one slice each (3
+    free chips). A 4-chip pod fits NOWHERE despite ~150 GiB free."""
+    for n in ("n0", "n1", "n2"):
+        api.create_node(make_node(n))
+    api.create_pod(_bound("s0", 6, "n0", [0]))
+    api.create_pod(_bound("s1", 6, "n0", [1]))
+    api.create_pod(_bound("a0", 6, "n1", [0]))
+    api.create_pod(_bound("b0", 6, "n2", [0]))
+    return _cache(api)
+
+
+def _counter(counter, **labels):
+    child = counter.labels(**labels) if labels else counter
+    return child._value.get()
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace():
+    yield
+    trace.reset()
+
+
+# ------------------------------------------------------------------------ #
+# Fragmentation index
+# ------------------------------------------------------------------------ #
+
+
+class TestFragIndex:
+    def test_stranded_against_chip_demand(self, api):
+        cache = _fragmented(api)
+        report = frag.cluster_report(cache.sharing_node_infos(),
+                                     [(0, 4)])
+        # Every free byte is stranded: no node has 4 whole chips, and
+        # no HBM-slice demand exists to take the splinters.
+        assert report["freeHBM"] == 3 * 64 - 4 * 6
+        assert report["strandedHBM"] == report["freeHBM"]
+        assert report["strandedRatio"] == 1.0
+        assert report["splinterChips"] == 4
+        by_node = {n["node"]: n for n in report["nodes"]}
+        assert by_node["n0"]["score"] == 1.0
+        assert by_node["n0"]["freeWholeChips"] == 2
+
+    def test_hbm_demand_unstrands_big_splinters(self, api):
+        cache = _fragmented(api)
+        # A pending 10-GiB slice CAN take each 10-GiB splinter and each
+        # free chip — only nothing-pending-that-fits is stranded.
+        report = frag.cluster_report(cache.sharing_node_infos(),
+                                     [(10, 0)])
+        assert report["strandedHBM"] == 0
+        # An 11-GiB slice cannot take the 10-GiB splinters.
+        report = frag.cluster_report(cache.sharing_node_infos(),
+                                     [(11, 0)])
+        assert report["strandedHBM"] == 4 * 10
+
+    def test_no_pending_demand_strands_nothing(self, api):
+        cache = _fragmented(api)
+        report = frag.cluster_report(cache.sharing_node_infos(), [])
+        assert report["strandedHBM"] == 0
+        assert report["pendingShapes"] == []
+
+    def test_demand_tracker_feeds_shapes(self, api):
+        from tpushare.scheduler.predicate import DemandTracker
+
+        tracker = DemandTracker()
+        tracker.record_unplaceable(_pod("ring", chips=4,
+                                            uid="u-ring"))
+        tracker.record_unplaceable(_pod("big", hbm=24, uid="u-big"))
+        assert tracker.shapes() == [(0, 4), (24, 0)]
+
+
+# ------------------------------------------------------------------------ #
+# Planner invariants
+# ------------------------------------------------------------------------ #
+
+
+class TestPlanner:
+    def test_plan_unblocks_whole_chip_pod(self, api):
+        cache = _fragmented(api)
+        planner = RebalancePlanner(cache)
+        pending = _pod("ring", chips=4, uid="u-ring")
+        plan = planner.plan([pending])
+        assert plan is not None
+        assert plan.unblocks == ["default/ring"]
+        # The cheapest repair: clear ONE splinter off a 3-free-chip
+        # node (n1 or n2), not two off n0.
+        assert len(plan.moves) == 1
+        move = plan.moves[0]
+        assert move.from_node in ("n1", "n2")
+        assert move.to_node != move.from_node
+        # Planned moves land in the flight recorder as defrag: spans.
+        doc = trace.get_trace(move.namespace, move.name,
+                              trace_id=move.trace_id)
+        assert doc is not None
+        assert doc["outcome"] == "defrag-planned"
+        assert doc["spans"][0]["phase"] == "defrag:plan"
+
+    def test_no_pending_no_plan(self, api):
+        cache = _fragmented(api)
+        assert RebalancePlanner(cache).plan([]) is None
+
+    def test_fitting_pod_needs_no_moves(self, api):
+        cache = _fragmented(api)
+        # 6 GiB fits the 10-GiB splinters as-is: nothing to repair.
+        plan = RebalancePlanner(cache).plan(
+            [_pod("small", hbm=6, uid="u-small")])
+        assert plan is None
+
+    def test_checkpoint_in_flight_never_moves(self, api):
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        # Both of n0's splinter pods are mid-checkpoint: no legal plan.
+        api.create_pod(_bound("c0", 6, "n0", [0], annotations={
+            const.ANN_CKPT_IN_FLIGHT: "true"}))
+        api.create_pod(_bound("c1", 6, "n0", [1], annotations={
+            const.ANN_CKPT_IN_FLIGHT: "true"}))
+        api.create_pod(_bound("a0", 6, "n1", [0]))
+        api.create_pod(_bound("a1", 6, "n1", [1]))
+        cache = _cache(api)
+        planner = RebalancePlanner(cache)
+        ok, why = planner.movable(cache.get_pod("uid-c0"))
+        assert not ok and "checkpoint" in why
+        plan = planner.plan([_pod("ring", chips=4, uid="u-ring")])
+        # The only clearable chips are n1's; their victims relocate to
+        # n0's splinters — never the checkpointing pods.
+        if plan is not None:
+            assert all(m.name not in ("c0", "c1") for m in plan.moves)
+
+    def test_quota_guarantee_is_never_cut(self, api):
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        api.create_pod(_bound("g0", 6, "n0", [0], ns="team-a"))
+        api.create_pod(_bound("g1", 6, "n0", [1], ns="team-a"))
+        api.create_pod(_bound("g2", 6, "n1", [0], ns="team-a"))
+        cache = _cache(api)
+        quota = QuotaManager()
+        from tpushare.quota import config as quota_config
+        from tpushare.api.objects import ConfigMap
+        quota.set_config(quota_config.parse_configmap(ConfigMap({
+            "metadata": {"name": const.QUOTA_CONFIGMAP,
+                         "namespace": "kube-system"},
+            "data": {"team-a": json.dumps({"guaranteeHBM": 24})}})))
+        for pod in api.list_pods():
+            quota.charge(pod)
+        planner = RebalancePlanner(cache, quota=quota)
+        # team-a's 18 GiB sit inside its 24-GiB guarantee: every pod is
+        # owed territory — immovable, so the 4-chip pod stays blocked
+        # even though clearing one splinter would free a node.
+        ok, why = planner.movable(cache.get_pod("uid-g0"))
+        assert not ok and "guarantee" in why
+        assert planner.plan([_pod("ring", chips=4, uid="u-ring")]) is None
+
+    def test_borrowed_pods_stay_movable_under_quota(self, api):
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        api.create_pod(_bound("g0", 6, "n0", [0], ns="team-a"))
+        cache = _cache(api)
+        quota = QuotaManager()
+        from tpushare.quota import config as quota_config
+        from tpushare.api.objects import ConfigMap
+        quota.set_config(quota_config.parse_configmap(ConfigMap({
+            "metadata": {"name": const.QUOTA_CONFIGMAP,
+                         "namespace": "kube-system"},
+            "data": {"team-a": json.dumps({"guaranteeHBM": 0,
+                                           "limitHBM": 64})}})))
+        quota.charge(cache.get_pod("uid-g0"))
+        planner = RebalancePlanner(cache, quota=quota)
+        # Zero guarantee: the whole holding is borrowed — movable.
+        assert planner.movable(cache.get_pod("uid-g0"))[0]
+
+    def test_planner_prefers_non_gang_repair(self, api):
+        for n in ("n0", "n1", "n2"):
+            api.create_node(make_node(n))
+        gang = {const.ANN_POD_GROUP: "ring", const.ANN_POD_GROUP_MIN: "2"}
+        api.create_pod(_bound("m0", 6, "n0", [0], annotations=gang))
+        api.create_pod(_bound("m1", 6, "n0", [1], annotations=gang))
+        api.create_pod(_bound("a0", 6, "n1", [0]))
+        api.create_pod(_bound("b0", 6, "n2", [0]))
+        cache = _cache(api)
+        plan = RebalancePlanner(cache).plan(
+            [_pod("big", chips=4, uid="u-big")])
+        assert plan is not None
+        moved = {m.name for m in plan.moves}
+        # A one-move repair exists on n1/n2; the two-member gang on n0
+        # must not be touched.
+        assert not (moved & {"m0", "m1"})
+
+    def test_gang_moves_whole_group_or_not_at_all(self, api):
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        gang = {const.ANN_POD_GROUP: "ring", const.ANN_POD_GROUP_MIN: "2"}
+        frozen = {const.ANN_CKPT_IN_FLIGHT: "true"}
+        # The ONLY repair is clearing n0's gang: n1's splinter pods are
+        # mid-checkpoint (immovable), but their chips have 10 GiB free —
+        # enough to host both relocated members.
+        api.create_pod(_bound("m0", 6, "n0", [0], annotations=gang))
+        api.create_pod(_bound("m1", 6, "n0", [1], annotations=gang))
+        api.create_pod(_bound("f0", 6, "n1", [0], annotations=frozen))
+        api.create_pod(_bound("f1", 6, "n1", [1], annotations=frozen))
+        cache = _cache(api)
+        plan = RebalancePlanner(cache).plan(
+            [_pod("big", chips=4, uid="u-big")])
+        assert plan is not None
+        moved = {m.name for m in plan.moves}
+        # ALL members move, together, and each move names its gang.
+        assert moved == {"m0", "m1"}
+        assert all(m.gang == "ring" for m in plan.moves)
+        assert all(m.to_node == "n1" for m in plan.moves)
+
+    def test_gang_with_immovable_member_pins_the_group(self, api):
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        gang = {const.ANN_POD_GROUP: "ring", const.ANN_POD_GROUP_MIN: "2"}
+        frozen = dict(gang)
+        frozen[const.ANN_CKPT_IN_FLIGHT] = "true"
+        api.create_pod(_bound("m0", 6, "n0", [0], annotations=gang))
+        api.create_pod(_bound("m1", 6, "n0", [1], annotations=frozen))
+        cache = _cache(api)
+        # m1 is mid-checkpoint: the gang cannot move, so no plan exists.
+        assert RebalancePlanner(cache).plan(
+            [_pod("big", chips=4, uid="u-big")]) is None
+
+    def test_move_budget_bounds_the_plan(self, api):
+        cache = _fragmented(api)
+        # A zero-move budget can never author a plan.
+        assert RebalancePlanner(cache, max_moves=0).plan(
+            [_pod("ring", chips=4, uid="u-ring")]) is None
+
+
+# ------------------------------------------------------------------------ #
+# Executor: modes, budgets, SLO abort
+# ------------------------------------------------------------------------ #
+
+
+def _executor(api, cache, mode, **kw):
+    kw.setdefault("burning_fn", lambda: [])
+    return DefragExecutor(cache, api, pod_lister=api.list_pods,
+                          mode=mode, **kw)
+
+
+class TestExecutor:
+    def test_off_mode_does_nothing(self, api):
+        cache = _fragmented(api)
+        api.create_pod(make_pod("ring", chips=4))
+        ex = _executor(api, cache, "off")
+        assert ex.tick() is None
+
+    def test_follower_never_plans(self, api):
+        cache = _fragmented(api)
+        api.create_pod(make_pod("ring", chips=4))
+        ex = _executor(api, cache, "active", is_leader=lambda: False)
+        assert ex.tick() is None
+        assert len(api.list_pods()) == 5
+
+    def test_dry_run_provably_evicts_nothing(self, api):
+        cache = _fragmented(api)
+        api.create_pod(make_pod("ring", chips=4))
+        before = {p.uid for p in api.list_pods()}
+        dry_before = _counter(metrics.DEFRAG_MOVES, outcome="dry-run")
+        ex = _executor(api, cache, "dry-run")
+        doc = ex.tick()
+        assert doc is not None and doc["status"] == "dry-run"
+        assert all(m["status"] == "dry-run" for m in doc["moves"])
+        # NOTHING was evicted — the fleet is byte-for-byte intact.
+        assert {p.uid for p in api.list_pods()} == before
+        assert (_counter(metrics.DEFRAG_MOVES, outcome="dry-run")
+                == dry_before + len(doc["moves"]))
+        assert ex.status()["lastPlan"]["id"] == doc["id"]
+
+    def test_active_mode_migrates(self, api):
+        cache = _fragmented(api)
+        api.create_pod(make_pod("ring", chips=4))
+        evicted_before = _counter(metrics.DEFRAG_MOVES, outcome="evicted")
+        ex = _executor(api, cache, "active")
+        doc = ex.tick()
+        assert doc is not None and doc["status"] == "executed"
+        assert doc["moves"] and all(m["status"] == "evicted"
+                                    for m in doc["moves"])
+        gone = {m["pod"].split("/", 1)[1] for m in doc["moves"]}
+        live = {p.name for p in api.list_pods()}
+        assert not (gone & live)
+        assert (_counter(metrics.DEFRAG_MOVES, outcome="evicted")
+                == evicted_before + len(doc["moves"]))
+        # Every executed move emitted a TPUShareDefragMove Event.
+        assert events.flush()
+        reasons = [e["reason"] for _, e in api.events]
+        assert reasons.count(events.REASON_DEFRAG_MOVE) == len(doc["moves"])
+
+    def test_burning_slo_aborts_in_flight_plan(self, api):
+        """The acceptance clause: a burning SLO aborts an IN-FLIGHT
+        plan — the first move lands, the rest are cancelled, and
+        tpushare_defrag_plans_aborted_total{reason="slo-burn"} ticks."""
+        for n in ("n0", "n1", "n2"):
+            api.create_node(make_node(n))
+        # Two independent 1-move repairs (two pending 4-chip pods), so
+        # the plan holds >= 2 moves and can be aborted between them.
+        api.create_pod(_bound("a0", 6, "n1", [0]))
+        api.create_pod(_bound("b0", 6, "n2", [0]))
+        api.create_pod(_bound("s0", 6, "n0", [0]))
+        api.create_pod(_bound("s1", 6, "n0", [1]))
+        cache = _cache(api)
+        api.create_pod(make_pod("ring-a", chips=4, uid="u-ra"))
+        api.create_pod(make_pod("ring-b", chips=4, uid="u-rb"))
+        calls = []
+
+        def burn_after_first():
+            calls.append(1)
+            return [] if len(calls) == 1 else ["pod-bind-30s"]
+
+        aborted_before = _counter(metrics.DEFRAG_PLANS_ABORTED,
+                                  reason="slo-burn")
+        ex = _executor(api, cache, "active", burning_fn=burn_after_first)
+        doc = ex.tick()
+        assert doc is not None and doc["status"] == "aborted"
+        assert doc["abortReason"] == "slo-burn"
+        statuses = [m["status"] for m in doc["moves"]]
+        assert statuses[0] == "evicted"
+        assert set(statuses[1:]) == {"aborted"}
+        assert (_counter(metrics.DEFRAG_PLANS_ABORTED, reason="slo-burn")
+                == aborted_before + 1)
+        assert events.flush()
+        reasons = [e["reason"] for _, e in api.events]
+        assert events.REASON_DEFRAG_ABORTED in reasons
+
+    def test_real_engine_burn_vetoes_eviction(self, api):
+        """Same contract through the REAL SLO engine (no injection):
+        feed it journeys blowing the default 30s objective until both
+        windows burn, and the executor refuses to evict at all."""
+        cache = _fragmented(api)
+        api.create_pod(make_pod("ring", chips=4))
+        for i in range(20):
+            slo.engine().observe_pod_e2e(120.0, "bound", "default",
+                                         f"late-{i}", f"u-late-{i}")
+        assert any(r["burning"] for r in slo.engine().evaluate())
+        before = {p.uid for p in api.list_pods()}
+        ex = DefragExecutor(cache, api, pod_lister=api.list_pods,
+                            mode="active")
+        doc = ex.tick()
+        assert doc is not None and doc["status"] == "aborted"
+        assert {p.uid for p in api.list_pods()} == before
+
+    def test_hourly_budget_exhaustion_aborts_remainder(self, api):
+        for n in ("n0", "n1", "n2"):
+            api.create_node(make_node(n))
+        api.create_pod(_bound("a0", 6, "n1", [0]))
+        api.create_pod(_bound("b0", 6, "n2", [0]))
+        api.create_pod(_bound("s0", 6, "n0", [0]))
+        api.create_pod(_bound("s1", 6, "n0", [1]))
+        cache = _cache(api)
+        api.create_pod(make_pod("ring-a", chips=4, uid="u-ra"))
+        api.create_pod(make_pod("ring-b", chips=4, uid="u-rb"))
+        budget_before = _counter(metrics.DEFRAG_PLANS_ABORTED,
+                                 reason="budget")
+        ex = _executor(api, cache, "active",
+                       budget=eviction.EvictionBudget(per_hour=1))
+        doc = ex.tick()
+        assert doc is not None and doc["status"] == "aborted"
+        assert doc["abortReason"] == "budget"
+        statuses = [m["status"] for m in doc["moves"]]
+        assert statuses.count("evicted") == 1
+        assert (_counter(metrics.DEFRAG_PLANS_ABORTED, reason="budget")
+                == budget_before + 1)
+
+    def test_node_cooldown_defers_not_aborts(self, api):
+        clock = [0.0]
+        budget = eviction.EvictionBudget(node_cooldown_s=300.0,
+                                         now=lambda: clock[0])
+        budget.acquire("n1")
+        budget.release("n1", evicted=True)  # n1 cooling down
+        cache = _fragmented(api)
+        api.create_pod(make_pod("ring", chips=4))
+        ex = _executor(api, cache, "active", budget=budget)
+        plan = ex.build_plan()
+        assert plan is not None
+        n1_moves = [m for m in plan.moves if m.from_node == "n1"]
+        assert n1_moves  # the cheapest repair clears n1's splinter
+        ex.execute(plan)
+        for move in n1_moves:
+            assert move.status == "deferred"
+        assert plan.status != "aborted"
+
+    def test_frag_gauges_rebuilt_by_scrape(self, api):
+        cache = _fragmented(api)
+        api.create_pod(make_pod("ring", chips=4))
+        ex = _executor(api, cache, "dry-run")
+        text = metrics.scrape(cache, defrag=ex).decode()
+        assert "tpushare_cluster_stranded_hbm_gib 168.0" in text
+        assert ('tpushare_node_frag_score{node="n0"} 1.0' in text)
+
+    def test_debug_defrag_route(self, api):
+        import urllib.request
+        from tpushare.routes.server import (ExtenderHTTPServer,
+                                            serve_forever)
+        from tpushare.scheduler.inspect import Inspect
+        from tpushare.scheduler.predicate import Predicate
+
+        cache = _fragmented(api)
+        api.create_pod(make_pod("ring", chips=4))
+        ex = _executor(api, cache, "dry-run")
+        ex.tick()
+        server = ExtenderHTTPServer(
+            ("127.0.0.1", 0), Predicate(cache), None,
+            Inspect(cache), defrag=ex)
+        serve_forever(server)
+        try:
+            host, port = server.server_address[:2]
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/defrag") as resp:
+                doc = json.loads(resp.read())
+            assert doc["mode"] == "dry-run"
+            assert doc["frag"]["strandedHBM"] > 0
+            assert doc["lastPlan"]["moves"]
+            assert doc["budget"]["perHour"] >= 0
+        finally:
+            server.shutdown()
+
+
+# ------------------------------------------------------------------------ #
+# The evict→recreate race the migrate flow exercises
+# ------------------------------------------------------------------------ #
+
+
+class TestEvictRecreateRace:
+    def test_informer_delete_is_uid_guarded(self):
+        """A stale DELETED for the evicted instance must not clobber a
+        recreated same-name pod from the lister (store keys are
+        ns/name; a delete names one specific uid)."""
+        from tpushare.k8s.informer import Store
+
+        store = Store()
+        old = Pod({"metadata": {"name": "a0", "namespace": "default",
+                                "uid": "u-old"}})
+        new = Pod({"metadata": {"name": "a0", "namespace": "default",
+                                "uid": "u-new"}})
+        store.upsert(new)          # recreate observed first
+        store.delete(old)          # then the stale delete arrives
+        assert store.get("default/a0").uid == "u-new"
+        store.delete(new)          # deleting the live instance works
+        assert store.get("default/a0") is None
+
+    def test_sync_frees_dead_instance_behind_recreated_name(self, api):
+        """Out-of-order informer delivery: the recreated successor is
+        already in the apiserver when the old instance's delete syncs —
+        the dead uid's ledger entry must still be freed (or its chips
+        haunt the old node forever) while the successor is untouched."""
+        from tpushare.controller.controller import Controller
+
+        api.create_node(make_node("n0"))
+        api.create_node(make_node("n1"))
+        controller = Controller(api)
+        old = Pod(_bound("a0", 6, "n0", [0], uid="u-old"))
+        controller.cache.add_or_update_pod(old)
+        # The recreated successor, already re-bound on ANOTHER node.
+        api.create_pod(_bound("a0", 6, "n1", [0], uid="u-new"))
+        with controller._removed_lock:
+            controller._removed["default/a0"] = old
+        controller.sync_pod("default/a0")
+        assert controller.cache.get_pod("u-old") is None
+        assert controller.cache.get_pod("u-new") is not None
+        n0 = controller.cache.peek_node_info("n0")
+        assert n0.get_available_hbm()[0] == 16  # u-old's chip freed
+        n1 = controller.cache.get_node_info("n1")
+        assert n1.get_available_hbm()[0] == 10  # u-new untouched
+
+
+# ------------------------------------------------------------------------ #
+# The e2e acceptance story, over the real wire (miniapiserver)
+# ------------------------------------------------------------------------ #
+
+
+class TestAcceptanceStory:
+    def test_fragment_plan_migrate_bind(self):
+        import http.client
+        import urllib.request
+
+        from tests.miniapiserver import MiniApiServer
+        from tpushare.cmd.main import serve_stack, shutdown_stack
+        from tpushare.k8s.client import ApiClient, ClusterConfig
+
+        server = MiniApiServer().start()
+        stack = http_server = None
+        try:
+            for n in ("n0", "n1", "n2"):
+                server.seed_node(make_node(n))
+            server.seed_pod(_bound("s0", 6, "n0", [0]))
+            server.seed_pod(_bound("s1", 6, "n0", [1]))
+            server.seed_pod(_bound("a0", 6, "n1", [0]))
+            server.seed_pod(_bound("b0", 6, "n2", [0]))
+            client = ApiClient(ClusterConfig(
+                host=f"http://127.0.0.1:{server.port}"))
+            stack, http_server = serve_stack(client)
+            host, port = http_server.server_address[:2]
+            conn = http.client.HTTPConnection(host, port)
+
+            def post(path, doc):
+                conn.request("POST", path, json.dumps(doc).encode(),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                return resp.status, json.loads(resp.read())
+
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://{host}:{port}{path}") as resp:
+                    return json.loads(resp.read())
+
+            # 1. The pending pod is unschedulable DESPITE free HBM.
+            ring = client.create_pod(make_pod("ring", chips=4))
+            names = ["n0", "n1", "n2"]
+            _, result = post("/tpushare-scheduler/filter",
+                             {"Pod": ring.raw, "NodeNames": names})
+            assert result["NodeNames"] == []
+            inspect_doc = get("/tpushare-scheduler/inspect")
+            assert sum(n["totalHBM"] - n["usedHBM"]
+                       for n in inspect_doc["nodes"]) >= 64
+
+            # 2. The frag index flags the stranding (fed by the
+            #    DemandTracker entry the failed filter just recorded).
+            defrag_doc = get("/debug/defrag")
+            assert defrag_doc["frag"]["strandedHBM"] > 0
+            assert defrag_doc["frag"]["strandedRatio"] == 1.0
+
+            # 3+4. Active-mode executor plans and migrates over the
+            #      real wire (pods/eviction on the miniapiserver).
+            executor = stack.controller.defrag
+            executor.mode = "active"
+            plan_doc = executor.tick()
+            assert plan_doc is not None
+            assert plan_doc["status"] == "executed"
+            assert all(m["status"] == "evicted"
+                       for m in plan_doc["moves"])
+            assert stack.controller.wait_idle(timeout=10)
+
+            # The owner (this test, playing the Job controller)
+            # recreates each evicted pod; the scheduler lands it on the
+            # planned destination.
+            for move in plan_doc["moves"]:
+                ns, name = move["pod"].split("/", 1)
+                fresh = client.create_pod(make_pod(name, hbm=6,
+                                                   namespace=ns))
+                _, refilter = post("/tpushare-scheduler/filter",
+                                   {"Pod": fresh.raw,
+                                    "NodeNames": [move["to"]]})
+                assert refilter["NodeNames"] == [move["to"]], refilter
+                status, bound = post("/tpushare-scheduler/bind", {
+                    "PodName": name, "PodNamespace": ns,
+                    "PodUID": fresh.uid, "Node": move["to"]})
+                assert status == 200, bound
+
+            # 5. The pending pod now passes the filter and binds.
+            assert stack.controller.wait_idle(timeout=10)
+            _, result = post("/tpushare-scheduler/filter",
+                             {"Pod": ring.raw, "NodeNames": names})
+            assert len(result["NodeNames"]) == 1, result
+            target = result["NodeNames"][0]
+            status, bound = post("/tpushare-scheduler/bind", {
+                "PodName": "ring", "PodNamespace": "default",
+                "PodUID": ring.uid, "Node": target})
+            assert status == 200, bound
+            assert client.get_pod("default", "ring").node_name == target
+
+            # 6. The story is auditable: the move Events reached the
+            #    apiserver and each move's trace-id resolves.
+            assert events.flush()
+            reasons = [e.get("reason") for e in server.store.events]
+            assert events.REASON_DEFRAG_MOVE in reasons
+            for move in plan_doc["moves"]:
+                ns, name = move["pod"].split("/", 1)
+                doc = get(f"/debug/trace/{ns}/{name}"
+                          f"?id={move['traceId']}")
+                assert doc["outcome"] == "defrag-planned"
+            conn.close()
+        finally:
+            if stack is not None:
+                shutdown_stack(stack, http_server)
+            server.close()

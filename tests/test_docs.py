@@ -16,6 +16,7 @@ METRICS_PY = os.path.join(REPO_ROOT, "tpushare", "routes", "metrics.py")
 OBSERVABILITY_MD = os.path.join(REPO_ROOT, "docs", "observability.md")
 QUOTA_MD = os.path.join(REPO_ROOT, "docs", "quota.md")
 SLO_MD = os.path.join(REPO_ROOT, "docs", "slo.md")
+DEFRAG_MD = os.path.join(REPO_ROOT, "docs", "defrag.md")
 
 _METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
 
@@ -66,7 +67,7 @@ def test_observability_doc_covers_the_surfaces():
         doc = f.read()
     for needle in ("/debug/flight", "/debug/trace/", "/debug/pprof/mutex",
                    "TPUSHARE_LOG_JSON", "tpushare.io/trace-id",
-                   "/debug/quota"):
+                   "/debug/quota", "/debug/defrag"):
         assert needle in doc, needle
 
 
@@ -125,6 +126,45 @@ def test_slo_doc_covers_the_contract():
         f"SLO/journey metrics absent from docs/slo.md: {missing}")
 
 
+def test_defrag_doc_covers_the_contract():
+    """docs/defrag.md is the rebalancer contract: it must keep naming
+    the mode env (with all three postures), the index math terms, the
+    planner invariants, the abort/budget machinery with its Events and
+    runbook, the surfaces, and every frag/defrag metric the code
+    registers."""
+    with open(DEFRAG_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("TPUSHARE_DEFRAG_MODE", "off", "dry-run", "active",
+                   "stranded", "splinter", "packingRatio",
+                   "what-if", "Gang-atomic", "Quota-safe",
+                   "tpushare.io/checkpoint-in-flight",
+                   "TPUSHARE_DEFRAG_MOVES_PER_HOUR",
+                   "TPUSHARE_DEFRAG_NODE_COOLDOWN_S",
+                   "pods/eviction", "eviction-without-budget",
+                   "TPUShareDefragMove", "TPUShareDefragAborted",
+                   "slo-burn", "/debug/defrag",
+                   "kubectl inspect tpushare defrag",
+                   "--example-defrag", "stranded_hbm_ratio",
+                   "Runbook", "defrag:plan", "defrag:move"):
+        assert needle in doc, needle
+    defrag_metrics = [n for n in registered_metric_names()
+                      if "defrag" in n or "frag" in n or "stranded" in n]
+    assert len(defrag_metrics) >= 4
+    missing = [n for n in defrag_metrics if n not in doc]
+    assert not missing, (
+        f"defrag metrics absent from docs/defrag.md: {missing}")
+
+
+def test_defrag_doc_is_linked():
+    """observability.md (the catalogue), the README, and the user
+    guide must keep pointing at the defrag contract."""
+    for path in (OBSERVABILITY_MD,
+                 os.path.join(REPO_ROOT, "README.md"),
+                 os.path.join(REPO_ROOT, "docs", "userguide.md")):
+        with open(path, encoding="utf-8") as f:
+            assert "defrag.md" in f.read(), path
+
+
 def test_slo_doc_is_linked():
     """observability.md (the catalogue), the README, and the user
     guide must keep pointing at the SLO contract."""
@@ -148,7 +188,9 @@ if __name__ == "__main__":
                   test_quota_doc_covers_the_contract,
                   test_quota_doc_is_linked,
                   test_slo_doc_covers_the_contract,
-                  test_slo_doc_is_linked):
+                  test_slo_doc_is_linked,
+                  test_defrag_doc_covers_the_contract,
+                  test_defrag_doc_is_linked):
         try:
             check()
         except AssertionError as e:

@@ -419,6 +419,56 @@ class TestSimulator:
         assert doc["nodes"][0]["pods"] == 1
 
 
+class TestDefragCLI:
+    def test_defrag_subcommand_renders_frag_and_plan(self, api, cluster,
+                                                     capsys):
+        """`kubectl inspect tpushare defrag`: frag table + the last
+        plan with per-move statuses and trace-ids, from /debug/defrag."""
+        import kubectl_inspect_tpushare as cli
+
+        # Fragment the 2-chip fixture node: one 8-GiB slice per chip,
+        # then a whole-2-chip pod that fits nowhere.
+        for i in range(2):
+            api.create_pod(make_pod(f"frag-{i}", hbm=8))
+            assert cluster.schedule(make_pod(f"frag-{i}", hbm=8))[0]
+        api.create_pod(make_pod("whole", chips=2, uid="u-whole"))
+        bound, _ = cluster.schedule(make_pod("whole", chips=2,
+                                             uid="u-whole"))
+        assert not bound
+        # One dry-run tick publishes the plan the CLI renders. (A
+        # single node: nothing can relocate, so the plan may be None —
+        # the CLI must render that case too.)
+        cluster.stack.controller.defrag.tick()
+        assert cli.main(["--endpoint", cluster.base, "defrag"]) == 0
+        out = capsys.readouterr().out
+        assert "defrag mode: dry-run" in out
+        assert "stranded" in out
+        assert "v5e-0" in out
+        assert "budgets:" in out
+
+    def test_defrag_subcommand_404s_helpfully(self, api, capsys):
+        """Without the executor wired the route 404s and the CLI says
+        why instead of stack-tracing."""
+        import kubectl_inspect_tpushare as cli
+
+        from tpushare.routes.server import (ExtenderHTTPServer,
+                                            serve_forever)
+        from tpushare.scheduler.inspect import Inspect
+        from tpushare.scheduler.predicate import Predicate
+        from tpushare.cache.cache import SchedulerCache
+
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        server = ExtenderHTTPServer(("127.0.0.1", 0), Predicate(cache),
+                                    None, Inspect(cache))
+        serve_forever(server)
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            assert cli.main(["--endpoint", base, "defrag"]) == 1
+            assert "defrag view unavailable" in capsys.readouterr().err
+        finally:
+            server.shutdown()
+
+
 class TestCLIDemandSection:
     def test_demand_shown_when_unplaceable(self, api, cluster, capsys):
         import kubectl_inspect_tpushare as cli
@@ -437,6 +487,43 @@ class TestCLIDemandSection:
         import kubectl_inspect_tpushare as cli
         assert cli.main(["--endpoint", cluster.base]) == 0
         assert "UNPLACEABLE" not in capsys.readouterr().out
+
+
+class TestSimulateDefragScenario:
+    def test_example_defrag_fragment_plan_migrate_bind(self, capsys):
+        """The --example-defrag demo, end to end: spread-scored shards
+        fragment the fleet, a 4-chip pod is unschedulable, the
+        `defrag: active` round migrates shards and the pod binds — all
+        in one replay."""
+        import yaml
+
+        import simulate
+
+        scenario = yaml.safe_load(simulate.EXAMPLE_DEFRAG)
+        report = simulate.simulate(scenario)
+        assert report["unschedulable"] == 0, report["unschedulable_pods"]
+        defrag_doc = report["defrag"]
+        assert defrag_doc["mode"] == "active"
+        assert defrag_doc["plan"]["moves"]
+        assert all(m["rebound"] for m in defrag_doc["migrated"])
+        assert "default/ring" in defrag_doc["recovered"]
+        ring = next(p for p in report["placements"]
+                    if p["pod"] == "ring")
+        assert ring["via"] == "defrag"
+
+    def test_dry_run_scenario_reports_without_evicting(self):
+        import yaml
+
+        import simulate
+
+        scenario = yaml.safe_load(simulate.EXAMPLE_DEFRAG)
+        scenario["defrag"] = "dry-run"
+        report = simulate.simulate(scenario)
+        # The plan is reported, nothing moved, the pod stays pending.
+        assert report["defrag"]["mode"] == "dry-run"
+        assert report["defrag"]["plan"]["moves"]
+        assert report["unschedulable"] == 1
+        assert "migrated" not in report["defrag"]
 
 
 class TestDefragAdvisor:

@@ -146,6 +146,68 @@ def test_quota_package_is_strictly_typed():
     assert [v.rule for v in vs] == ["strict-typing"]
 
 
+def test_catches_eviction_without_budget():
+    """Any call into the eviction path must flow through a budget
+    object: a direct evict_pod() call outside tpushare/k8s/eviction.py
+    is the seeded defect; the budgeted helper's own call site and
+    evict_pod DEFINITIONS (client/fake implementing the subresource)
+    pass."""
+    bad = "client.evict_pod(ns, name)\n"
+    assert "eviction-without-budget" in _rules_hit(bad)
+    assert "eviction-without-budget" in _rules_hit(
+        "self.client.evict_pod(pod.namespace, pod.name)\n",
+        path="tpushare/deviceplugin/watchdog.py")
+    # the one legal home: the retry helper itself
+    assert "eviction-without-budget" not in _rules_hit(
+        bad, path="tpushare/k8s/eviction.py")
+    # defining the subresource is not calling it
+    assert "eviction-without-budget" not in _rules_hit(
+        "class ApiClient:\n"
+        "    def evict_pod(self, namespace, name):\n"
+        "        self._request('POST', 'x')\n",
+        path="tpushare/k8s/client.py")
+    # the budgeted doorway passes everywhere
+    assert "eviction-without-budget" not in _rules_hit(
+        "from tpushare.k8s import eviction\n"
+        "eviction.evict_with_retry(client, ns, name,\n"
+        "                          budget=budget, node=node)\n")
+
+
+def test_defrag_package_is_vetted():
+    """tpushare/defrag/ joined all three coverage tiers: strict typing,
+    guarded mutation (DefragExecutor/EvictionBudget state), and the
+    swallowed-telemetry contract."""
+    # strict typing
+    vs = check_source("def plan(pending):\n    return None\n",
+                      "tpushare/defrag/mod.py", TYPING_RULES)
+    assert [v.rule for v in vs] == ["strict-typing"]
+    # guarded mutation: executor plan state and the eviction budget
+    assert "unlocked-mutation" in _rules_hit(
+        "class DefragExecutor:\n"
+        "    def tick(self):\n"
+        "        self._last_plan = plan\n"
+        "        self._ticks += 1\n")
+    assert "unlocked-mutation" in _rules_hit(
+        "class EvictionBudget:\n"
+        "    def release(self, node):\n"
+        "        self._in_flight -= 1\n"
+        "        self._recent.append(1.0)\n")
+    assert "unlocked-mutation" not in _rules_hit(
+        "class DefragExecutor:\n"
+        "    def tick(self):\n"
+        "        with self._lock:\n"
+        "            self._last_plan = plan\n")
+    # swallowed telemetry: a counted drop passes, a silent one fails
+    silent = ("try:\n    pass\nexcept Exception:\n    pass\n")
+    assert "swallowed-telemetry-error" in _rules_hit(
+        silent, path="tpushare/defrag/executor.py")
+    counted = ("try:\n    pass\n"
+               "except Exception:\n"
+               "    metrics.safe_inc(metrics.DEFRAG_MOVES)\n")
+    assert "swallowed-telemetry-error" not in _rules_hit(
+        counted, path="tpushare/defrag/executor.py")
+
+
 def test_catches_bare_except():
     src = "try:\n    pass\nexcept:\n    pass\n"
     assert "bare-except" in _rules_hit(src)

@@ -225,7 +225,10 @@ def test_eviction_honors_pdb(api, tmp_path):
         "spec": {"selector": {"matchLabels": {"app": "protected"}}},
         "status": {"disruptionsAllowed": 0},
     })
-    wd = _watchdog(api, tmp_path, evict_after=2)
+    # No-op backoff sleep: this test pins the CROSS-SWEEP retry
+    # contract; the in-sweep backoff has its own tests below.
+    wd = _watchdog(api, tmp_path, evict_after=2,
+                   evict_sleep=lambda s: None)
     wd.sweep()
     doc = wd.sweep()  # streak hits the threshold, but the PDB blocks
     assert doc["evicted"] == []
@@ -238,6 +241,67 @@ def test_eviction_honors_pdb(api, tmp_path):
     assert doc["evicted"] == ["uid-hog"]
     assert events.flush()
     assert REASON_EVICTED in _event_reasons(api, "hog")
+
+
+def test_429_retry_with_backoff_actually_reattempts(api, tmp_path):
+    """The in-sweep 429 retry path, over the fake's real pods/eviction
+    semantics, through the retry helper the defrag executor shares
+    (tpushare/k8s/eviction.py): a PDB blocks the first attempt, the
+    backoff sleep fires, and the RE-ATTEMPT — not luck — completes the
+    eviction once the budget recovers mid-backoff. Before this test the
+    'retry' was only ever proven across sweeps, never within the helper."""
+    pod = _tenant("hog", 4, [0])
+    pod["metadata"]["labels"] = {"app": "protected"}
+    api.create_pod(pod)
+    _beat(tmp_path, "uid-hog", 10.0)
+    pdb = api.create_pdb({
+        "metadata": {"name": "hog-pdb", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {"app": "protected"}}},
+        "status": {"disruptionsAllowed": 0},
+    })
+    sleeps = []
+
+    def relax_pdb_on_first_backoff(seconds):
+        sleeps.append(seconds)
+        if len(sleeps) == 1:
+            pdb.raw["status"]["disruptionsAllowed"] = 1
+            api.update_pdb(pdb)
+
+    wd = _watchdog(api, tmp_path, evict_after=1,
+                   evict_sleep=relax_pdb_on_first_backoff)
+    doc = wd.sweep()
+    # One 429, one backoff sleep, then the re-attempt evicted the pod —
+    # all inside a single sweep.
+    assert sleeps, "helper never backed off before re-attempting"
+    assert doc["evicted"] == ["uid-hog"]
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        api.get_pod("default", "hog")
+    # Backoff is exponential from the helper's base, not a hot loop.
+    assert sleeps[0] > 0
+
+
+def test_429_blocked_through_every_attempt_keeps_streak(api, tmp_path):
+    """A PDB that never relents: the helper returns BLOCKED after its
+    bounded retries, the pod survives, and the streak persists so the
+    NEXT sweep retries again (the pre-existing cross-sweep contract)."""
+    pod = _tenant("hog", 4, [0])
+    pod["metadata"]["labels"] = {"app": "protected"}
+    api.create_pod(pod)
+    _beat(tmp_path, "uid-hog", 10.0)
+    api.create_pdb({
+        "metadata": {"name": "hog-pdb", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {"app": "protected"}}},
+        "status": {"disruptionsAllowed": 0},
+    })
+    sleeps = []
+    wd = _watchdog(api, tmp_path, evict_after=1,
+                   evict_sleep=sleeps.append)
+    doc = wd.sweep()
+    assert doc["evicted"] == []
+    assert len(sleeps) == 2  # 3 attempts => 2 backoffs, all blocked
+    assert api.get_pod("default", "hog") is not None
+    assert wd._over_streak["uid-hog"] >= 1  # next sweep retries
 
 
 def test_eviction_falls_back_to_delete_without_rbac(api, tmp_path):

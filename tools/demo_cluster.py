@@ -41,14 +41,15 @@ def main() -> None:
     print(f"extender listening on http://127.0.0.1:{args.port} with "
           f"{args.nodes} simulated {args.tpu_type} nodes "
           f"({args.chips} chips x {args.hbm} GiB)", flush=True)
-    print("create pods on stdin: NAME HBM_GIB  (e.g. 'demo1 8'); they are "
-          "created in the fake apiserver and scheduled via the HTTP API",
-          flush=True)
+    print("create pods on stdin: NAME HBM_GIB (e.g. 'demo1 8'), or "
+          "NAME <N>c for N whole chips (e.g. 'ring 4c' — stays Pending "
+          "when fragmented; watch /debug/defrag); they are created in "
+          "the fake apiserver and scheduled via the HTTP API", flush=True)
 
     import urllib.request
 
-    def schedule(name: str, hbm: int) -> None:
-        pod = api.create_pod(make_pod(name, hbm=hbm))
+    def schedule(name: str, hbm: int, chips: int = 0) -> None:
+        pod = api.create_pod(make_pod(name, hbm=hbm, chips=chips))
         names = [n.name for n in api.list_nodes()]
         req = urllib.request.Request(
             f"http://127.0.0.1:{args.port}/tpushare-scheduler/filter",
@@ -88,8 +89,12 @@ def main() -> None:
             parts = line.split()
             if len(parts) == 2 and parts[1].isdigit():
                 schedule(parts[0], int(parts[1]))
+            elif (len(parts) == 2 and parts[1].endswith("c")
+                    and parts[1][:-1].isdigit()):
+                schedule(parts[0], 0, chips=int(parts[1][:-1]))
             elif parts:
-                print(f"usage: NAME HBM_GIB (got {line!r})", flush=True)
+                print(f"usage: NAME HBM_GIB | NAME <N>c (got {line!r})",
+                      flush=True)
     except KeyboardInterrupt:
         pass
     shutdown_stack(stack, server)

@@ -8,8 +8,11 @@ API as a per-node, per-chip allocation table plus a cluster summary;
 subcommand renders the per-tenant guarantee/limit/usage/borrowed table
 from ``/debug/quota`` (docs/quota.md); the ``slo`` subcommand renders
 the error-budget / burn-rate table from ``/debug/slo`` (docs/slo.md);
-``explain`` heads its span timeline with the pod's journey (attempt N
-of M, cumulative queue-wait).
+the ``defrag`` subcommand renders the fragmentation index and the last
+rebalance plan (proposed vs executed vs aborted moves, with trace-ids)
+from ``/debug/defrag`` (docs/defrag.md); ``explain`` heads its span
+timeline with the pod's journey (attempt N of M, cumulative
+queue-wait).
 
 Install as a kubectl plugin by dropping an executable named
 ``kubectl-inspect_tpushare`` on PATH that execs this script, or run it
@@ -365,6 +368,88 @@ def render_slo(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def fetch_defrag(endpoint: str) -> dict | None:
+    """The fragmentation/rebalance snapshot from ``/debug/defrag``;
+    None when the extender runs without the defrag executor wired or
+    with debug routes disabled."""
+    try:
+        with urllib.request.urlopen(f"{endpoint}/debug/defrag",
+                                    timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def render_defrag(doc: dict) -> str:
+    """Frag table + the last rebalance plan (proposed vs executed vs
+    aborted moves, with trace-ids) + the eviction budgets."""
+    frag = doc.get("frag") or {}
+    lines = [
+        f"defrag mode: {doc.get('mode', '?')} "
+        f"(tick every {doc.get('intervalSeconds', '?')}s, "
+        f"max {doc.get('maxMovesPerPlan', '?')} move(s)/plan)",
+        f"cluster: {frag.get('strandedHBM', 0)} GiB stranded of "
+        f"{frag.get('freeHBM', 0)} GiB free "
+        f"(ratio {frag.get('strandedRatio', 0.0):.2f}), "
+        f"{frag.get('splinterChips', 0)} splinter chip(s), "
+        f"packing {frag.get('packingRatio', 0.0) * 100:.0f}%",
+    ]
+    shapes = frag.get("pendingShapes") or []
+    if shapes:
+        wants = ", ".join(
+            (f"{s['chips']} chip(s)" if s.get("chips")
+             else f"{s['hbm']} GiB") for s in shapes)
+        lines.append(f"pending demand shapes: {wants}")
+    nodes = frag.get("nodes") or []
+    if nodes:
+        rows = [["NODE", "FREE GiB", "STRANDED", "SPLINTERS",
+                 "FREE CHIPS", "SCORE"]]
+        for n in nodes:
+            rows.append([n["node"], str(n["freeHBM"]),
+                         str(n["strandedHBM"]), str(n["splinterChips"]),
+                         str(n["freeWholeChips"]), f"{n['score']:.2f}"])
+        widths = [max(len(r[i]) for r in rows)
+                  for i in range(len(rows[0]))]
+        lines.append("")
+        lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                  for r in rows]
+    plan = doc.get("lastPlan")
+    lines.append("")
+    if not plan:
+        lines.append("last plan: none (no pending demand a rebalance "
+                     "could unblock)")
+    else:
+        head = f"last plan {plan.get('id')}: {plan.get('status')}"
+        if plan.get("abortReason"):
+            head += f" ({plan['abortReason']})"
+        if plan.get("unblocks"):
+            head += " — unblocks " + ", ".join(plan["unblocks"])
+        lines.append(head)
+        for m in plan.get("moves", []):
+            extra = f" ({m['detail']})" if m.get("detail") else ""
+            gang = f" gang={m['gang']}" if m.get("gang") else ""
+            lines.append(f"  {m['pod']}: {m['from']} -> {m['to']} "
+                         f"[{m['status']}]{gang} "
+                         f"trace {m.get('traceId') or '-'}{extra}")
+    budget = doc.get("budget") or {}
+    lines.append(
+        f"budgets: {budget.get('usedLastHour', 0)}/"
+        f"{budget.get('perHour', 0) or '∞'} evictions this hour, "
+        f"{budget.get('inFlight', 0)}/"
+        f"{budget.get('maxConcurrent', 0) or '∞'} in flight, "
+        f"node cooldown {budget.get('nodeCooldownSeconds', 0)}s"
+        + (f" (cooling: {', '.join(budget['nodesCoolingDown'])})"
+           if budget.get("nodesCoolingDown") else ""))
+    lines.append("")
+    lines.append("Moves are proposals in dry-run mode and evictions in "
+                 "active mode (TPUSHARE_DEFRAG_MODE). Per-move WHY: "
+                 "kubectl inspect tpushare explain <pod>. Runbook: "
+                 "docs/defrag.md.")
+    return "\n".join(lines)
+
+
 def whatif_preempt(endpoint: str, hbm: int, chips: int, priority: int,
                    node: str | None) -> str:
     """Dry-run the preempt verb: which pods would a (hypothetical)
@@ -441,7 +526,9 @@ def main(argv: list[str] | None = None) -> int:
                              "trace; or the literal 'quota' for the "
                              "per-tenant guarantee/limit/usage table; "
                              "or the literal 'slo' for the error-budget "
-                             "/ burn-rate table")
+                             "/ burn-rate table; or the literal "
+                             "'defrag' for the fragmentation index and "
+                             "the last rebalance plan")
     parser.add_argument("pod", nargs="?", metavar="[ns/]pod",
                         help="with 'explain': the pod whose placement "
                              "decision to explain (namespace defaults "
@@ -486,6 +573,24 @@ def main(argv: list[str] | None = None) -> int:
                   "(DEBUG_ROUTES=0)", file=sys.stderr)
             return 1
         print(render_slo(doc))
+        return 0
+    if args.node == "defrag":
+        if args.pod:
+            print(f"unexpected argument {args.pod!r} after 'defrag'",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = fetch_defrag(args.endpoint)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        if doc is None:
+            print("defrag view unavailable — the extender runs without "
+                  "the defrag executor, or debug routes are disabled "
+                  "(DEBUG_ROUTES=0)", file=sys.stderr)
+            return 1
+        print(render_defrag(doc))
         return 0
     if args.node == "quota":
         if args.pod:

@@ -29,6 +29,11 @@ Scenario schema (YAML or JSON)::
           - {key: pool, value: tpu, effect: NoSchedule}
     execute_preemptions: true    # evict + re-schedule instead of
                                  # reporting would-be victims (optional)
+    defrag: dry-run              # after the replay, run the extender's
+                                 # rebalancer over what is still
+                                 # unschedulable: dry-run reports the
+                                 # move plan; active executes it and
+                                 # re-binds the migrants (optional)
     quotas:                      # per-tenant quota table  (optional) —
       team-a:                    # becomes the tpushare-quotas ConfigMap
         guaranteeHBM: 64         # GiB owed to the tenant
@@ -111,6 +116,31 @@ workload:
   - {count: 12, name: decode, namespace: team-serve, hbm: 16}
   - {count: 6, name: train, namespace: team-train, hbm: 16}
   - {count: 2, name: burst, namespace: team-serve, hbm: 16}
+"""
+
+
+EXAMPLE_DEFRAG = """\
+# Defragmentation demo: fragment -> plan -> migrate -> pending pod
+# binds, in one run. Eight spread-scored 6-GiB shards scatter over all
+# 16 chips' nodes (2 occupied chips per node), so a 4-chip ring pod
+# fits NOWHERE despite ~100 GiB free. `defrag: active` then runs the
+# extender's real rebalancer (tpushare/defrag/): it plans gang-safe
+# moves, evicts the victims through pods/eviction, the replay re-binds
+# them on their planned destinations (playing the Job controller), and
+# the ring pod binds on the freed node. Use `defrag: dry-run` to see
+# the plan without any eviction.
+fleet:
+  - count: 4
+    prefix: v5e
+    chips: 4
+    hbm_per_chip: 16
+defrag: active
+workload:
+  - count: 8
+    name: shard
+    hbm: 6
+    annotations: {tpushare.io/scoring: spread}
+  - {count: 1, name: ring, chips: 4}
 """
 
 
@@ -293,6 +323,15 @@ def simulate(scenario: dict) -> dict:
                                            "namespace", "default"),
                                        "node": final.node_name,
                                        "via": "gang commit"})
+        # Defragmentation round (scenario `defrag: dry-run|active`):
+        # run the extender's REAL rebalancer over whatever is still
+        # unschedulable — the offline dry-run of the fragment → plan →
+        # migrate → bind story (docs/defrag.md).
+        defrag_report = None
+        if scenario.get("defrag") and unschedulable:
+            defrag_report = _run_defrag(
+                api, client, stack, scenario["defrag"],
+                unschedulable, placements, all_nodes)
         inspect_doc = client.get("/tpushare-scheduler/inspect")
         tenants = (client.get("/debug/quota").get("tenants", [])
                    if quota_cm is not None else [])
@@ -304,7 +343,76 @@ def simulate(scenario: dict) -> dict:
         client.close()
         shutdown_stack(stack, server)
     return _report(inspect_doc, placements, held, unschedulable,
-                   latencies, executed_preemptions, tenants, slo_doc)
+                   latencies, executed_preemptions, tenants, slo_doc,
+                   defrag_report)
+
+
+def _run_defrag(api, client: _Client, stack, mode, unschedulable,
+                placements, all_nodes) -> dict:
+    """One defrag round through ``stack.controller.defrag`` (the REAL
+    executor): plan; in active mode evict, play the Job controller
+    (recreate each victim, re-bind it on its planned destination), then
+    retry the still-unschedulable pods. Mutates the ``unschedulable``
+    and ``placements`` buckets in place like the preemption executor."""
+    from tpushare.utils import const as _c
+
+    executor = stack.controller.defrag
+    executor.mode = "active" if mode is True else str(mode)
+    if executor.mode not in ("dry-run", "active"):
+        return {"error": f"defrag: unknown mode {mode!r} "
+                         "(want dry-run or active)"}
+    # Capture victims' specs BEFORE eviction deletes them.
+    originals = {f"{p.namespace}/{p.name}": p for p in api.list_pods()}
+    plan_doc = executor.tick()
+    out: dict = {"mode": executor.mode, "plan": plan_doc}
+    if plan_doc is None or executor.mode != "active":
+        return out
+    stack.controller.wait_idle(timeout=10)
+    migrated = []
+    for move in plan_doc.get("moves", []):
+        if move["status"] != "evicted":
+            continue
+        original = originals.get(move["pod"])
+        if original is None:
+            continue
+        raw = original.deepcopy().raw
+        meta = raw.setdefault("metadata", {})
+        for key in ("uid", "resourceVersion"):
+            meta.pop(key, None)
+        ann = meta.get("annotations") or {}
+        for key in _c.GRANT_ANNOTATIONS:
+            ann.pop(key, None)
+        raw.setdefault("spec", {}).pop("nodeName", None)
+        raw["status"] = {"phase": "Pending"}
+        pod = api.create_pod(raw)
+        verdict = _schedule_one(client, pod, [move["to"]])
+        migrated.append({"pod": move["pod"], "from": move["from"],
+                         "to": move["to"],
+                         "rebound": verdict["state"] == "bound"})
+    out["migrated"] = migrated
+    stack.controller.wait_idle(timeout=10)
+    # The whole point: pods the fragmentation blocked now bind.
+    recovered = []
+    from tpushare.k8s.errors import NotFoundError
+    for verdict in unschedulable[:]:
+        try:
+            pod = api.get_pod(verdict.get("namespace", "default"),
+                              verdict["pod"])
+        except NotFoundError:
+            continue
+        from tpushare.utils import node as nodeutils
+        candidates = [n.name for n in all_nodes
+                      if nodeutils.is_schedulable(n, pod)]
+        retry = _schedule_one(client, pod, candidates)
+        if retry.pop("state") == "bound":
+            unschedulable.remove(verdict)
+            retry["pod"] = pod.name
+            retry["namespace"] = pod.namespace
+            retry["via"] = "defrag"
+            placements.append(retry)
+            recovered.append(f"{pod.namespace}/{pod.name}")
+    out["recovered"] = recovered
+    return out
 
 
 def _quota_configmap(scenario: dict) -> dict | None:
@@ -426,7 +534,7 @@ def _execute_preemption(api, client: _Client, controller, pod,
 
 def _report(inspect_doc, placements, held, unschedulable,
             latencies, executed_preemptions=(), tenants=(),
-            slo_doc=None):
+            slo_doc=None, defrag_report=None):
     nodes = []
     total_hbm = used_hbm = free_whole_chips = cordoned_hbm = 0
     for n in inspect_doc.get("nodes", []):
@@ -470,6 +578,7 @@ def _report(inspect_doc, placements, held, unschedulable,
         "preemptions_executed": list(executed_preemptions),
         "tenants": list(tenants),
         "slo": slo_doc or {},
+        **({"defrag": defrag_report} if defrag_report else {}),
     }
 
 
@@ -509,6 +618,24 @@ def _print_human(report: dict) -> None:
         for p in report["preemptions_executed"]:
             print(f"  {p['pod']} -> {p['node']}: evicted "
                   f"{', '.join(p['evicted'])}")
+    defrag_doc = report.get("defrag")
+    if defrag_doc:
+        plan = defrag_doc.get("plan")
+        print(f"\ndefrag ({defrag_doc.get('mode')}):")
+        if defrag_doc.get("error"):
+            print(f"  error: {defrag_doc['error']}")
+        elif plan is None:
+            print("  no legal rebalance plan (nothing movable helps)")
+        else:
+            for m in plan.get("moves", []):
+                print(f"  move {m['pod']}: {m['from']} -> {m['to']} "
+                      f"[{m['status']}] trace {m['traceId']}")
+            for m in defrag_doc.get("migrated", []):
+                state = "re-bound" if m["rebound"] else "NOT re-bound"
+                print(f"  migrated {m['pod']} -> {m['to']} ({state})")
+            if defrag_doc.get("recovered"):
+                print("  unblocked: "
+                      + ", ".join(defrag_doc["recovered"]))
     slo_doc = report.get("slo") or {}
     journeys = slo_doc.get("journeys") or {}
     if journeys.get("closed"):
@@ -773,6 +900,10 @@ def main() -> None:
                     help="print a mixed-tenant quota-contention "
                          "scenario (borrowing, reclaim, limit denial) "
                          "and exit")
+    ap.add_argument("--example-defrag", action="store_true",
+                    help="print a defragmentation demo scenario "
+                         "(fragment -> plan -> migrate -> pending pod "
+                         "binds in one run) and exit")
     ap.add_argument("--drain", metavar="NODE",
                     help="with --defrag: ask whether NODE can be "
                          "drained — only its residents are re-packed "
@@ -790,6 +921,9 @@ def main() -> None:
         return
     if args.example_tenants:
         print(EXAMPLE_TENANTS, end="")
+        return
+    if args.example_defrag:
+        print(EXAMPLE_DEFRAG, end="")
         return
     if not args.scenario and not args.defrag:
         ap.error("scenario file required (or --example / --defrag)")

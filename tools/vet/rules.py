@@ -79,6 +79,13 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
     # scrape thread reads windows — every mutation is cross-thread.
     "JourneyTracker": ("_open", "_ring", "_closed_uids"),
     "SLOEngine": ("_events", "_burn_event_at", "_config"),
+    # Defrag (tpushare/defrag/executor.py): the tick loop mutates plan
+    # state while HTTP threads read /debug/defrag and the scrape reads
+    # the frag gauges — cross-thread like every ledger above.
+    "DefragExecutor": ("_last_plan", "_ticks", "_abort_event_at"),
+    # The shared eviction budget (tpushare/k8s/eviction.py) is hit
+    # concurrently by the defrag executor and any parallel eviction.
+    "EvictionBudget": ("_node_last", "_recent", "_in_flight"),
 }
 
 #: Method calls that mutate a dict/set/list in place.
@@ -290,7 +297,7 @@ def raw_lock(tree: ast.AST, src: str, path: str) -> list[Violation]:
 #: "quiet fleet" when the truth is "blind fleet". Every swallow must
 #: increment a drop/error counter so the loss itself is observable.
 _TELEMETRY_PATHS = ("k8s/events.py", "routes/metrics.py")
-_TELEMETRY_DIRS = ("tpushare/trace/", "tpushare/slo/")
+_TELEMETRY_DIRS = ("tpushare/trace/", "tpushare/slo/", "tpushare/defrag/")
 
 #: Call shapes that count as incrementing a drop/error counter
 #: (bare ``safe_inc(...)``, ``metrics.safe_inc(...)``, ``x.inc()``).
@@ -410,6 +417,44 @@ def unbounded_metric_cardinality(tree: ast.AST, src: str,
     return out
 
 
+# --------------------------------------------------------------------------
+# eviction-without-budget: pods/eviction flows through EvictionBudget
+# --------------------------------------------------------------------------
+
+#: The one module allowed to call ``evict_pod`` directly: the budgeted
+#: retry helper. Everything else goes through ``evict_with_retry(...,
+#: budget=...)`` so a planner bug or a hot retry loop is bounded by
+#: hard caps, not by luck.
+_EVICTION_HELPER = "k8s/eviction.py"
+
+
+@_rule("eviction-without-budget")
+def eviction_without_budget(tree: ast.AST, src: str,
+                            path: str) -> list[Violation]:
+    """Any call into the eviction path must flow through a budget
+    object: direct ``*.evict_pod(...)`` calls outside
+    ``tpushare/k8s/eviction.py`` bypass the :class:`EvictionBudget`
+    caps (max concurrent, per-node cooldown, moves/hour) AND the shared
+    429-retry semantics — use ``eviction.evict_with_retry(...,
+    budget=...)``. A ``def evict_pod`` (the client/fake implementing
+    the subresource) is fine; *calling* it anywhere else is not."""
+    if _posix(path).endswith(_EVICTION_HELPER):
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "evict_pod":
+            out.append(Violation(
+                path, node.lineno, node.col_offset,
+                "eviction-without-budget",
+                "direct evict_pod() call bypasses the EvictionBudget: "
+                "use tpushare.k8s.eviction.evict_with_retry(..., "
+                "budget=...) — the only legal doorway to pods/eviction"))
+    return out
+
+
 LINT_RULES = (annotation_literal, unlocked_mutation, bare_except,
               sleep_in_handler, raw_lock, swallowed_telemetry_error,
-              unbounded_metric_cardinality)
+              unbounded_metric_cardinality, eviction_without_budget)

@@ -21,7 +21,8 @@ from tools.vet.engine import Violation
 #: Path fragments of the strictly-typed core packages.
 CORE_PACKAGES = ("tpushare/cache/", "tpushare/scheduler/",
                  "tpushare/utils/", "tpushare/api/", "tpushare/quota/",
-                 "tpushare/slo/")
+                 "tpushare/slo/", "tpushare/defrag/",
+                 "tpushare/k8s/eviction.py")
 
 #: Parameter names exempt from annotation (bound implicitly).
 _IMPLICIT = {"self", "cls"}

@@ -201,6 +201,14 @@ class SchedulerCache:
                     if p.namespace == namespace
                     and p.annotations.get(const.ANN_POD_GROUP) == group]
 
+    def sharing_node_infos(self) -> list[NodeInfo]:
+        """Ledgers of nodes that actually advertise shareable TPU HBM —
+        the defrag planner's what-if universe (a non-sharing node can
+        neither strand capacity nor receive a migrated pod)."""
+        with self._lock:
+            infos = list(self._nodes.values())
+        return [i for i in infos if nodeutils.is_tpu_sharing_node(i.node)]
+
     def remove_node(self, name: str) -> bool:
         """Drop a deleted node's ledger (no reference counterpart — the
         reference's cache only ever grew, SURVEY.md §2 defect family).

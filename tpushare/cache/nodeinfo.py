@@ -165,6 +165,23 @@ class NodeInfo:
                 if chip is not None:
                     chip.remove_pod(pod)
 
+    def whatif_clone(self) -> "NodeInfo":
+        """A detached copy of this ledger for what-if planning: a fresh
+        NodeInfo over the same node document, repopulated with the live
+        residents. The defrag planner mutates clones freely (remove a
+        victim, trial-place it elsewhere) while the real ledger keeps
+        serving the filter hot path untouched."""
+        clone = NodeInfo(self.node, self.default_scoring)
+        seen: set[str] = set()
+        with self._lock:
+            for chip in self.chips.values():
+                for pod in chip.snapshot_pods():
+                    if pod.uid in seen or podutils.is_complete_pod(pod):
+                        continue
+                    seen.add(pod.uid)
+                    clone.add_or_update_pod(pod)
+        return clone
+
     # ------------------------------------------------------------------ #
     # Views
     # ------------------------------------------------------------------ #

@@ -37,6 +37,15 @@ Environment (reference cmd/main.go:23,92-98):
   ConfigMap (SLO objectives: error budgets + burn-rate alerting,
   docs/slo.md) is trusted from; default ``kube-system``. Absent
   ConfigMap = the built-in default objectives.
+* ``TPUSHARE_DEFRAG_MODE`` — ``off`` | ``dry-run`` (default) |
+  ``active``: the defragmentation rebalancer's posture (docs/defrag.md).
+  Dry-run plans and publishes moves without evicting; active executes
+  under the budget knobs ``TPUSHARE_DEFRAG_MAX_MOVES`` /
+  ``TPUSHARE_DEFRAG_MOVES_PER_HOUR`` /
+  ``TPUSHARE_DEFRAG_NODE_COOLDOWN_S`` /
+  ``TPUSHARE_DEFRAG_MAX_CONCURRENT`` /
+  ``TPUSHARE_DEFRAG_INTERVAL_S``, leader-gated, and aborts whole plans
+  while any SLO is burning.
 """
 
 from __future__ import annotations
@@ -121,6 +130,11 @@ def build_stack(client, is_leader=None) -> Stack:
     predicate = Predicate(controller.cache, demand=DemandTracker(
         pod_lookup=controller.hub.get_pod),
         quota=controller.quota, client=client)
+    # The defrag executor's fragmentation index measures stranding
+    # against the demand shapes currently failing the filter — the
+    # predicate owns that tracker, so it is wired in here, after both
+    # exist (docs/defrag.md).
+    controller.defrag.set_demand(predicate.demand)
     prioritize = Prioritize(
         controller.cache, gang_planner=gang, policy=scoring,
         quota=controller.quota)
@@ -155,7 +169,8 @@ def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2):
         admission=stack.admission,
         gang_planner=stack.binder.gang_planner,
         workqueue=stack.controller.queue,
-        quota=stack.controller.quota)
+        quota=stack.controller.quota,
+        defrag=stack.controller.defrag)
     serve_forever(server)
     return stack, server
 
@@ -289,7 +304,8 @@ def main() -> None:
                                 gang_planner=stack.binder.gang_planner,
                                 debug_routes=debug_routes,
                                 workqueue=stack.controller.queue,
-                                quota=stack.controller.quota)
+                                quota=stack.controller.quota,
+                                defrag=stack.controller.defrag)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
     if bool(cert) != bool(key):
         log.error("TLS misconfigured: exactly one of TLS_CERT_FILE / "

@@ -61,9 +61,18 @@ class Controller:
                                     default_scoring=default_scoring,
                                     quota=self.quota)
         #: ``() -> bool`` — gates apiserver WRITES this controller
-        #: originates (today: the gang reaper). Reads/ledger upkeep run
-        #: on every replica; deletes from N replicas would multiply.
+        #: originates (the gang reaper, the defrag executor). Reads/
+        #: ledger upkeep run on every replica; deletes from N replicas
+        #: would multiply.
         self._is_leader = is_leader or (lambda: True)
+        #: Defragmentation: stranded-HBM detection + the budgeted,
+        #: SLO-guarded rebalancer (docs/defrag.md). Dry-run by default;
+        #: TPUSHARE_DEFRAG_MODE=active arms eviction. build_stack wires
+        #: the filter verb's DemandTracker in post-construction.
+        from tpushare.defrag.executor import DefragExecutor
+        self.defrag = DefragExecutor(
+            self.cache, client, quota=self.quota,
+            pod_lister=self.hub.pods.list, is_leader=self._is_leader)
         self._removed_lock = locks.TracingRLock("controller/removed")
         #: ns/name -> last seen Pod, for deletes (reference removePodCache)
         self._removed: dict[str, Pod] = locks.guarded_dict(
@@ -238,13 +247,23 @@ class Controller:
                 pod = self.client.get_pod(namespace, name)
             except NotFoundError:
                 pod = None
+        with self._removed_lock:
+            stashed = self._removed.pop(key, None)
+        if stashed is not None and (pod is None
+                                    or pod.uid != stashed.uid):
+            # The deleted INSTANCE is definitively gone — either the
+            # key is empty, or it now holds a recreated successor with
+            # a new uid (the defrag evict→recreate flow; keys are
+            # ns/name, but a deletion names one specific object). Free
+            # the dead instance's ledger entry; the successor, if any,
+            # is handled below on its own merits. A same-uid live pod
+            # means the delete was stale noise: drop the stash, touch
+            # nothing.
+            self.cache.remove_pod(stashed)
+            log.info("sync: removed deleted pod %s (uid %s) from ledger",
+                     key, stashed.uid)
+            self._maybe_reap_gang(stashed)
         if pod is None:
-            with self._removed_lock:
-                stashed = self._removed.pop(key, None)
-            if stashed is not None:
-                self.cache.remove_pod(stashed)
-                log.info("sync: removed deleted pod %s from ledger", key)
-                self._maybe_reap_gang(stashed)
             return
         if podutils.is_complete_pod(pod):
             self.cache.remove_pod(pod)
@@ -397,10 +416,15 @@ class Controller:
                                  name=f"tpushare-sync-{i}", daemon=True)
             t.start()
             self._workers.append(t)
+        # Defrag tick loop (no-op when TPUSHARE_DEFRAG_MODE=off; its
+        # first tick only fires a full interval from now, so transient
+        # controllers never rebalance by accident).
+        self.defrag.start()
         log.info("controller started with %d sync workers", workers)
 
     def stop(self) -> None:
         self._stop.set()
+        self.defrag.stop()
         self.queue.shut_down()
         self.hub.stop()
         for t in self._workers:

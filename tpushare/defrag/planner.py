@@ -1,0 +1,430 @@
+"""Rebalance planner: bounded move search over a what-if ledger.
+
+A *move* is "evict pod P from node A; its owner recreates it and the
+scheduler re-places it on node B" — proven, not hoped: before a move is
+proposed, the victim is re-placed in a **what-if copy** of the chip
+ledger by replaying the REAL admission predicate (``NodeInfo.assume``)
+and the REAL bin-pack chip picker (``NodeInfo.pick_chips``), so the
+plan only contains relocations the live filter/bind path would accept.
+
+Invariants every plan honors (docs/defrag.md):
+
+* **gang-atomic** — a committed gang member never moves alone: either
+  every cluster-wide member of its group is proven re-placeable (and
+  all of them are in the plan) or none moves. Evicting one member would
+  trip the controller's gang reaper and restart the job anyway — the
+  planner prices that truthfully by moving the whole group or not at
+  all.
+* **quota-safe** — with a quota table configured, only pods sitting
+  wholly in *borrowed* territory (beyond their tenant's guarantee) are
+  movable: defrag must never cut a tenant below what it is owed, even
+  transiently during the evict→rebind window.
+* **checkpoint-aware** — a pod with ``tpushare.io/checkpoint-in-flight``
+  set is never moved: killing it mid-save loses the checkpoint AND the
+  progress since the previous one.
+* **budgeted** — at most ``max_moves`` per plan (gang members count
+  individually), and at most ``MAX_VICTIMS_PER_CHIP`` victims cleared
+  from any one chip (a chip needing mass eviction is not fragmentation,
+  it is load).
+
+The search itself is greedy: pending pods (largest demand first) that
+fit nowhere in the what-if get a make-room attempt per candidate node;
+the cheapest working victim set wins; the what-if absorbs the result so
+later pending pods plan against the post-move world.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from tpushare import trace
+from tpushare.api.objects import Pod
+from tpushare.cache.cache import SchedulerCache
+from tpushare.cache.nodeinfo import AllocationError, NodeInfo
+from tpushare.quota.manager import QuotaManager
+from tpushare.utils import const
+from tpushare.utils import node as nodeutils
+from tpushare.utils import pod as podutils
+
+log = logging.getLogger(__name__)
+
+#: Victims the planner may clear from one chip for one pending pod.
+MAX_VICTIMS_PER_CHIP = 3
+
+#: Candidate target nodes trial-cloned per pending pod. Each trial
+#: deep-clones the what-if fleet, so this bounds a planner tick at
+#: O(pending × MAX_TARGETS_TRIED × fleet) instead of O(pending × nodes
+#: × fleet); candidates are sorted cheapest-first, so the first trial
+#: almost always succeeds and later ones exist only as fallbacks.
+MAX_TARGETS_TRIED = 4
+
+
+class Move:
+    """One planned relocation. ``status`` advances planned → (dry-run |
+    evicted | deferred | aborted | failed | gone); each transition lands
+    in the flight recorder under the pod's name with a ``defrag:``
+    span."""
+
+    __slots__ = ("namespace", "name", "uid", "from_node", "to_node",
+                 "gang", "hbm", "chips", "status", "trace_id", "detail")
+
+    def __init__(self, pod: Pod, from_node: str, to_node: str) -> None:
+        self.namespace = pod.namespace
+        self.name = pod.name
+        self.uid = pod.uid
+        self.from_node = from_node
+        self.to_node = to_node
+        self.gang = pod.annotations.get(const.ANN_POD_GROUP, "")
+        self.hbm, self.chips = QuotaManager.granted_demand(pod)
+        self.status = "planned"
+        self.trace_id = ""
+        self.detail = ""
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def to_json(self) -> dict:
+        doc = {
+            "pod": self.key(),
+            "uid": self.uid,
+            "from": self.from_node,
+            "to": self.to_node,
+            "status": self.status,
+            "traceId": self.trace_id,
+        }
+        if self.gang:
+            doc["gang"] = self.gang
+        if self.hbm:
+            doc["hbmGiB"] = self.hbm
+        if self.chips:
+            doc["wholeChips"] = self.chips
+        if self.detail:
+            doc["detail"] = self.detail
+        return doc
+
+
+class Plan:
+    """A bounded set of moves plus the pending pods they unblock."""
+
+    def __init__(self, moves: list[Move], unblocks: list[str]) -> None:
+        self.plan_id = trace.new_trace_id()
+        self.created_at = time.time()
+        self.moves = moves
+        self.unblocks = unblocks
+        self.status = "planned"
+        self.abort_reason = ""
+
+    def to_json(self) -> dict:
+        return {
+            "id": self.plan_id,
+            "createdAt": self.created_at,
+            "status": self.status,
+            **({"abortReason": self.abort_reason}
+               if self.abort_reason else {}),
+            "unblocks": list(self.unblocks),
+            "moves": [m.to_json() for m in self.moves],
+        }
+
+
+class WhatIf:
+    """A detached copy of the fleet's chip ledgers the planner mutates
+    freely. Placement replays the real predicate + picker, so "fits"
+    here means "the live filter/bind path would take it"."""
+
+    def __init__(self, infos: list[NodeInfo]) -> None:
+        self.nodes: dict[str, NodeInfo] = {
+            i.name: i.whatif_clone() for i in infos}
+        #: uid -> (node name, the pod document the ledger holds)
+        self.located: dict[str, tuple[str, Pod]] = {}
+        for name, info in self.nodes.items():
+            for chip in info.chips.values():
+                for pod in chip.snapshot_pods():
+                    self.located.setdefault(pod.uid, (name, pod))
+
+    def clone(self) -> "WhatIf":
+        return WhatIf(list(self.nodes.values()))
+
+    def remove(self, uid: str) -> None:
+        entry = self.located.pop(uid, None)
+        if entry is not None:
+            node, pod = entry
+            self.nodes[node].remove_pod(pod)
+
+    def fits(self, pod: Pod) -> bool:
+        return any(info.assume(pod)[0] for info in self.nodes.values())
+
+    def place(self, pod: Pod,
+              exclude: frozenset[str] = frozenset()) -> str | None:
+        """Re-place ``pod`` with the real picker, tightest node first
+        (the node left with the least free HBM — the cross-node binpack
+        the prioritize verb implements). Returns the node, or None."""
+        best: tuple[int, str, list[int]] | None = None
+        for name in sorted(self.nodes):
+            if name in exclude:
+                continue
+            info = self.nodes[name]
+            ok, _ = info.assume(pod)
+            if not ok:
+                continue
+            try:
+                chips = info.pick_chips(pod)
+            # Control flow, not telemetry: "no placement on this
+            # node" just tries the next one.
+            # vet: ignore[swallowed-telemetry-error]
+            except AllocationError:
+                continue
+            leftover = sum(info.get_available_hbm().values())
+            if best is None or leftover < best[0]:
+                best = (leftover, name, chips)
+        if best is None:
+            return None
+        _, name, chips = best
+        info = self.nodes[name]
+        if podutils.get_chips_from_pod_resource(pod) > 0:
+            hbm_pod = sum(info.chips[c].total_hbm for c in chips)
+        else:
+            hbm_pod = podutils.get_hbm_from_pod_resource(pod)
+        placed = podutils.updated_pod_annotation_spec(
+            pod, chips, hbm_pod, info.chips[chips[0]].total_hbm,
+            assume_time_ns=0)
+        placed.spec["nodeName"] = name
+        info.add_or_update_pod(placed)
+        self.located[pod.uid] = (name, placed)
+        return name
+
+
+class RebalancePlanner:
+    def __init__(self, cache: SchedulerCache,
+                 quota: QuotaManager | None = None,
+                 max_moves: int = 8) -> None:
+        self.cache = cache
+        self.quota = quota
+        self.max_moves = max_moves
+
+    # -- move eligibility ------------------------------------------------ #
+
+    def movable(self, pod: Pod) -> tuple[bool, str]:
+        """May this resident be relocated at all? (Gang atomicity is
+        enforced separately — this is the per-pod gate.)"""
+        if podutils.is_complete_pod(pod):
+            return False, "complete"
+        if not pod.node_name:
+            return False, "unbound (gang reservation in flight)"
+        if pod.annotations.get(const.ANN_CKPT_IN_FLIGHT, "").lower() in (
+                "true", "1"):
+            return False, "checkpoint in flight"
+        if self.quota is not None:
+            tenant = self.quota.tenant_of(pod)
+            if (self.quota.configured(tenant)
+                    and not self.quota.is_borrowed(pod)):
+                # Inside guaranteed territory: evicting would cut the
+                # tenant below what it is owed until the rebind lands.
+                return False, f"inside tenant {tenant}'s quota guarantee"
+        return True, ""
+
+    def _gang_members(self, pod: Pod) -> list[Pod]:
+        group, _ = podutils.get_pod_group(pod)
+        if not group:
+            return [pod]
+        members = [m for m in self.cache.gang_members(pod.namespace, group)
+                   if not podutils.is_complete_pod(m)]
+        return members or [pod]
+
+    # -- the search ------------------------------------------------------ #
+
+    def plan(self, pending: list[Pod]) -> Plan | None:
+        """Author a bounded move set that unblocks as much of ``pending``
+        as it can; None when no legal move helps (including when nothing
+        is pending — defrag never moves pods for aesthetics alone)."""
+        infos = self.cache.sharing_node_infos()
+        if not infos or not pending:
+            return None
+        whatif = WhatIf(infos)
+        moves: list[Move] = []
+        unblocks: list[str] = []
+        order = sorted(
+            pending,
+            key=lambda p: -(podutils.get_hbm_from_pod_resource(p)
+                            + podutils.get_chips_from_pod_resource(p) * 1000))
+        # Bound the scan: a huge pending backlog must not turn the
+        # (default-on, every-interval) planner tick into a fleet-sized
+        # search per pod — the move budget caps what a plan can repair
+        # anyway, so scanning far past it only burns the controller.
+        order = order[:max(self.max_moves, 1) * 4]
+        for pod in order:
+            if len(moves) >= self.max_moves:
+                break
+            if whatif.fits(pod):
+                # Fits already (or a previous pod's moves freed room):
+                # account for it so later pending pods don't plan onto
+                # the same hole.
+                whatif.place(pod)
+                continue
+            found = self._make_room(whatif, pod,
+                                    self.max_moves - len(moves))
+            if found is None:
+                continue
+            new_moves, whatif = found
+            moves.extend(new_moves)
+            whatif.place(pod)
+            unblocks.append(f"{pod.namespace}/{pod.name}")
+        if not moves:
+            return None
+        plan = Plan(moves, unblocks)
+        self._record(plan)
+        return plan
+
+    def _make_room(self, whatif: WhatIf, pod: Pod, budget: int
+                   ) -> tuple[list[Move], WhatIf] | None:
+        """Find a victim set on SOME node whose relocation lets ``pod``
+        fit there; returns (moves, the what-if with them applied)."""
+        req_chips = podutils.get_chips_from_pod_resource(pod)
+        req_hbm = podutils.get_hbm_from_pod_resource(pod)
+        candidates: list[tuple[int, str, list[Pod]]] = []
+        for name, info in whatif.nodes.items():
+            victims = (self._chip_victims(info, req_chips)
+                       if req_chips > 0
+                       else self._hbm_victims(info, req_hbm))
+            if victims is None:
+                continue
+            expanded = self._expand_gangs(victims)
+            if expanded is None or len(expanded) > budget:
+                continue
+            candidates.append((len(expanded), name, expanded))
+        for _, target, victims in sorted(
+                candidates, key=lambda c: (c[0], c[1]))[:MAX_TARGETS_TRIED]:
+            trial = whatif.clone()
+            ok = True
+            placements: list[Move] = []
+            for victim in sorted(
+                    victims,
+                    key=lambda v: -podutils.get_hbm_from_pod_annotation(v)):
+                source = trial.located.get(victim.uid, ("", None))[0]
+                trial.remove(victim.uid)
+                dest = trial.place(self._as_request(victim),
+                                   exclude=frozenset((target,)))
+                if dest is None:
+                    ok = False
+                    break
+                placements.append(Move(victim, source, dest))
+            if ok and trial.nodes[target].assume(pod)[0]:
+                return placements, trial
+        return None
+
+    def _hbm_victims(self, info: NodeInfo,
+                     req_hbm: int) -> list[Pod] | None:
+        """Cheapest movable victim set freeing one chip up to
+        ``req_hbm``; None when no chip on this node can get there."""
+        if req_hbm <= 0:
+            return None
+        avail = info.get_available_hbm()
+        best: list[Pod] | None = None
+        for idx, chip in info.chips.items():
+            if chip.total_hbm < req_hbm:
+                continue
+            deficit = req_hbm - avail.get(idx, 0)
+            if deficit <= 0:
+                continue  # fits already; caller would not be here
+            residents = [(p, c) for p, c in chip.snapshot_contributions()
+                         if c > 0 and self.movable(p)[0]]
+            # Largest contribution first: fewest victims to cover the
+            # deficit (moving is disruption; minimize bodies, not GiB).
+            residents.sort(key=lambda pc: -pc[1])
+            chosen: list[Pod] = []
+            freed = 0
+            for p, c in residents:
+                if len(chosen) >= MAX_VICTIMS_PER_CHIP:
+                    break
+                chosen.append(p)
+                freed += c
+                if freed >= deficit:
+                    break
+            if freed < deficit:
+                continue
+            if best is None or len(chosen) < len(best):
+                best = chosen
+        return best
+
+    def _chip_victims(self, info: NodeInfo,
+                      req_chips: int) -> list[Pod] | None:
+        """Movable victims clearing enough chips for a whole-chip
+        request; already-free chips are used first."""
+        if req_chips <= 0:
+            return None
+        free = len(info.get_free_chips())
+        need = req_chips - free
+        if need <= 0:
+            return None  # fits already
+        clearable: list[tuple[int, list[Pod]]] = []
+        for idx, chip in info.chips.items():
+            residents = {p.uid: p for p, c in chip.snapshot_contributions()
+                         if c > 0}
+            if not residents:
+                continue
+            if any(not self.movable(p)[0] for p in residents.values()):
+                continue
+            if len(residents) > MAX_VICTIMS_PER_CHIP:
+                continue
+            cost = sum(podutils.pod_used_hbm(p)
+                       for p in residents.values())
+            clearable.append((cost, list(residents.values())))
+        if len(clearable) < need:
+            return None
+        clearable.sort(key=lambda c: c[0])
+        victims: dict[str, Pod] = {}
+        for _, residents in clearable[:need]:
+            for p in residents:
+                victims[p.uid] = p
+        return list(victims.values())
+
+    def _expand_gangs(self, victims: list[Pod]) -> list[Pod] | None:
+        """Close the victim set over gang membership — move all members
+        or none. None when any member is immovable."""
+        out: dict[str, Pod] = {}
+        for victim in victims:
+            for member in self._gang_members(victim):
+                ok, why = self.movable(member)
+                if not ok:
+                    log.debug("defrag: dropping candidate %s — gang "
+                              "member %s is immovable (%s)",
+                              victim.key(), member.key(), why)
+                    return None
+                out[member.uid] = member
+        return list(out.values())
+
+    @staticmethod
+    def _as_request(victim: Pod) -> Pod:
+        """The victim as its owner would recreate it: the original
+        request, no grant annotations (re-placement must re-run the
+        real picker, not adopt the old chips)."""
+        fresh = victim.deepcopy()
+        ann = fresh.metadata.get("annotations") or {}
+        for key in const.GRANT_ANNOTATIONS:
+            ann.pop(key, None)
+        fresh.raw.setdefault("spec", {}).pop("nodeName", None)
+        return fresh
+
+    # -- flight-recorder plumbing ---------------------------------------- #
+
+    def _record(self, plan: Plan) -> None:
+        """Every planned move becomes a completed ``defrag:plan``
+        decision in the flight recorder — `kubectl inspect tpushare
+        explain <pod>` shows WHY the pod was (or would be) moved."""
+        for move in plan.moves:
+            try:
+                with trace.phase("defrag:plan", move.namespace, move.name,
+                                 move.uid) as dec:
+                    trace.note("planId", plan.plan_id)
+                    trace.note("from", move.from_node)
+                    trace.note("to", move.to_node)
+                    trace.note("unblocks", list(plan.unblocks))
+                    if move.gang:
+                        trace.note("gang", move.gang)
+                    trace.complete(dec, "defrag-planned",
+                                   node=move.to_node)
+                if dec is not None:
+                    move.trace_id = dec.trace_id
+            except Exception:  # noqa: BLE001 - telemetry must not plan
+                trace.recorder().drops.inc()

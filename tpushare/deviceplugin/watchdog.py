@@ -45,7 +45,7 @@ import time
 from prometheus_client import CollectorRegistry, Gauge, generate_latest
 
 from tpushare.api.objects import Pod
-from tpushare.k8s import events
+from tpushare.k8s import events, eviction
 from tpushare.k8s.errors import ApiError, ConflictError, NotFoundError
 from tpushare.utils import const, pod as podutils
 
@@ -71,7 +71,7 @@ class GrantWatchdog:
                  evict_after: int = 0,
                  stale_after: float = STALE_AFTER_S,
                  registry: CollectorRegistry | None = None,
-                 now=time.time):
+                 now=time.time, evict_sleep=time.sleep):
         self.node_name = node_name
         self.client = client
         self.usage_dir = usage_dir
@@ -81,6 +81,15 @@ class GrantWatchdog:
         self.evict_after = evict_after
         self.stale_after = stale_after
         self.now = now
+        #: Injectable backoff sleep for the in-sweep 429 retry (tests
+        #: relax a PDB between attempts to prove the retry re-attempts).
+        self._evict_sleep = evict_sleep
+        #: Node-local eviction policy: unlimited budget — evict_after's
+        #: consecutive-sweep streak IS the rate limit here. The shared
+        #: helper is still the only doorway (eviction-without-budget
+        #: vet rule), so the 429-retry semantics match the defrag
+        #: executor's exactly.
+        self._evict_budget = eviction.EvictionBudget()
         self.registry = registry or CollectorRegistry()
         self._used = Gauge(
             "tpushare_hbm_used_gib",
@@ -308,30 +317,36 @@ class GrantWatchdog:
                 # pods/eviction subresource, NOT a bare DELETE: the
                 # apiserver then honors PodDisruptionBudgets, matching
                 # the scheduler-side preemption path's PDB-aware
-                # semantics (ADVICE round 5). 429 == a PDB is blocking
-                # the disruption right now.
-                self.client.evict_pod(pod.namespace, pod.name)
-                evicted.append(pod.uid)
-                log.warning("evicted overrunning pod %s", pod.key())
-                events.record(
-                    self.client, pod, REASON_EVICTED,
-                    f"evicting: HBM grant overrun persisted for {streak} "
-                    f"consecutive sweeps (policy TPUSHARE_EVICT_OVERRUN)",
-                    event_type="Warning")
-                self._over_streak.pop(uid, None)
-            except NotFoundError:
-                # Pod vanished between the list and the eviction: the
-                # overrun is moot; the end-of-sweep prune drops the
-                # streak next pass.
-                pass
-            except ApiError as e:
-                if e.status == 429:
-                    # PDB-protected: keep the streak so the eviction
-                    # retries once the budget allows a disruption.
+                # semantics (ADVICE round 5). The shared budgeted
+                # helper retries 429 (a PDB blocking the disruption
+                # right now) with backoff inside the sweep; a pod still
+                # BLOCKED afterwards keeps its streak, so the NEXT
+                # sweep retries again once the budget allows.
+                status = eviction.evict_with_retry(
+                    self.client, pod.namespace, pod.name,
+                    budget=self._evict_budget, node=self.node_name,
+                    sleep=self._evict_sleep)
+                if status == eviction.EVICTED:
+                    evicted.append(pod.uid)
+                    log.warning("evicted overrunning pod %s", pod.key())
+                    events.record(
+                        self.client, pod, REASON_EVICTED,
+                        f"evicting: HBM grant overrun persisted for "
+                        f"{streak} consecutive sweeps (policy "
+                        f"TPUSHARE_EVICT_OVERRUN)", event_type="Warning")
+                    self._over_streak.pop(uid, None)
+                elif status == eviction.BLOCKED:
+                    # PDB-protected through every in-sweep retry: keep
+                    # the streak so the eviction re-attempts next sweep.
                     log.warning("eviction of %s blocked by a "
                                 "PodDisruptionBudget; will retry",
                                 pod.key())
-                elif e.status in (403, 405):
+                # GONE: pod vanished between the list and the eviction —
+                # the overrun is moot; the end-of-sweep prune drops the
+                # streak next pass. (DENIED cannot happen: the node-
+                # local budget is unlimited.)
+            except ApiError as e:
+                if e.status in (403, 405):
                     # Old RBAC (no pods/eviction create rule) or an
                     # apiserver without the subresource: fall back to
                     # the bare DELETE this policy used before, LOUDLY —

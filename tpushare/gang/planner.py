@@ -589,9 +589,7 @@ class GangPlanner:
         try:
             fresh = self.client.get_pod(pod.namespace, pod.name)
             ann = fresh.metadata.get("annotations") or {}
-            for k in (const.ANN_CHIP_IDX, const.ANN_HBM_POD,
-                      const.ANN_HBM_CHIP, const.ANN_ASSIGNED,
-                      const.ANN_ASSUME_TIME, const.ANN_TRACE_ID):
+            for k in const.GRANT_ANNOTATIONS:
                 ann.pop(k, None)
             fresh.raw.setdefault("spec", {}).pop("nodeName", None)
             self.client.update_pod(fresh)

@@ -98,6 +98,8 @@ REASON_GANG_REAPED = "TPUShareGangReaped"
 REASON_GANG_COMMITTED = "TPUShareGangCommitted"
 REASON_QUOTA_DENIED = "TPUShareQuotaDenied"
 REASON_SLO_BURN = "TPUShareSLOBurn"
+REASON_DEFRAG_MOVE = "TPUShareDefragMove"
+REASON_DEFRAG_ABORTED = "TPUShareDefragAborted"
 
 
 def record(client, pod: Pod, reason: str, message: str,

@@ -1,0 +1,151 @@
+"""Budgeted, retrying eviction — the ONE doorway to ``pods/eviction``.
+
+Two components in this codebase kill pods through the PDB-honoring
+eviction subresource: the node-local grant watchdog (overrun policy) and
+the defragmentation executor (rebalance moves). Both failure-handling
+stories are identical — the apiserver answers 429 while a matching
+PodDisruptionBudget has no disruptions left, and the caller must retry
+with backoff rather than either hammering the apiserver or silently
+giving up — so the retry loop lives here once, and the
+``eviction-without-budget`` vet rule (docs/vet.md) pins every
+``evict_pod`` call site to this module: an eviction that does not flow
+through an :class:`EvictionBudget` is a lint failure, not a code-review
+hope.
+
+The budget is what makes automated eviction safe to run unattended:
+a planner bug, a flapping SLO, or a hot retry loop is bounded by hard
+caps (concurrent evictions in flight, per-node cooldown, global
+evictions per hour) instead of by luck.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable
+
+from tpushare.k8s.errors import ApiError, NotFoundError
+from tpushare.utils import locks
+
+#: Terminal statuses :func:`evict_with_retry` returns. DENIED_PREFIX is
+#: followed by the budget's reason ("concurrent", "moves-per-hour",
+#: "node-cooldown") so callers can tell a skip-this-node from a
+#: stop-the-whole-plan.
+EVICTED = "evicted"
+GONE = "gone"
+BLOCKED = "blocked"
+DENIED_PREFIX = "denied:"
+
+#: Budget-denial reasons (the part after DENIED_PREFIX).
+REASON_CONCURRENT = "concurrent"
+REASON_PER_HOUR = "moves-per-hour"
+REASON_NODE_COOLDOWN = "node-cooldown"
+
+_HOUR_S = 3600.0
+
+
+class EvictionBudget:
+    """Hard caps every eviction must pass through. A zero limit means
+    "unlimited" for that dimension — the watchdog's node-local policy
+    constructs a default (unlimited) budget, the defrag executor a
+    tightly capped one; both flow through the same gate so the vet rule
+    has one shape to enforce."""
+
+    def __init__(self, max_concurrent: int = 0,
+                 node_cooldown_s: float = 0.0,
+                 per_hour: int = 0,
+                 now: Callable[[], float] = time.monotonic) -> None:
+        self.max_concurrent = max_concurrent
+        self.node_cooldown_s = node_cooldown_s
+        self.per_hour = per_hour
+        self._now = now
+        self._lock = locks.TracingRLock("k8s/eviction-budget")
+        self._in_flight = 0
+        #: node -> monotonic stamp of its last successful eviction.
+        self._node_last: dict[str, float] = locks.guarded_dict(
+            self._lock, "EvictionBudget._node_last")
+        #: monotonic stamps of recent successful evictions (1h window).
+        self._recent: deque[float] = deque()
+
+    def acquire(self, node: str = "") -> str:
+        """Admit one eviction attempt; returns "" when admitted, else
+        the denial reason. An admitted attempt MUST be paired with
+        :meth:`release` (``evict_with_retry`` does this in a finally)."""
+        now = self._now()
+        with self._lock:
+            if (self.max_concurrent > 0
+                    and self._in_flight >= self.max_concurrent):
+                return REASON_CONCURRENT
+            while self._recent and now - self._recent[0] > _HOUR_S:
+                self._recent.popleft()
+            if self.per_hour > 0 and len(self._recent) >= self.per_hour:
+                return REASON_PER_HOUR
+            if (self.node_cooldown_s > 0 and node
+                    and now - self._node_last.get(node, float("-inf"))
+                    < self.node_cooldown_s):
+                return REASON_NODE_COOLDOWN
+            self._in_flight += 1
+            return ""
+
+    def release(self, node: str = "", evicted: bool = False) -> None:
+        """End an admitted attempt; a successful eviction consumes the
+        per-hour budget and starts the node's cooldown."""
+        with self._lock:
+            self._in_flight = max(self._in_flight - 1, 0)
+            if evicted:
+                self._recent.append(self._now())
+                if node:
+                    self._node_last[node] = self._now()
+
+    def snapshot(self) -> dict:
+        """Operator view for ``GET /debug/defrag`` (0 = unlimited)."""
+        now = self._now()
+        with self._lock:
+            while self._recent and now - self._recent[0] > _HOUR_S:
+                self._recent.popleft()
+            return {
+                "maxConcurrent": self.max_concurrent,
+                "inFlight": self._in_flight,
+                "perHour": self.per_hour,
+                "usedLastHour": len(self._recent),
+                "nodeCooldownSeconds": self.node_cooldown_s,
+                "nodesCoolingDown": sorted(
+                    n for n, t in self._node_last.items()
+                    if self.node_cooldown_s > 0
+                    and now - t < self.node_cooldown_s),
+            }
+
+
+def evict_with_retry(client: Any, namespace: str, name: str, *,
+                     budget: EvictionBudget, node: str = "",
+                     attempts: int = 3, backoff_s: float = 0.2,
+                     sleep: Callable[[float], None] = time.sleep) -> str:
+    """Evict ``namespace/name`` via the PDB-honoring ``pods/eviction``
+    subresource, retrying 429 (a PodDisruptionBudget with no disruptions
+    left) with exponential backoff.
+
+    Returns :data:`EVICTED`, :data:`GONE` (pod vanished first),
+    :data:`BLOCKED` (PDB still refusing after every attempt), or
+    ``denied:<reason>`` when ``budget`` refused the attempt outright.
+    Non-429 ApiErrors propagate — the caller owns fallback policy (the
+    watchdog's 403/405 bare-DELETE escape hatch, for example)."""
+    denied = budget.acquire(node)
+    if denied:
+        return DENIED_PREFIX + denied
+    evicted = False
+    try:
+        for i in range(max(attempts, 1)):
+            try:
+                client.evict_pod(namespace, name)
+                evicted = True
+                return EVICTED
+            except NotFoundError:
+                return GONE
+            except ApiError as e:
+                if e.status != 429:
+                    raise
+                if i + 1 < max(attempts, 1):
+                    sleep(backoff_s * (2 ** i))
+        return BLOCKED
+    finally:
+        budget.release(node, evicted=evicted)

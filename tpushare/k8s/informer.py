@@ -45,8 +45,22 @@ class Store:
             self._items[self.key_of(obj)] = obj
 
     def delete(self, obj) -> None:
+        """Remove ``obj``'s slot — UNLESS the slot now holds a NEWER
+        instance (different uid). Keys are ns/name, but a delete event
+        names one specific object: when a pod is evicted and its owner
+        recreates it under the same name (the defrag migrate flow), the
+        stale DELETED for the old uid must not clobber the recreated,
+        possibly already-bound pod from the lister."""
         with self._lock:
-            self._items.pop(self.key_of(obj), None)
+            key = self.key_of(obj)
+            current = self._items.get(key)
+            if current is None:
+                return
+            cur_uid = getattr(current, "uid", "")
+            obj_uid = getattr(obj, "uid", "")
+            if cur_uid and obj_uid and cur_uid != obj_uid:
+                return
+            self._items.pop(key, None)
 
     def get(self, key: str):
         with self._lock:

@@ -250,6 +250,41 @@ UNSCHED_CHIPS_TENANT = Gauge(
     "Per-tenant breakdown of the unplaceable whole-chip demand",
     ["tenant"], registry=REGISTRY,
 )
+# -- Fragmentation & defrag (tpushare/defrag/, docs/defrag.md) ------------- #
+
+CLUSTER_STRANDED_HBM = Gauge(
+    "tpushare_cluster_stranded_hbm_gib",
+    "Free HBM no currently-pending demand shape can use: splinters "
+    "smaller than every pending slice request, free chips on nodes too "
+    "fragmented for the pending whole-chip requests. Sustained nonzero "
+    "while pods sit unschedulable means the fleet needs DEFRAG, not "
+    "more nodes (compare tpushare_unschedulable_demand_hbm_gib)",
+    registry=REGISTRY,
+)
+NODE_FRAG_SCORE = Gauge(
+    "tpushare_node_frag_score",
+    "Per-node fragmentation score: the fraction of the node's free HBM "
+    "that is stranded against the pending demand shapes (0 = every "
+    "free byte is usable, 1 = all of it is splinters nobody can take)",
+    ["node"], registry=REGISTRY,
+)
+DEFRAG_MOVES = Counter(
+    "tpushare_defrag_moves_total",
+    "Defrag rebalance moves by outcome: evicted (active mode), dry-run "
+    "(proposed only), deferred (PDB block / node cooldown), aborted "
+    "(SLO burn or budget exhaustion cancelled the rest of the plan), "
+    "failed, gone",
+    ["outcome"], registry=REGISTRY,
+)
+DEFRAG_PLANS_ABORTED = Counter(
+    "tpushare_defrag_plans_aborted_total",
+    "Defrag plans aborted mid-flight, by reason: slo-burn (the SLO "
+    "engine reported a burning objective — defrag must never worsen "
+    "the journeys it serves) or budget (the hourly eviction budget ran "
+    "out). See the docs/defrag.md runbook",
+    ["reason"], registry=REGISTRY,
+)
+
 TELEMETRY_ERRORS = Counter(
     "tpushare_telemetry_errors_total",
     "Errors swallowed on telemetry paths (metrics scrape parse, trace "
@@ -425,8 +460,30 @@ def observe_slo() -> None:
                     view["burnRate"])
 
 
+def observe_frag(defrag) -> None:
+    """Refresh the fragmentation gauges from the defrag executor's
+    index (frag.py math over the live ledger + pending demand shapes).
+    Rebuilt each scrape like the node gauges, so a deleted node's score
+    series disappears instead of freezing."""
+    with _SCRAPE_LOCK:
+        try:
+            report = defrag.frag_snapshot()
+        except Exception:
+            # A broken frag read must not take down the whole scrape —
+            # the lost sample is counted, and BOTH gauges keep their
+            # last good values together (clearing the per-node scores
+            # while the cluster gauge stayed stale would render a
+            # self-contradictory scrape).
+            safe_inc(TELEMETRY_ERRORS)
+            return
+        NODE_FRAG_SCORE.clear()
+        CLUSTER_STRANDED_HBM.set(report["strandedHBM"])
+        for node in report["nodes"]:
+            NODE_FRAG_SCORE.labels(node=node["node"]).set(node["score"])
+
+
 def scrape(cache, gang_planner=None, leader=None, demand=None,
-           workqueue=None, quota=None) -> bytes:
+           workqueue=None, quota=None, defrag=None) -> bytes:
     """Atomic observe+render for the /metrics handler, timed and
     error-counted (a scrape that raises is a sample Prometheus never
     saw — that loss must itself be countable)."""
@@ -455,6 +512,10 @@ def scrape(cache, gang_planner=None, leader=None, demand=None,
                     UNSCHED_PODS_TENANT.labels(tenant=tenant).set(t_pods)
                     UNSCHED_HBM_TENANT.labels(tenant=tenant).set(t_hbm)
                     UNSCHED_CHIPS_TENANT.labels(tenant=tenant).set(t_chips)
+            if defrag is not None:
+                # After the demand block: the frag index reads the
+                # DemandTracker's shapes, which snapshot() just pruned.
+                observe_frag(defrag)
             if gang_planner is not None:
                 # stats() is the cheap view (no member lists / TTL math)
                 # — this runs under the scrape lock.

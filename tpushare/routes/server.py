@@ -20,6 +20,10 @@ Routes:
   (``?id=<trace-id>`` resolves a specific attempt from the journey)
 * ``GET  /debug/quota``     — per-tenant quota snapshot: guarantee /
   limit / usage / borrowed (the tenancy ledger, docs/quota.md)
+* ``GET  /debug/defrag``    — fragmentation index (stranded HBM, per-
+  node scores) + the last rebalance plan (proposed vs executed vs
+  aborted moves, with trace-ids) and the eviction budgets
+  (docs/defrag.md)
 * ``GET  /debug/slo``       — SLO objectives: error-budget remaining,
   burn rates per window, journey aggregates (docs/slo.md)
 * ``GET  /debug/journey/<ns>/<pod>`` — the pod's journey: creation to
@@ -75,7 +79,7 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
                  prefix: str = DEFAULT_PREFIX, prioritize=None,
                  preempt=None, admission=None, leader=None,
                  gang_planner=None, debug_routes: bool = True,
-                 workqueue=None, quota=None):
+                 workqueue=None, quota=None, defrag=None):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
@@ -104,6 +108,10 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
         #: explicitly like gang_planner: dropping it must fail loudly,
         #: not freeze the tenant gauges.
         self.quota = quota
+        #: Defrag executor (DefragExecutor), for the fragmentation
+        #: gauges in /metrics and GET /debug/defrag. Wired explicitly
+        #: like quota: dropping it must 404, not freeze the frag score.
+        self.defrag = defrag
         super().__init__(addr, _Handler)
 
 
@@ -235,7 +243,8 @@ class _Handler(BaseHTTPRequestHandler):
                                    leader=self.server.leader,
                                    demand=self.server.predicate.demand,
                                    workqueue=self.server.workqueue,
-                                   quota=self.server.quota),
+                                   quota=self.server.quota,
+                                   defrag=self.server.defrag),
                     ctype="text/plain; version=0.0.4")
             elif path.startswith("/debug/") and not self.server.debug_routes:
                 self._send_json({"Error": "debug routes disabled"}, 404)
@@ -255,6 +264,12 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send_json(
                         {"tenants": self.server.quota.snapshot()})
+            elif path == "/debug/defrag":
+                if self.server.defrag is None:
+                    self._send_json({"Error": "defrag not configured"},
+                                    404)
+                else:
+                    self._send_json(self.server.defrag.status())
             elif path.startswith("/debug/trace/"):
                 rest = path[len("/debug/trace/"):]
                 ns, sep, pod_name = rest.partition("/")

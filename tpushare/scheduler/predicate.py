@@ -126,6 +126,16 @@ class DemandTracker:
             chips = sum(e[1] for e in self._entries.values())
         return pods, hbm, chips
 
+    def shapes(self) -> list[tuple[int, int]]:
+        """Distinct (hbm GiB, chips) request shapes currently failing
+        the filter everywhere — the demand the fragmentation index
+        measures stranding against (a free splinter is only *stranded*
+        relative to what somebody is actually asking for). Pure read;
+        call after :meth:`snapshot` when freshness matters."""
+        with self._lock:
+            return sorted({(hbm, chips) for hbm, chips, _, _, _
+                           in self._entries.values()})
+
     def by_tenant(self) -> dict[str, tuple[int, int, int]]:
         """tenant -> (pods, hbm GiB, chips) of the CURRENT entries —
         whose demand the fleet cannot place. Call after :meth:`snapshot`

@@ -61,6 +61,14 @@ ANN_ASSUME_TIME = "tpushare.io/assume-time"
 #: and the device plugin ignore it.
 ANN_TRACE_ID = "tpushare.io/trace-id"
 
+#: The bind-time grant record as a unit: every annotation the extender
+#: writes when placing a pod. Rollback (gang TTL expiry) and
+#: re-request modeling (the defrag planner's what-if re-placement, the
+#: simulator's migrant recreation) strip exactly this set — one tuple,
+#: so a future grant annotation cannot be forgotten at one strip site.
+GRANT_ANNOTATIONS = (ANN_CHIP_IDX, ANN_HBM_POD, ANN_HBM_CHIP,
+                     ANN_ASSIGNED, ANN_ASSUME_TIME, ANN_TRACE_ID)
+
 # --------------------------------------------------------------------------
 # Node annotations (new — the reference had no node-side schema beyond the
 # capacity numbers and so could not express heterogeneity or topology).
@@ -207,6 +215,13 @@ ENV_USAGE_FILE = "TPUSHARE_USAGE_FILE"
 #: the DaemonSet manifest; mounted into tenant containers at the same
 #: path so ENV_USAGE_FILE is valid on both sides of the boundary).
 USAGE_DIR_DEFAULT = "/var/run/tpushare/usage"
+
+#: "true" while the pod has a checkpoint write in flight (set/cleared by
+#: the workload around its orbax save — docs/defrag.md). The defrag
+#: planner never proposes moving a pod mid-checkpoint: evicting it then
+#: would lose the save AND the progress since the previous one, turning
+#: a cheap migration into an expensive restart.
+ANN_CKPT_IN_FLIGHT = "tpushare.io/checkpoint-in-flight"
 
 #: Watchdog-reported HBM usage (GiB, one decimal) written onto the POD
 #: by the device plugin's grant watchdog — apiserver-as-store, like

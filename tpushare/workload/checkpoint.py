@@ -9,6 +9,13 @@ saving from a dp×tp×sp mesh and restoring onto a DIFFERENT mesh shape
 works because restore re-shards to the target shardings.
 
 Layout: ``<dir>/<step>/`` per step, orbax-managed, with retention.
+
+Defrag interaction (docs/defrag.md): while a save is in flight, set the
+``tpushare.io/checkpoint-in-flight: "true"`` annotation on your own pod
+(and clear it after ``wait_until_finished``) — the scheduler's
+rebalance planner never proposes moving a pod mid-checkpoint, so a
+defrag eviction cannot land between ``save`` and durability and cost
+both the checkpoint and the progress since the previous one.
 """
 
 from __future__ import annotations
