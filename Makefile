@@ -8,10 +8,13 @@ test:
 
 # Static-analysis hard gate: tools/vet (annotation-key lint, lock
 # discipline, raw-lock ban, sleep-in-handler, bare-except, strict
-# typing) + mypy --strict on the core packages where mypy exists.
-# tools/vet is stdlib-only so the gate itself needs no extra deps.
+# typing) + the whole-program flow layer (--flow: static lock-order
+# cycles, blocking-under-lock, hot-path fleet-scan budget; call-graph
+# cache under .vet_cache/ keeps the pass sub-second) + mypy --strict
+# on the core packages where mypy exists. tools/vet is stdlib-only so
+# the gate itself needs no extra deps.
 lint:
-	python -m tools.vet
+	python -m tools.vet --flow
 	@if python -c "import mypy" >/dev/null 2>&1; then \
 		python -m mypy --config-file pyproject.toml; \
 	else \

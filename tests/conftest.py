@@ -70,3 +70,31 @@ def api():
 def v5e_node(api):
     """One v5e host: 4 chips x 16 GiB, 2x2 mesh."""
     return api.create_node(make_node("v5e-node-0"))
+
+
+class LockProbeClient:
+    """Wraps a fake apiserver, recording which TracingRLock sites the
+    calling thread held during every apiserver round-trip — the
+    runtime twin of vet-flow's ``blocking-under-lock`` rule. Used by
+    the lock-discipline regression tests in test_ledger.py and
+    test_gang_lifecycle.py."""
+
+    def __init__(self, api):
+        self._api = api
+        self.held_during = []
+
+    def __getattr__(self, name):
+        real = getattr(self._api, name)
+        if not callable(real):
+            return real
+
+        def probed(*args, **kwargs):
+            from tpushare.utils import locks
+            self.held_during.append((name, locks.held_sites()))
+            return real(*args, **kwargs)
+        return probed
+
+    def assert_never_held(self, *site_prefixes):
+        for name, held in self.held_during:
+            assert not any(site.startswith(site_prefixes)
+                           for site in held), (name, held)

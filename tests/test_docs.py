@@ -17,6 +17,7 @@ OBSERVABILITY_MD = os.path.join(REPO_ROOT, "docs", "observability.md")
 QUOTA_MD = os.path.join(REPO_ROOT, "docs", "quota.md")
 SLO_MD = os.path.join(REPO_ROOT, "docs", "slo.md")
 DEFRAG_MD = os.path.join(REPO_ROOT, "docs", "defrag.md")
+VET_MD = os.path.join(REPO_ROOT, "docs", "vet.md")
 
 _METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
 
@@ -165,6 +166,46 @@ def test_defrag_doc_is_linked():
             assert "defrag.md" in f.read(), path
 
 
+def test_vet_doc_covers_the_flow_layer():
+    """docs/vet.md is the analysis-gate contract: it must keep naming
+    every flow rule, the call-graph/summary model, the budget-manifest
+    ratchet, the cache, the pragma-inventory surface, and the runbook
+    for a new violation."""
+    with open(VET_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("--flow", "static-lock-order", "blocking-under-lock",
+                   "hotpath-complexity", "hotpath_budget.json",
+                   "call graph", "may_block", "FLOW_DECLARED_SITES",
+                   "reserve under lock", "may only shrink",
+                   "--list-pragmas", "justification", ".vet_cache",
+                   "Runbook", "mtime", "Fake*",
+                   "Predicate.handle", "Bind.handle"):
+        assert needle in doc, needle
+    # Every flow rule id the analyzer exposes is documented.
+    import ast as _ast
+    flow_init = os.path.join(REPO_ROOT, "tools", "vet", "flow",
+                             "analysis.py")
+    with open(flow_init, encoding="utf-8") as f:
+        tree = _ast.parse(f.read())
+    ids = []
+    for node in _ast.walk(tree):
+        if (isinstance(node, _ast.Assign)
+                and any(getattr(t, "id", "") == "FLOW_RULE_IDS"
+                        for t in node.targets)):
+            ids = [c.value for c in node.value.elts]
+    assert ids, "FLOW_RULE_IDS literal not found"
+    missing = [i for i in ids if f"`{i}`" not in doc]
+    assert not missing, f"flow rules absent from docs/vet.md: {missing}"
+
+
+def test_vet_doc_is_linked():
+    """README and the user guide must keep pointing at the analysis
+    gate's contract."""
+    for rel in ("README.md", os.path.join("docs", "userguide.md")):
+        with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+            assert "vet.md" in f.read(), rel
+
+
 def test_slo_doc_is_linked():
     """observability.md (the catalogue), the README, and the user
     guide must keep pointing at the SLO contract."""
@@ -190,7 +231,9 @@ if __name__ == "__main__":
                   test_slo_doc_covers_the_contract,
                   test_slo_doc_is_linked,
                   test_defrag_doc_covers_the_contract,
-                  test_defrag_doc_is_linked):
+                  test_defrag_doc_is_linked,
+                  test_vet_doc_covers_the_flow_layer,
+                  test_vet_doc_is_linked):
         try:
             check()
         except AssertionError as e:

@@ -537,3 +537,156 @@ class TestBoundMembersCountTowardQuorum:
         final = api.get_pod("default", "w0-new")
         assert final.node_name == "h0"
         assert podutils.is_assumed(final)
+
+
+from tests.conftest import LockProbeClient
+
+
+class TestGangLockDiscipline:
+    """Regression for vet-flow's blocking-under-lock findings: the
+    reserve path used to hold the per-group lock across the member's
+    annotation write, the quorum pre-check's node walk, the retry
+    tick's binding POSTs, and expiry's annotation strips — a slow
+    apiserver would stall every sibling member's bind."""
+
+    def test_reserve_and_commit_io_runs_outside_group_lock(self, api):
+        cache = make_cluster(api)
+        client = LockProbeClient(api)
+        planner = GangPlanner(cache, client, ttl=60)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
+        planner.bind_member(p1, "host-1")  # reaches quorum, commits
+        calls = [name for name, _ in client.held_during]
+        assert "update_pod" in calls and "bind_pod" in calls
+        client.assert_never_held("gang/")
+        assert api.get_pod("default", "w0").node_name == "host-0"
+        assert api.get_pod("default", "w1").node_name == "host-1"
+
+    def test_retry_unbound_posts_outside_group_lock(self, api):
+        cache = make_cluster(api)
+        flaky = FlakyBindClient(api, fail_names={"w0"})
+        probe = LockProbeClient(flaky)
+        planner = GangPlanner(cache, probe, ttl=60)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        p1 = api.create_pod(make_pod("w1", chips=4, annotations=ANN))
+        planner.bind_member(p1, "host-1")
+        probe.held_during.clear()
+        assert planner.retry_unbound() == 1
+        probe.assert_never_held("gang/")
+        assert api.get_pod("default", "w0").node_name == "host-0"
+
+    def test_expiry_rollback_strips_outside_group_lock(self, api):
+        cache = make_cluster(api)
+        client = LockProbeClient(api)
+        planner = GangPlanner(cache, client, ttl=0.01)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(p0, "host-0")
+        time.sleep(0.02)
+        client.held_during.clear()
+        assert planner.expire_stale() == 1
+        strip_calls = [n for n, _ in client.held_during
+                       if n in ("get_pod", "update_pod")]
+        assert strip_calls, "expiry must strip the member's annotations"
+        client.assert_never_held("gang/")
+        # Rollback is complete: ledger free, annotations gone.
+        assert len(cache.get_node_info("host-0").get_free_chips()) == 4
+        assert const.ANN_CHIP_IDX not in \
+            api.get_pod("default", "w0").annotations
+
+    def test_reserve_retry_during_expiry_rollback_is_refused(self, api):
+        """Review finding: expiry must not hand the group key to a
+        fresh same-key group while its rollback's apiserver traffic is
+        still in flight — the stale rollback (remove_pod by uid +
+        annotation strip) would destroy the NEW reservation's charge:
+        double allocation. A retry mid-rollback is refused; after the
+        rollback it reserves cleanly."""
+        import threading
+
+        cache = make_cluster(api)
+        entered = threading.Event()
+        hold = threading.Event()
+
+        class SlowStripClient:
+            def __getattr__(self, name):
+                return getattr(api, name)
+
+            def get_pod(self, ns, name):
+                # _strip_annotations' fetch: park the rollback here.
+                entered.set()
+                hold.wait(5)
+                return api.get_pod(ns, name)
+
+        planner = GangPlanner(cache, SlowStripClient(), ttl=0.01)
+        w0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(GangPending):
+            planner.bind_member(w0, "host-0")
+        time.sleep(0.02)
+        t = threading.Thread(target=planner.expire_stale)
+        t.start()
+        assert entered.wait(5)
+        # Mid-rollback: the victim's scheduler retry must be refused —
+        # NOT allocated into the dying group or a fresh same-key one.
+        fresh = api.get_pod("default", "w0")
+        from tpushare.cache.nodeinfo import AllocationError
+        with pytest.raises(AllocationError, match="rollback in progress"):
+            planner.bind_member(fresh, "host-0")
+        hold.set()
+        t.join(5)
+        # Rollback complete: ledger free, annotations stripped, and the
+        # next retry reserves into a fresh group.
+        assert planner.stats() == {}
+        assert len(cache.get_node_info("host-0").get_free_chips()) == 4
+        fresh2 = api.get_pod("default", "w0")
+        assert const.ANN_CHIP_IDX not in fresh2.annotations
+        with pytest.raises(GangPending):
+            planner.bind_member(fresh2, "host-0")
+
+    def test_duplicate_inflight_reserve_of_same_member_is_refused(self, api):
+        """Review finding: with the group lock no longer spanning the
+        allocate I/O, a duplicate bind RPC for the SAME member must be
+        refused while the first is mid-allocate — allocating twice
+        would double-charge the ledger and leak the overwritten
+        reservation's chips."""
+        import threading
+
+        cache = make_cluster(api)
+        entered = threading.Event()
+        hold = threading.Event()
+
+        class SlowWriteClient:
+            def __getattr__(self, name):
+                return getattr(api, name)
+
+            def update_pod(self, pod):
+                entered.set()
+                hold.wait(5)
+                return api.update_pod(pod)
+
+        planner = GangPlanner(cache, SlowWriteClient(), ttl=60)
+        w0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        results = []
+
+        def first():
+            try:
+                planner.bind_member(w0, "host-0")
+            except Exception as e:
+                results.append(e)
+
+        t = threading.Thread(target=first)
+        t.start()
+        assert entered.wait(5)
+        # Duplicate RPC while the first allocate is in flight:
+        from tpushare.cache.nodeinfo import AllocationError
+        with pytest.raises(AllocationError, match="already in flight"):
+            planner.bind_member(w0, "host-1")
+        hold.set()
+        t.join(5)
+        assert results and isinstance(results[0], GangPending)
+        # Exactly ONE reservation's chips charged, on host-0 only.
+        assert len(cache.get_node_info("host-0").get_free_chips()) == 0
+        assert len(cache.get_node_info("host-1").get_free_chips()) == 4

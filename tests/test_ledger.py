@@ -225,3 +225,111 @@ class TestSpreadChipPick:
         pod = Pod(make_pod("q", hbm=4,
                            annotations={const.ANN_SCORING: "binpack"}))
         assert info.pick_chips(pod) == [2]
+
+
+from tests.conftest import LockProbeClient
+
+
+class TestAllocateLockDiscipline:
+    """Regression for vet-flow's blocking-under-lock finding: the
+    allocate commit path used to hold the node ledger lock across the
+    annotation PUT and the binding POST — an apiserver hiccup would
+    stall every filter/bind verb touching that node."""
+
+    def test_apiserver_writes_run_outside_the_ledger_lock(self, api,
+                                                          v5e_node):
+        info = NodeInfo(v5e_node)
+        client = LockProbeClient(api)
+        pod = api.create_pod(make_pod("p", hbm=4))
+        info.allocate(client, pod)
+        calls = [name for name, _ in client.held_during]
+        assert "update_pod" in calls and "bind_pod" in calls
+        client.assert_never_held("node/", "chip/")
+
+    def test_provisional_hold_blocks_concurrent_double_grant(self, api):
+        """Between the pick and the apiserver commit the chips must
+        already be charged: a second allocate in that window cannot be
+        granted the same capacity."""
+        node = api.create_node(make_node("n", chip_hbm=[16]))
+        info = NodeInfo(node)
+
+        class MidFlightClient:
+            def __init__(self, inner):
+                self._inner = inner
+                self.seen_mid_flight = None
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def update_pod(self, pod):
+                # The ledger must already hold the grant while this
+                # write is in flight (lock released, chips charged).
+                self.seen_mid_flight = info.get_available_hbm()[0]
+                return self._inner.update_pod(pod)
+
+        client = MidFlightClient(api)
+        pod = api.create_pod(make_pod("p", hbm=10))
+        info.allocate(client, pod)
+        assert client.seen_mid_flight == 6  # 16 - 10, charged pre-write
+
+    def test_failed_write_rolls_back_the_provisional_hold(self, api):
+        node = api.create_node(make_node("n", chip_hbm=[16]))
+        info = NodeInfo(node)
+
+        class BrokenClient:
+            def __getattr__(self, name):
+                return getattr(api, name)
+
+            def update_pod(self, pod):
+                from tpushare.k8s.errors import ApiError
+                raise ApiError(500, reason="boom")
+
+        pod = api.create_pod(make_pod("p", hbm=10))
+        with pytest.raises(Exception):
+            info.allocate(BrokenClient(), pod)
+        # No phantom charge: the full chip is free again.
+        assert info.get_available_hbm()[0] == 16
+        assert info.get_free_chips() == [0]
+
+    def test_failed_bind_rolls_back_the_provisional_hold(self, api):
+        node = api.create_node(make_node("n", chip_hbm=[16]))
+        info = NodeInfo(node)
+
+        class NoBindClient:
+            def __getattr__(self, name):
+                return getattr(api, name)
+
+            def bind_pod(self, binding):
+                from tpushare.k8s.errors import ApiError
+                raise ApiError(500, reason="bind down")
+
+        pod = api.create_pod(make_pod("p", hbm=10))
+        with pytest.raises(Exception):
+            info.allocate(NoBindClient(), pod)
+        assert info.get_available_hbm()[0] == 16
+
+    def test_delete_during_write_window_is_not_resurrected(self, api):
+        """Review finding: a pod deleted while allocate's apiserver
+        writes are in flight (the informer's remove_pod freeing the
+        provisional hold) must NOT be re-charged by the post-write
+        re-price — that DELETE was consumed and nothing would ever
+        free the charge again."""
+        node = api.create_node(make_node("n", chip_hbm=[16]))
+        info = NodeInfo(node)
+
+        class DeleteMidFlightClient:
+            def __getattr__(self, name):
+                return getattr(api, name)
+
+            def bind_pod(self, binding):
+                api.bind_pod(binding)
+                # The informer observes the pod's deletion and frees
+                # its ledger entry while allocate's lock is released.
+                info.remove_pod(
+                    api.get_pod("default", binding["metadata"]["name"]))
+
+        pod = api.create_pod(make_pod("p", hbm=10))
+        info.allocate(DeleteMidFlightClient(), pod)
+        # No phantom charge survives.
+        assert info.get_available_hbm()[0] == 16
+        assert info.get_free_chips() == [0]

@@ -549,3 +549,253 @@ def test_self_and_cls_are_exempt():
            "    def c(cls) -> None:\n"
            "        pass\n")
     assert check_source(src, "tpushare/cache/mod.py", TYPING_RULES) == []
+
+
+# ------------------------------------------------------------------------ #
+# Engine 4: whole-program flow analysis (tools/vet/flow)
+# ------------------------------------------------------------------------ #
+
+import json
+import shutil
+import time as _time
+
+from tools.vet import flow
+from tools.vet.flow import analysis as flow_analysis
+from tools.vet.engine import iter_pragmas, pragma_justified
+
+
+def _copy_tree(tmp_path):
+    """A scratch copy of the real tpushare/ package for seeding
+    defects into (the acceptance contract: each mutation must fail
+    lint on an otherwise-clean tree)."""
+    dst = tmp_path / "tpushare"
+    shutil.copytree(os.path.join(REPO_ROOT, "tpushare"), dst,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    return tmp_path
+
+
+def _flow_rules_hit(root):
+    return {v.rule for v in flow.analyze(str(root))}
+
+
+def test_flow_tree_is_clean_and_fast():
+    """`make lint --flow`'s hard gate: zero unjustified violations on
+    the shipped tree — AND the analyzer itself must not become the
+    slow path (satellite contract: whole pass under 5 s, cold cache)."""
+    t0 = _time.monotonic()
+    violations = flow.analyze(cache_path=None)
+    elapsed = _time.monotonic() - t0
+    assert violations == [], "\n".join(v.render() for v in violations)
+    assert elapsed < 5.0, f"flow pass took {elapsed:.2f}s (budget: 5s)"
+
+
+def test_flow_cache_reuses_unchanged_files(tmp_path):
+    root = _copy_tree(tmp_path)
+    cache_file = str(tmp_path / "cache" / "flow.json")
+    p1 = flow_analysis.build_program(str(root), cache_path=cache_file)
+    assert p1.stats["parsed"] > 50 and p1.stats["cached"] == 0
+    p2 = flow_analysis.build_program(str(root), cache_path=cache_file)
+    assert p2.stats["parsed"] == 0
+    assert p2.stats["cached"] == p1.stats["parsed"]
+    # Touching one file re-parses exactly that file.
+    victim = root / "tpushare" / "cache" / "cache.py"
+    os.utime(victim, (os.stat(victim).st_atime,
+                      os.stat(victim).st_mtime + 10))
+    p3 = flow_analysis.build_program(str(root), cache_path=cache_file)
+    assert p3.stats["parsed"] == 1
+    # And the cached program analyzes identically (clean).
+    assert flow.analyze(str(root), program=p3) == []
+
+
+def test_flow_catches_seeded_lock_order_cycle(tmp_path):
+    """Seeded defect 1: two functions taking the same pair of locks in
+    opposite orders — a cycle in the static acquisition graph, caught
+    with no test ever interleaving the threads."""
+    root = _copy_tree(tmp_path)
+    (root / "tpushare" / "badcycle.py").write_text(
+        "from tpushare.utils import locks\n"
+        "A = locks.TracingRLock('seeded/a')\n"
+        "B = locks.TracingRLock('seeded/b')\n"
+        "def ab() -> None:\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+        "def ba() -> None:\n"
+        "    with B:\n"
+        "        with A:\n"
+        "            pass\n")
+    vs = flow.analyze(str(root))
+    cycles = [v for v in vs if v.rule == "static-lock-order"]
+    assert cycles, vs
+    assert any("seeded/a" in v.message and "seeded/b" in v.message
+               for v in cycles)
+
+
+def test_flow_catches_seeded_blocking_under_ledger_lock(tmp_path):
+    """Seeded defect 2: an apiserver round-trip (a call reaching
+    k8s/client._request) inside the scheduler cache's ledger lock."""
+    root = _copy_tree(tmp_path)
+    cache_py = root / "tpushare" / "cache" / "cache.py"
+    src = cache_py.read_text()
+    anchor = "    def get_node_infos(self)"
+    bad = ("    def _seeded_refresh(self, client: object) -> None:\n"
+           "        with self._lock:\n"
+           "            client.update_pod(None)\n\n")
+    assert anchor in src
+    cache_py.write_text(src.replace(anchor, bad + anchor, 1))
+    vs = flow.analyze(str(root))
+    hits = [v for v in vs if v.rule == "blocking-under-lock"]
+    assert hits, vs
+    assert any("cache/table" in v.message and "update_pod" in v.message
+               for v in hits)
+
+
+def test_flow_catches_seeded_unbudgeted_fleet_scan(tmp_path):
+    """Seeded defect 3: a full-fleet materialization on the filter
+    verb with no budget-manifest entry — the indexed-admission
+    ratchet's teeth."""
+    root = _copy_tree(tmp_path)
+    pred_py = root / "tpushare" / "scheduler" / "predicate.py"
+    src = pred_py.read_text()
+    anchor = "        passed_names: list[str] = []"
+    assert anchor in src
+    pred_py.write_text(src.replace(
+        anchor,
+        "        _fleet = self.cache.get_node_infos()\n" + anchor, 1))
+    vs = flow.analyze(str(root))
+    hits = [v for v in vs if v.rule == "hotpath-complexity"]
+    assert any("get_node_infos" in v.message
+               and "Predicate.handle" in v.message for v in hits), vs
+
+
+def test_budget_manifest_entries_carry_justifications():
+    """Acceptance: every checked-in budget entry is justified, and the
+    analyzer rejects an entry whose justification is stripped."""
+    with open(flow_analysis.DEFAULT_BUDGET_PATH, encoding="utf-8") as f:
+        budget = json.load(f)
+    assert budget["entries"], "manifest must list the live fleet scans"
+    for entry in budget["entries"]:
+        assert entry.get("justification", "").strip(), entry["id"]
+    # Strip one justification: the gate must fail.
+    stripped = {"entries": [dict(e) for e in budget["entries"]]}
+    stripped["entries"][0]["justification"] = ""
+    vs = flow.analyze(budget=stripped)
+    assert any(v.rule == "hotpath-complexity"
+               and "no justification" in v.message for v in vs), vs
+
+
+def test_stale_budget_entry_fails_the_ratchet():
+    """The manifest may only shrink: an entry with no matching live
+    scan (e.g. left behind by an indexing refactor) fails lint."""
+    with open(flow_analysis.DEFAULT_BUDGET_PATH, encoding="utf-8") as f:
+        budget = json.load(f)
+    budget["entries"].append({
+        "id": "tpushare/scheduler/predicate.py::Predicate.gone::_nodes",
+        "justification": "a scan that no longer exists"})
+    vs = flow.analyze(budget=budget)
+    assert any(v.rule == "hotpath-complexity" and "stale" in v.message
+               for v in vs), vs
+
+
+def test_flow_respects_pragmas(tmp_path):
+    """A flow finding is suppressible exactly like a per-file finding —
+    rule-scoped, with the standard pragma syntax."""
+    root = _copy_tree(tmp_path)
+    cache_py = root / "tpushare" / "cache" / "cache.py"
+    src = cache_py.read_text()
+    anchor = "    def get_node_infos(self)"
+    bad = ("    def _seeded_refresh(self, client: object) -> None:\n"
+           "        with self._lock:\n"
+           "            # vet: ignore[blocking-under-lock] - seeded test fixture\n"
+           "            client.update_pod(None)\n\n")
+    cache_py.write_text(src.replace(anchor, bad + anchor, 1))
+    vs = flow.analyze(str(root))
+    assert not any(v.rule == "blocking-under-lock" for v in vs), vs
+
+
+# ------------------------------------------------------------------------ #
+# Pragma inventory: the exception surface is reviewable
+# ------------------------------------------------------------------------ #
+
+
+def _all_known_rule_ids():
+    return ({r.rule_id for r in ALL_RULES}
+            | set(flow_analysis.FLOW_RULE_IDS))
+
+
+def test_every_pragma_carries_a_justification():
+    """Every real `# vet: ignore[...]` pragma in the tree must carry
+    trailing prose saying WHY — an exception with no stated reason is
+    not reviewable. (Doc prose that merely mentions the syntax names
+    no real rule id and is exempt.)"""
+    known = _all_known_rule_ids()
+    roots = [os.path.join(REPO_ROOT, "tpushare"),
+             os.path.join(REPO_ROOT, "tools")]
+    naked = []
+    total = 0
+    for path in iter_py_files(roots):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for lineno, ids, justification in iter_pragmas(src):
+            if not set(ids) & known:
+                continue
+            total += 1
+            # Same predicate as `--list-pragmas`: the CLI must never
+            # pass a pragma this gate rejects.
+            if not pragma_justified(justification):
+                naked.append(f"{path}:{lineno} [{', '.join(ids)}]")
+    assert total >= 10  # the inventory extractor must not go vacuous
+    assert not naked, ("pragmas without a trailing justification:\n"
+                       + "\n".join(naked))
+
+
+def test_list_pragmas_cli(capsys):
+    """`python -m tools.vet --list-pragmas` renders the inventory and
+    exits 0 while every pragma is justified."""
+    from tools.vet.__main__ import main
+    assert main(["--list-pragmas"]) == 0
+    out = capsys.readouterr().out
+    assert "deviceplugin/plugin.py" in out
+    assert "blocking-under-lock" in out
+    assert "NO JUSTIFICATION" not in out
+
+
+def test_cli_rule_flag_with_flow_rule_runs_the_flow_pass(capsys):
+    """Review finding: `--rule <flow-rule-id>` without `--flow` used to
+    run zero rules and report a false 'clean' — asking for a flow rule
+    must run the flow pass."""
+    from tools.vet.__main__ import main
+    assert main(["--rule", "blocking-under-lock",
+                 "--no-flow-cache"]) == 0
+    out = capsys.readouterr().out
+    assert "+ flow" in out  # the flow pass actually ran
+
+
+def test_file_pragma_beyond_line_20_is_not_inventoried():
+    """Review finding: _pragma_sets only honors ignore-file pragmas in
+    the first 20 lines; the inventory must apply the same scope rule or
+    it advertises exceptions that suppress nothing."""
+    live = "# vet: ignore-file[raw-lock] - early enough to be live\n"
+    dead = ("\n" * 25
+            + "# vet: ignore-file[raw-lock] - too deep, inert\n")
+    assert any("raw-lock" in ids for _, ids, _ in iter_pragmas(live))
+    assert not iter_pragmas(dead)
+    # inline pragmas stay inventoried at any depth
+    deep_inline = "\n" * 25 + "x = 1  # vet: ignore[raw-lock] - why\n"
+    assert any("raw-lock" in ids
+               for _, ids, _ in iter_pragmas(deep_inline))
+
+
+def test_cli_paths_scope_flow_findings():
+    """Review finding: `tools.vet <path> --flow` must report flow
+    findings only for files under the requested paths (the analysis
+    itself is whole-program)."""
+    from tools.vet.__main__ import _scope_violations
+    from tools.vet.engine import Violation
+    vs = [Violation(os.path.join(REPO_ROOT, "tpushare", "cache",
+                                 "cache.py"), 1, 0, "x", "m"),
+          Violation(os.path.join(REPO_ROOT, "tpushare", "slo",
+                                 "engine.py"), 1, 0, "x", "m")]
+    scoped = _scope_violations(vs, [os.path.join(REPO_ROOT, "tpushare",
+                                                 "slo")])
+    assert [v.path for v in scoped] == [vs[1].path]

@@ -462,9 +462,9 @@ def whatif_preempt(endpoint: str, hbm: int, chips: int, priority: int,
     # This plugin is deliberately stdlib-only (it is copied bare onto
     # PATH as a kubectl plugin), so it cannot import utils/const.
     if chips > 0:
-        limits["tpushare.io/tpu-chip"] = str(chips)  # vet: ignore[annotation-literal]
+        limits["tpushare.io/tpu-chip"] = str(chips)  # vet: ignore[annotation-literal] - standalone kubectl plugin cannot import const
     else:
-        limits["tpushare.io/tpu-hbm"] = str(hbm)  # vet: ignore[annotation-literal]
+        limits["tpushare.io/tpu-hbm"] = str(hbm)  # vet: ignore[annotation-literal] - standalone kubectl plugin cannot import const
     review = {
         "Pod": {
             "apiVersion": "v1", "kind": "Pod",

@@ -4,6 +4,16 @@ Default scan roots are ``tpushare/`` and ``tools/`` relative to the
 repo root (found via this file's location, so the gate behaves the same
 from any CWD). Exit 1 on any violation — this is the hard-gate half of
 ``make lint``; ``make test-race`` arms the runtime detector.
+
+``--flow`` additionally runs the whole-program analysis layer
+(:mod:`tools.vet.flow`): static lock-order cycles, blocking ops
+reachable from lock scopes, and the hot-path fleet-scan budget. Its
+call-graph summaries are cached under ``.vet_cache/`` keyed on file
+mtime+size, so the pass stays sub-second on a warm tree.
+
+``--list-pragmas`` inventories every ``# vet: ignore[...]`` pragma in
+the tree with its file:line, rule ids, and trailing justification —
+the whole exception surface on one screen for review.
 """
 
 from __future__ import annotations
@@ -12,7 +22,8 @@ import argparse
 import os
 import sys
 
-from tools.vet.engine import check_tree
+from tools.vet.engine import (check_tree, iter_pragmas, iter_py_files,
+                              pragma_justified)
 from tools.vet.rules import LINT_RULES
 from tools.vet.typing_rules import TYPING_RULES
 
@@ -20,6 +31,43 @@ REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
 ALL_RULES = LINT_RULES + TYPING_RULES
+
+FLOW_CACHE_PATH = os.path.join(REPO_ROOT, ".vet_cache", "flow.json")
+
+
+def _list_pragmas(roots: list[str]) -> int:
+    from tools.vet.flow import FLOW_RULE_IDS
+
+    known = {r.rule_id for r in ALL_RULES} | set(FLOW_RULE_IDS)
+    count = 0
+    missing = 0
+    for path in iter_py_files(roots):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        for lineno, ids, justification in iter_pragmas(src):
+            if not set(ids) & known:
+                continue  # prose MENTIONING the syntax, not a pragma
+            count += 1
+            rel = os.path.relpath(path, REPO_ROOT)
+            ok = pragma_justified(justification)
+            tag = justification if ok else (
+                f"(NO JUSTIFICATION: {justification!r})" if justification
+                else "(NO JUSTIFICATION)")
+            if not ok:
+                missing += 1
+            print(f"{rel}:{lineno}: [{', '.join(ids)}] {tag}")
+    print(f"tools.vet: {count} pragma(s), "
+          f"{missing} without a justification", file=sys.stderr)
+    return 1 if missing else 0
+
+
+def _scope_violations(violations, paths):
+    """Only violations whose file sits under one of ``paths`` (the flow
+    analysis always reads the whole program; its report honors the
+    CLI's path restriction)."""
+    prefixes = tuple(os.path.abspath(p) for p in paths)
+    return [v for v in violations
+            if os.path.abspath(v.path).startswith(prefixes)]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -31,36 +79,78 @@ def main(argv: list[str] | None = None) -> int:
                              "(default: tpushare/ and tools/)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print every rule id and exit")
+    parser.add_argument("--list-pragmas", action="store_true",
+                        help="inventory every vet pragma in the tree "
+                             "(file:line, rule ids, justification) "
+                             "and exit; exit 1 if any pragma lacks a "
+                             "justification")
     parser.add_argument("--rule", action="append", default=None,
                         metavar="RULE-ID",
                         help="run only this rule (repeatable)")
+    parser.add_argument("--flow", action="store_true",
+                        help="also run the whole-program flow analysis "
+                             "(lock order, blocking-under-lock, "
+                             "hot-path budget)")
+    parser.add_argument("--no-flow-cache", action="store_true",
+                        help="ignore and do not write the flow "
+                             "call-graph cache")
     opts = parser.parse_args(argv)
 
     if opts.list_rules:
+        from tools.vet.flow import FLOW_RULE_IDS
+
         for rule in ALL_RULES:
             doc = ((rule.__doc__ or "").strip().splitlines() or [""])[0]
             print(f"{rule.rule_id:20s} {doc}")
+        for rule_id in FLOW_RULE_IDS:
+            print(f"{rule_id:20s} whole-program flow rule "
+                  "(--flow; see docs/vet.md)")
         return 0
+
+    roots = opts.paths or [os.path.join(REPO_ROOT, "tpushare"),
+                           os.path.join(REPO_ROOT, "tools")]
+
+    if opts.list_pragmas:
+        return _list_pragmas(roots)
 
     rules = ALL_RULES
     if opts.rule:
+        # Import lazily: plain per-file runs never load the flow layer.
+        from tools.vet.flow import FLOW_RULE_IDS
+
         known = {r.rule_id for r in ALL_RULES}
-        unknown = set(opts.rule) - known
+        unknown = set(opts.rule) - known - set(FLOW_RULE_IDS)
         if unknown:
             print(f"unknown rule(s): {', '.join(sorted(unknown))}",
                   file=sys.stderr)
             return 2
+        if set(opts.rule) & set(FLOW_RULE_IDS):
+            # Asking for a flow rule IS asking for the flow pass —
+            # silently running zero rules would report a false "clean".
+            opts.flow = True
         rules = tuple(r for r in ALL_RULES if r.rule_id in opts.rule)
 
-    roots = opts.paths or [os.path.join(REPO_ROOT, "tpushare"),
-                           os.path.join(REPO_ROOT, "tools")]
-    violations = check_tree(roots, rules)
+    violations = list(check_tree(roots, rules))
+    if opts.flow:
+        from tools.vet.flow import analyze
+
+        # The flow pass is whole-program by nature (its call graph must
+        # see every module), but its FINDINGS are scoped to the paths
+        # the user asked about.
+        flow = analyze(cache_path=None if opts.no_flow_cache
+                       else FLOW_CACHE_PATH)
+        if opts.paths:
+            flow = _scope_violations(flow, opts.paths)
+        if opts.rule:
+            flow = [v for v in flow if v.rule in opts.rule]
+        violations.extend(flow)
     for v in violations:
         print(v.render())
     if violations:
         print(f"tools.vet: {len(violations)} violation(s)", file=sys.stderr)
         return 1
-    print(f"tools.vet: clean ({len(rules)} rules)")
+    suffix = " + flow" if opts.flow else ""
+    print(f"tools.vet: clean ({len(rules)} rules{suffix})")
     return 0
 
 

@@ -57,6 +57,44 @@ def iter_py_files(roots: Sequence[str]) -> Iterator[str]:
                     yield os.path.join(dirpath, fn)
 
 
+#: A pragma's trailing justification must be at least this much prose
+#: to count — shared by ``--list-pragmas`` and the tests/test_vet.py
+#: gate so the CLI can never pass a pragma the suite rejects.
+MIN_JUSTIFICATION_LEN = 10
+
+
+def pragma_justified(justification: str) -> bool:
+    return len(justification.strip()) >= MIN_JUSTIFICATION_LEN
+
+
+def iter_pragmas(src: str) -> list[tuple[int, tuple[str, ...], str]]:
+    """Every ``# vet: ignore[...]`` / ``ignore-file[...]`` pragma in
+    ``src`` as ``(lineno, rule ids, trailing justification text)``.
+
+    The justification is whatever prose follows the closing bracket on
+    the pragma's own comment — the reviewable WHY the inventory
+    (``--list-pragmas``) surfaces and ``tests/test_vet.py`` requires to
+    be non-empty: an exception with no stated reason is not reviewable.
+    """
+    out: list[tuple[int, tuple[str, ...], str]] = []
+    for lineno, line in enumerate(src.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if m is None:
+            # Same scope rule as _pragma_sets: an ignore-file pragma is
+            # only LIVE in the first 20 lines — listing one beyond that
+            # would advertise an exception that suppresses nothing.
+            if lineno > 20:
+                continue
+            m = _FILE_PRAGMA_RE.search(line)
+        if not m:
+            continue
+        ids = tuple(sorted(r.strip() for r in m.group(1).split(",")
+                           if r.strip()))
+        trailing = line[m.end():].strip().lstrip("-—:,. ").strip()
+        out.append((lineno, ids, trailing))
+    return out
+
+
 def _pragma_sets(src: str) -> tuple[set[str], dict[int, set[str]]]:
     """(file-wide ignored rules, line -> rules ignored on that line)."""
     file_ignores: set[str] = set()

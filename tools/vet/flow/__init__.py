@@ -1,0 +1,44 @@
+"""vet-flow: whole-program lock/blocking/complexity analysis.
+
+The per-file rules in :mod:`tools.vet.rules` see one AST at a time;
+the invariants that make the extender's hot path fast and its HA story
+possible are *interprocedural*:
+
+* **static-lock-order** — a cycle in the statically-derived lock
+  acquisition graph (``with A:`` somewhere reaching ``with B:``, and
+  ``with B:`` elsewhere reaching ``with A:``) is a potential deadlock
+  even if no test run ever interleaves it. Complements the runtime
+  detector in ``tpushare/utils/locks.py``, which only sees schedules
+  the tests happen to exercise.
+* **blocking-under-lock** — any path from a ``with <lock>:`` body to a
+  blocking operation (``k8s/client._request`` and everything built on
+  it, ``time.sleep``, socket/HTTP, ``pods/eviction``) fails. A ledger
+  lock held across an apiserver round-trip stalls every verb that
+  touches that ledger; this is the property that keeps filter/bind
+  jitter bounded and makes multi-replica binds viable.
+* **hotpath-complexity** — the verb entry points (filter / prioritize /
+  preempt / bind) are roots; any reachable materialization of, or loop
+  over, a full-fleet collection (``get_node_infos``, ``_known_pods``,
+  apiserver LISTs, the candidate list) must carry an entry in the
+  checked-in budget manifest ``tools/vet/hotpath_budget.json``. The
+  manifest may only shrink: a stale entry is itself a violation, so
+  indexed-admission refactors ratchet the fleet-scan count down.
+
+The analysis is stdlib-``ast`` only, like the rest of vet: a
+module-resolved call graph of ``tpushare/`` (see
+:mod:`tools.vet.flow.callgraph`), per-function summaries of lock
+acquisitions / blocking facts / fleet scans, and a fixpoint propagation
+over the call edges (:mod:`tools.vet.flow.analysis`). Per-file
+summaries are cached keyed on (mtime, size) so ``make lint`` re-parses
+only what changed (:mod:`tools.vet.flow.fscache`).
+
+Findings respect the same ``# vet: ignore[rule-id]`` pragma layer as
+every other rule; docs/vet.md documents the model and the runbook for
+a new violation.
+"""
+
+from __future__ import annotations
+
+from tools.vet.flow.analysis import FLOW_RULE_IDS, analyze
+
+__all__ = ["analyze", "FLOW_RULE_IDS"]

@@ -1,0 +1,504 @@
+"""Interprocedural propagation + the three vet-flow rules.
+
+Assembles the per-module summaries from :mod:`tools.vet.flow.callgraph`
+into one program, resolves every call spec to its candidate targets,
+and computes two fixpoints over the call graph:
+
+* ``may_block`` — the function performs (or can reach) a blocking
+  operation: ``time.sleep``, a socket/HTTP primitive, or anything built
+  on ``k8s/client._request`` (which contains the ``urlopen``);
+* ``acquires*`` — the transitive set of lock sites a call into the
+  function may take.
+
+On top of those:
+
+* **static-lock-order**: edges ``A → B`` wherever ``B`` is acquired
+  (lexically or transitively through a call) while ``A`` is held; any
+  cycle fails lint.
+* **blocking-under-lock**: a direct blocking op, or a call to a
+  ``may_block`` function, lexically inside a ``with <lock>:`` body.
+* **hotpath-complexity**: fleet scans reachable from the verb roots
+  must appear in the budget manifest; manifest entries that no longer
+  match a live scan are *stale* and also fail (the ratchet — the
+  manifest may only shrink), as are entries with no justification.
+
+Violations carry real ``path:line`` anchors and flow through the same
+``# vet: ignore[rule-id]`` pragma layer as every per-file rule.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterable
+
+from tools.vet.engine import Violation, _pragma_sets, iter_py_files
+from tools.vet.flow import fscache
+from tools.vet.flow.callgraph import summarize_module
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+#: The verb entry points — roots of the hot-path reachability walk.
+HOTPATH_ROOTS = (
+    "tpushare.scheduler.predicate.Predicate.handle",
+    "tpushare.scheduler.prioritize.Prioritize.handle",
+    "tpushare.scheduler.preempt.Preempt.handle",
+    "tpushare.scheduler.bind.Bind.handle",
+)
+
+DEFAULT_BUDGET_PATH = os.path.join(
+    REPO_ROOT, "tools", "vet", "hotpath_budget.json")
+
+FLOW_RULE_IDS = ("static-lock-order", "blocking-under-lock",
+                 "hotpath-complexity")
+
+#: caller qual -> [(target quals, line, held sites, spec kind)]
+_Calls = dict[str, list[tuple[list[str], int, list[str], str]]]
+
+
+# -------------------------------------------------------------------------
+# Program assembly
+# -------------------------------------------------------------------------
+
+
+class Program:
+    """All module summaries, with cross-module resolution maps."""
+
+    def __init__(self, modules: list[dict[str, Any]]) -> None:
+        self.modules = {m["module"]: m for m in modules}
+        #: qual ("pkg.mod.Cls.meth" / "pkg.mod.fn") -> summary dict.
+        self.functions: dict[str, dict[str, Any]] = {}
+        #: qual -> (path, module)
+        self.location: dict[str, tuple[str, str]] = {}
+        #: method name -> [quals] (name-based attr resolution).
+        self.methods_by_name: dict[str, list[str]] = {}
+        #: lock attr name -> {sites} (non-self ``with x.<attr>:``).
+        self.lock_attr_sites: dict[str, set[str]] = {}
+        #: how many files were (re)parsed vs cache-served.
+        self.stats: dict[str, int] = {}
+        for m in modules:
+            mod = m["module"]
+            for key, fn in m["functions"].items():
+                qual = f"{mod}.{key}"
+                self.functions[qual] = fn
+                self.location[qual] = (m["path"], mod)
+                # "Cls.meth" (one dot) is an attr-resolvable method;
+                # nested defs ("Cls.meth.inner") are not. Fake* test
+                # doubles mirror real interfaces by construction, so
+                # name-linking their methods would bridge every duck-
+                # typed seam twice (and drag, e.g., the FakeKubelet →
+                # device-plugin world into the bind verb's reach); the
+                # real implementation carries the facts.
+                if (fn.get("cls") and key.count(".") == 1
+                        and not fn["cls"].startswith("Fake")):
+                    self.methods_by_name.setdefault(
+                        key.rsplit(".", 1)[-1], []).append(qual)
+            for locks in m["class_locks"].values():
+                for attr, site in locks.items():
+                    self.lock_attr_sites.setdefault(attr, set()).add(site)
+            for name, site in m["module_locks"].items():
+                self.lock_attr_sites.setdefault(name, set()).add(site)
+
+    # -- symbol resolution ------------------------------------------------ #
+
+    def _module_symbol(self, mod: str, name: str,
+                       seen: set[tuple[str, str]] | None = None,
+                       ) -> list[str]:
+        """Resolve ``mod.name`` to function quals, chasing re-exports."""
+        if seen is None:
+            seen = set()
+        if (mod, name) in seen or mod not in self.modules:
+            return []
+        seen.add((mod, name))
+        m = self.modules[mod]
+        if name in m["functions"]:
+            return [f"{mod}.{name}"]
+        if name in m["class_methods"]:
+            ctor = f"{mod}.{name}.__init__"
+            return [ctor] if ctor in self.functions else []
+        fi = m["from_imports"].get(name)
+        if fi is not None:
+            src_mod, remote = fi
+            if f"{src_mod}.{remote}" in self.modules:
+                return []  # module alias, not a callable
+            return self._module_symbol(src_mod, remote, seen)
+        return []
+
+    def resolve_call(self, caller: str, spec: list[Any]) -> list[str]:
+        """Candidate target quals for one recorded call spec."""
+        _path, mod = self.location[caller]
+        m = self.modules[mod]
+        kind = spec[0]
+        if kind == "local":
+            name = spec[1]
+            nested = f"{caller}.{name}"
+            if nested in self.functions:
+                return [nested]
+            return self._module_symbol(mod, name)
+        if kind == "self":
+            meth = spec[1]
+            cls = self.functions[caller].get("cls")
+            seen: set[str] = set()
+            while cls and cls not in seen:
+                seen.add(cls)
+                qual = f"{mod}.{cls}.{meth}"
+                if qual in self.functions:
+                    return [qual]
+                nxt = None
+                for base in m["class_bases"].get(cls, []):
+                    fi = m["from_imports"].get(base)
+                    if fi is not None:
+                        bqual = f"{fi[0]}.{fi[1]}.{meth}"
+                        if bqual in self.functions:
+                            return [bqual]
+                    elif base in m["class_methods"]:
+                        nxt = base
+                cls = nxt
+            return []
+        if kind == "mod":
+            alias, attr = spec[1], spec[2]
+            target = m["import_aliases"].get(alias)
+            if target is None:
+                fi = m["from_imports"].get(alias)
+                if fi is None:
+                    return []
+                target = f"{fi[0]}.{fi[1]}"
+            return self._module_symbol(target, attr)
+        if kind == "attr":
+            return list(self.methods_by_name.get(spec[1], ()))
+        return []
+
+    def expand_lock_sites(self, sites: Iterable[str]) -> list[str]:
+        """``?attr:<name>`` placeholders (non-self lock receivers)
+        resolve by attribute name across every declared lock."""
+        out: list[str] = []
+        for site in sites:
+            if site.startswith("?attr:"):
+                out.extend(sorted(self.lock_attr_sites.get(site[6:], ())))
+            else:
+                out.append(site)
+        return out
+
+
+def build_program(scan_root: str,
+                  cache_path: str | None = None) -> Program:
+    """Parse (or cache-load) every module under ``scan_root`` (a
+    directory containing the ``tpushare/`` package, or the package
+    itself)."""
+    pkg_dir = os.path.join(scan_root, "tpushare")
+    root = pkg_dir if os.path.isdir(pkg_dir) else scan_root
+    base = os.path.dirname(root)
+    cache = fscache.load(cache_path)
+    modules: list[dict[str, Any]] = []
+    parsed = cached = 0
+    for path in iter_py_files([root]):
+        rel = os.path.relpath(path, base).replace(os.sep, "/")
+        name = rel[:-3].replace("/", ".")
+        if name.endswith(".__init__"):
+            name = name[: -len(".__init__")]
+        entry = fscache.lookup(cache, path)
+        if entry is not None:
+            summary = dict(entry)
+            summary["module"] = name
+            summary["path"] = path
+            modules.append(summary)
+            cached += 1
+            continue
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        summary = summarize_module(name, path, src)
+        fscache.store(cache, path, summary)
+        modules.append(summary)
+        parsed += 1
+    fscache.save(cache, cache_path)
+    program = Program(modules)
+    program.stats = {"parsed": parsed, "cached": cached}
+    return program
+
+
+# -------------------------------------------------------------------------
+# Fixpoints
+# -------------------------------------------------------------------------
+
+
+def _resolved_calls(program: Program) -> _Calls:
+    out: _Calls = {}
+    for qual, fn in program.functions.items():
+        entries = []
+        for call in fn["calls"]:
+            line, held = call[-2], call[-1]
+            targets = program.resolve_call(qual, call[:-2])
+            entries.append((targets, line,
+                            program.expand_lock_sites(held), call[0]))
+        out[qual] = entries
+    return out
+
+
+def _fixpoint_may_block(program: Program, calls: _Calls) -> dict[str, str]:
+    """qual -> witness for every function that may reach a blocking op
+    (absent key == cannot block)."""
+    witness: dict[str, str] = {}
+    for qual, fn in program.functions.items():
+        if fn["blocking"]:
+            desc, line = fn["blocking"][0][0], fn["blocking"][0][1]
+            path, _ = program.location[qual]
+            witness[qual] = f"{desc} at {_rel(path)}:{line}"
+    changed = True
+    while changed:
+        changed = False
+        for qual, entries in calls.items():
+            if qual in witness:
+                continue
+            for targets, _line, _held, _kind in entries:
+                hit = next((t for t in targets if t in witness), None)
+                if hit is not None:
+                    witness[qual] = f"via {_short(hit)}"
+                    changed = True
+                    break
+    return witness
+
+
+def _fixpoint_acquires(program: Program,
+                       calls: _Calls) -> dict[str, set[str]]:
+    """qual -> transitive set of lock sites a call may take."""
+    acq: dict[str, set[str]] = {}
+    for qual, fn in program.functions.items():
+        acq[qual] = set(program.expand_lock_sites(
+            site for site, _line in fn["acquires"]))
+    changed = True
+    while changed:
+        changed = False
+        for qual, entries in calls.items():
+            mine = acq[qual]
+            before = len(mine)
+            for targets, _line, _held, _kind in entries:
+                for t in targets:
+                    mine |= acq[t]
+            if len(mine) != before:
+                changed = True
+    return acq
+
+
+def _rel(path: str) -> str:
+    return os.path.relpath(path, REPO_ROOT).replace(os.sep, "/")
+
+
+def _short(qual: str) -> str:
+    return qual.replace("tpushare.", "", 1)
+
+
+# -------------------------------------------------------------------------
+# Rules
+# -------------------------------------------------------------------------
+
+
+def _lock_order_violations(program: Program, calls: _Calls,
+                           acquires: dict[str, set[str]],
+                           ) -> list[Violation]:
+    #: (held, acquired) -> (path, line) first seen.
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    for qual, fn in program.functions.items():
+        path, _ = program.location[qual]
+        for held, acquired, line in fn["edges"]:
+            for h in program.expand_lock_sites([held]):
+                for a in program.expand_lock_sites([acquired]):
+                    if h != a:
+                        edges.setdefault((h, a), (path, line))
+    for qual, entries in calls.items():
+        path, _ = program.location[qual]
+        for targets, line, held, kind in entries:
+            if not held:
+                continue
+            if kind == "attr" and len(targets) > 1:
+                # Ambiguous name-based resolution: fine for blocking
+                # facts (the duck-typed client seam is the point), but
+                # inferring lock ACQUISITION from a shared method name
+                # would invent inversions between unrelated classes.
+                continue
+            taken: set[str] = set()
+            for t in targets:
+                taken |= acquires[t]
+            for h in held:
+                for a in taken:
+                    if h != a:
+                        edges.setdefault((h, a), (path, line))
+    adj: dict[str, list[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    for k in adj:
+        adj[k].sort()
+    out: list[Violation] = []
+    seen_cycles: set[tuple[str, ...]] = set()
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color: dict[str, int] = {}
+    stack: list[str] = []
+
+    def dfs(node: str) -> None:
+        color[node] = GRAY
+        stack.append(node)
+        for nxt in adj.get(node, ()):
+            c = color.get(nxt, WHITE)
+            if c == GRAY:
+                cyc = stack[stack.index(nxt):]
+                start = cyc.index(min(cyc))
+                key = tuple(cyc[start:] + cyc[:start])
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    where = edges[(cyc[0], cyc[1])] if len(cyc) > 1 \
+                        else edges[(cyc[0], cyc[0])]
+                    legs = "; ".join(
+                        f"{x}->{y} at "
+                        f"{_rel(edges[(x, y)][0])}:{edges[(x, y)][1]}"
+                        for x, y in zip(cyc, cyc[1:] + [cyc[0]])
+                        if (x, y) in edges)
+                    out.append(Violation(
+                        where[0], where[1], 0, "static-lock-order",
+                        "statically possible lock-order cycle: "
+                        + " -> ".join(cyc + [cyc[0]])
+                        + f" ({legs}) — a thread interleaving away "
+                        "from deadlock; impose one acquisition order"))
+            elif c == WHITE:
+                dfs(nxt)
+        stack.pop()
+        color[node] = BLACK
+
+    for node in sorted(adj):
+        if color.get(node, WHITE) == WHITE:
+            dfs(node)
+    return out
+
+
+def _blocking_violations(program: Program, calls: _Calls,
+                         may_block: dict[str, str]) -> list[Violation]:
+    out: list[Violation] = []
+    for qual, fn in program.functions.items():
+        path, _ = program.location[qual]
+        for desc, line, held in fn["blocking"]:
+            sites = program.expand_lock_sites(held)
+            if sites:
+                out.append(Violation(
+                    path, line, 0, "blocking-under-lock",
+                    f"direct blocking op {desc} runs while holding "
+                    f"lock {'+'.join(sorted(set(sites)))} — move it "
+                    "outside the lock scope"))
+        for targets, line, held, _kind in calls[qual]:
+            if not held:
+                continue
+            hit = next((t for t in targets if t in may_block), None)
+            if hit is not None:
+                out.append(Violation(
+                    path, line, 0, "blocking-under-lock",
+                    f"call to {_short(hit)} can block "
+                    f"({may_block[hit]}) while holding lock "
+                    f"{'+'.join(sorted(set(held)))} — move the I/O "
+                    "outside the lock scope (reserve under lock, "
+                    "commit after)"))
+    return out
+
+
+def _hotpath_violations(program: Program, calls: _Calls,
+                        budget: dict[str, Any], base: str,
+                        budget_path: str) -> list[Violation]:
+    reachable: set[str] = set()
+    stack = [r for r in HOTPATH_ROOTS if r in program.functions]
+    while stack:
+        qual = stack.pop()
+        if qual in reachable:
+            continue
+        reachable.add(qual)
+        for targets, _line, _held, _kind in calls[qual]:
+            stack.extend(t for t in targets if t not in reachable)
+    entries = {e["id"]: e for e in budget.get("entries", [])}
+    live_ids: set[str] = set()
+    out: list[Violation] = []
+    for qual in sorted(reachable):
+        fn = program.functions[qual]
+        if not fn["scans"]:
+            continue
+        path, mod = program.location[qual]
+        rel = os.path.relpath(path, base).replace(os.sep, "/")
+        func_key = qual[len(mod) + 1:]
+        reported: set[str] = set()
+        for token, line in fn["scans"]:
+            scan_id = f"{rel}::{func_key}::{token}"
+            live_ids.add(scan_id)
+            if scan_id in entries or scan_id in reported:
+                continue
+            reported.add(scan_id)
+            out.append(Violation(
+                path, line, 0, "hotpath-complexity",
+                f"O(fleet) scan ({token}) reachable from a verb entry "
+                "point — index it, or justify it with a budget entry "
+                f"{scan_id!r} in tools/vet/hotpath_budget.json"))
+    # The ratchet: stale or unjustified manifest entries fail too.
+    for scan_id, entry in sorted(entries.items()):
+        if scan_id not in live_ids:
+            out.append(Violation(
+                budget_path, 1, 0, "hotpath-complexity",
+                f"stale budget entry {scan_id!r}: no reachable fleet "
+                "scan matches it — delete the entry (the manifest may "
+                "only shrink)"))
+        elif not str(entry.get("justification", "")).strip():
+            out.append(Violation(
+                budget_path, 1, 0, "hotpath-complexity",
+                f"budget entry {scan_id!r} carries no justification — "
+                "every fleet scan kept on the hot path must say why"))
+    return out
+
+
+# -------------------------------------------------------------------------
+# Entry point
+# -------------------------------------------------------------------------
+
+
+def _apply_pragmas(violations: Iterable[Violation]) -> list[Violation]:
+    """Filter through the standard pragma layer, reading each flagged
+    file's pragmas once."""
+    cache: dict[str, tuple[set[str], dict[int, set[str]]]] = {}
+    out = []
+    for v in violations:
+        if v.path not in cache:
+            try:
+                with open(v.path, encoding="utf-8") as f:
+                    cache[v.path] = _pragma_sets(f.read())
+            except OSError:
+                cache[v.path] = (set(), {})
+        file_ignores, line_ignores = cache[v.path]
+        if v.rule in file_ignores:
+            continue
+        if v.rule in line_ignores.get(v.line, ()):
+            continue
+        out.append(v)
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))
+
+
+def analyze(root: str | None = None, *,
+            budget: dict[str, Any] | None = None,
+            budget_path: str | None = None,
+            cache_path: str | None = None,
+            program: Program | None = None) -> list[Violation]:
+    """Run the whole-program pass; returns pragma-filtered violations.
+
+    ``root`` is a directory containing ``tpushare/`` (defaults to the
+    repo root). ``budget`` overrides the manifest inline (tests);
+    otherwise ``budget_path`` (default: the checked-in manifest) is
+    loaded."""
+    base = root or REPO_ROOT
+    if program is None:
+        program = build_program(base, cache_path=cache_path)
+    bpath = budget_path or DEFAULT_BUDGET_PATH
+    if budget is None:
+        try:
+            with open(bpath, encoding="utf-8") as f:
+                budget = json.load(f)
+        except OSError:
+            budget = {"entries": []}
+    calls = _resolved_calls(program)
+    may_block = _fixpoint_may_block(program, calls)
+    acquires = _fixpoint_acquires(program, calls)
+    violations = []
+    violations += _lock_order_violations(program, calls, acquires)
+    violations += _blocking_violations(program, calls, may_block)
+    violations += _hotpath_violations(program, calls, budget, base, bpath)
+    return _apply_pragmas(violations)

@@ -1,0 +1,495 @@
+"""Module-resolved call graph + per-function summaries for vet-flow.
+
+One parse per file produces a JSON-serializable *module summary*:
+imports, class/function inventory, declared lock identities, and for
+every function a :class:`FuncSummary` — which lock sites it acquires
+(with the lexical nesting edges between them), which blocking
+operations it performs directly, which fleet-scale collections it
+materializes or loops over, and every call it makes together with the
+lock sites lexically held at that call. :mod:`tools.vet.flow.analysis`
+assembles the summaries into a program, resolves the call specs, and
+runs the interprocedural rules.
+
+Lock identity model
+-------------------
+
+A lock's *site* is the string handed to ``TracingRLock(site)``;
+f-string sites normalize their formatted fields to ``*``
+(``f"node/{self.name}"`` → ``node/*``) so every NodeInfo shares one
+static identity. ``self.<attr> = locks.TracingRLock(site)`` declares
+``(class, attr) → site``; ``with self.<attr>:`` resolves through the
+class (bases included), and ``with other.<attr>:`` resolves by
+attribute name when exactly one class in the program declares it.
+Module-level raw locks (legal only inside ``utils/locks.py``) declare
+their identities in that module's ``FLOW_DECLARED_SITES`` literal,
+which this builder reads from the AST.
+
+Call resolution is deliberately name-based at the attribute boundary
+(``client.update_pod(...)`` links to every ``update_pod`` method in
+the program): the duck-typed client seam is exactly where the blocking
+facts live, and a false edge through the in-memory fake is harmless —
+the union is what can happen in production. Container/logging method
+names are excluded so dict/set/log traffic does not pollute the graph.
+Injected callables (``self._node_getter(...)``) are invisible to the
+static graph; the runtime race detector covers that half.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any
+
+#: Attribute names never resolved name-based: builtin container /
+#: string / logging / concurrency traffic whose targets are not
+#: project functions (and whose name collisions would flood the graph).
+EXCLUDED_ATTR_CALLS = frozenset({
+    "add", "append", "appendleft", "cancel", "clear", "copy", "count",
+    "decode", "discard", "done", "encode", "endswith", "extend",
+    "findall", "finditer", "format", "get", "get_nowait", "getvalue",
+    "group", "index", "insert", "intersection_update", "is_set",
+    "isoformat", "items", "join", "keys", "locked", "lower", "lstrip",
+    "match", "notify", "notify_all", "pop", "popitem", "popleft",
+    "put", "put_nowait", "qsize", "read", "readline", "replace",
+    "result", "rstrip", "search", "set", "setdefault", "shutdown",
+    "sort", "split", "splitlines", "startswith", "strip", "sub",
+    "submit", "task_done", "timestamp", "total_seconds", "update",
+    "upper", "values", "wait", "write",
+    "debug", "info", "warning", "error", "exception", "critical",
+    "log", "inc", "dec", "observe", "labels",
+    # Thread/process lifecycle names: `t.start()` / `w.join()` are
+    # stdlib threading traffic; name-linking them to every project
+    # class that happens to define `start` floods the graph.
+    "start", "stop", "run", "join", "flush",
+})
+
+#: Receiver names that are loggers, never project objects.
+_LOGGER_RECEIVERS = frozenset({"log", "logger", "logging"})
+
+#: Calls that MATERIALIZE an O(fleet) collection wherever they appear.
+FLEET_ENUM_CALLS = frozenset({
+    "get_node_infos", "sharing_node_infos", "list_pods", "list_nodes",
+})
+
+#: Calls whose RESULT is O(fleet) when looped (the enum calls plus the
+#: injected lister seams and the scheduler's candidate list).
+FLEET_LOOP_CALLS = FLEET_ENUM_CALLS | frozenset({
+    "candidate_names", "_node_lister", "pod_lister", "_pod_lister",
+})
+
+#: ``self.<attr>`` collections that hold the whole fleet: looping (or
+#: comprehending over) them is a fleet scan.
+FLEET_ATTRS = frozenset({"_nodes", "_known_pods"})
+
+
+def normalize_site(node: ast.expr) -> str | None:
+    """The static lock-site string of a ``TracingRLock(arg)`` argument:
+    constants verbatim, f-strings with formatted fields collapsed to
+    ``*``, anything else unidentifiable (None)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def _is_tracing_rlock_ctor(call: ast.Call) -> bool:
+    fn = call.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "TracingRLock":
+        return True
+    return isinstance(fn, ast.Name) and fn.id == "TracingRLock"
+
+
+def _is_raw_lock_ctor(call: ast.Call) -> bool:
+    fn = call.func
+    return (isinstance(fn, ast.Attribute) and fn.attr in ("Lock", "RLock")
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "threading")
+
+
+class _FuncVisitor(ast.NodeVisitor):
+    """Summarize one function body: acquisitions, lexical lock-order
+    edges, blocking facts, fleet scans, and call sites with held
+    locks."""
+
+    def __init__(self, module: "ModuleCollector", cls: str | None,
+                 sleep_aliases: set[str]) -> None:
+        self.module = module
+        self.cls = cls
+        self.sleep_aliases = set(sleep_aliases)
+        self.held: list[str] = []
+        #: [site, line]
+        self.acquires: list[list[Any]] = []
+        #: [held_site, acquired_site, line] — lexical nesting edges.
+        self.edges: list[list[Any]] = []
+        #: [description, line, [held sites]]
+        self.blocking: list[list[Any]] = []
+        #: [token, line]
+        self.scans: list[list[Any]] = []
+        #: [spec..., line, [held sites]] — spec is ("local", name) /
+        #: ("self", meth) / ("mod", alias, attr) / ("attr", meth).
+        self.calls: list[list[Any]] = []
+        #: local name -> fleet token it was assigned from.
+        self._taint: dict[str, str] = {}
+
+    # -- lock scopes ---------------------------------------------------- #
+
+    def _lock_sites_of(self, ctx: ast.expr) -> list[str]:
+        """Lock sites acquired by one ``with`` item, [] when the item
+        is not a recognizable lock."""
+        if isinstance(ctx, ast.Attribute):
+            attr = ctx.attr
+            if "lock" not in attr.lower():
+                return []
+            if isinstance(ctx.value, ast.Name) and ctx.value.id == "self":
+                site = self.module.class_lock_site(self.cls, attr)
+                if site is not None:
+                    return [site]
+                return [f"{self.module.name}.{self.cls}.{attr}"]
+            # Non-self receiver: resolve by attribute name program-wide
+            # at analysis time; emit a placeholder the analysis expands.
+            return [f"?attr:{attr}"]
+        if isinstance(ctx, ast.Name):
+            site = self.module.module_locks.get(ctx.id)
+            if site is not None:
+                return [site]
+        return []
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: list[str] = []
+        for item in node.items:
+            ctx = item.context_expr
+            # The context expression itself evaluates BEFORE this
+            # item's lock is taken (but after earlier items').
+            self.visit(ctx)
+            for site in self._lock_sites_of(ctx):
+                self.acquires.append([site, node.lineno])
+                for held in self.held:
+                    if held != site:
+                        self.edges.append([held, site, node.lineno])
+                self.held.append(site)
+                acquired.append(site)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        for site in reversed(acquired):
+            self.held.pop()
+
+    visit_AsyncWith = visit_With  # type: ignore[assignment]
+
+    # -- nested definitions --------------------------------------------- #
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # Nested defs are summarized separately by the collector; from
+        # here record only a conservative local call edge (assume the
+        # enclosing function invokes what it defines).
+        self.calls.append(["local", node.name, node.lineno,
+                           list(self.held)])
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self.visit(node.body)  # body runs (at worst) where it is built
+
+    # -- calls ----------------------------------------------------------- #
+
+    def _blocking_desc(self, node: ast.Call) -> str | None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if (fn.attr == "sleep" and isinstance(recv, ast.Name)
+                    and recv.id == "time"):
+                return "time.sleep"
+            if (fn.attr == "urlopen" and isinstance(recv, ast.Attribute)
+                    and recv.attr == "request"):
+                return "urllib.request.urlopen"
+            if isinstance(recv, ast.Name) and recv.id == "socket":
+                return f"socket.{fn.attr}"
+        if isinstance(fn, ast.Name) and fn.id in self.sleep_aliases:
+            return "time.sleep"
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        desc = self._blocking_desc(node)
+        if desc is not None:
+            self.blocking.append([desc, node.lineno, list(self.held)])
+        else:
+            fn = node.func
+            if isinstance(fn, ast.Name):
+                self.calls.append(["local", fn.id, node.lineno,
+                                   list(self.held)])
+                if fn.id in FLEET_ENUM_CALLS:
+                    self.scans.append([fn.id, node.lineno])
+            elif isinstance(fn, ast.Attribute):
+                attr = fn.attr
+                recv = fn.value
+                if attr in FLEET_ENUM_CALLS:
+                    self.scans.append([attr, node.lineno])
+                if isinstance(recv, ast.Name) and recv.id == "self":
+                    self.calls.append(["self", attr, node.lineno,
+                                       list(self.held)])
+                elif (isinstance(recv, ast.Name)
+                        and recv.id in self.module.import_aliases):
+                    self.calls.append(
+                        ["mod", recv.id, attr, node.lineno,
+                         list(self.held)])
+                elif (attr not in EXCLUDED_ATTR_CALLS
+                        and not (isinstance(recv, ast.Name)
+                                 and recv.id in _LOGGER_RECEIVERS)):
+                    self.calls.append(["attr", attr, node.lineno,
+                                       list(self.held)])
+        self.generic_visit(node)
+
+    # -- fleet scans ------------------------------------------------------ #
+
+    def _fleet_token(self, expr: ast.expr) -> str | None:
+        """The fleet-collection token an iterable derives from, if any.
+        Point lookups into a fleet table (``self._nodes.get(name)``,
+        ``self._known_pods.pop(uid, None)``) are O(1), not scans."""
+        point_lookups: set[int] = set()
+        for sub in ast.walk(expr):
+            if (isinstance(sub, ast.Call) and sub.args
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in ("get", "pop")
+                    and isinstance(sub.func.value, ast.Attribute)):
+                point_lookups.add(id(sub.func.value))
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                name = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else "")
+                if name in FLEET_LOOP_CALLS:
+                    return name
+            elif isinstance(sub, ast.Attribute):
+                if (sub.attr in FLEET_ATTRS
+                        and id(sub) not in point_lookups):
+                    return sub.attr
+            elif isinstance(sub, ast.Name) and sub.id in self._taint:
+                return self._taint[sub.id]
+        return None
+
+    def _note_scan(self, iterable: ast.expr, line: int) -> None:
+        token = self._fleet_token(iterable)
+        if token is not None:
+            self.scans.append([token, line])
+
+    def visit_For(self, node: ast.For) -> None:
+        self._note_scan(node.iter, node.lineno)
+        self.generic_visit(node)
+
+    visit_AsyncFor = visit_For  # type: ignore[assignment]
+
+    def _visit_comp(self, node: Any) -> None:
+        for gen in node.generators:
+            self._note_scan(gen.iter, node.lineno)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            token = self._fleet_token(node.value)
+            if token is not None:
+                self._taint[node.targets[0].id] = token
+        self.generic_visit(node)
+
+
+class ModuleCollector:
+    """One parsed module's inventory + per-function summaries."""
+
+    def __init__(self, name: str, path: str, tree: ast.Module) -> None:
+        self.name = name
+        self.path = path
+        #: alias -> fully qualified module ("podutils" -> "...utils.pod")
+        self.import_aliases: dict[str, str] = {}
+        #: local name -> (module, remote name) from-imports.
+        self.from_imports: dict[str, tuple[str, str]] = {}
+        #: class -> {attr: site} lock declarations.
+        self.class_locks: dict[str, dict[str, str]] = {}
+        #: class -> base-name list (unresolved local names).
+        self.class_bases: dict[str, list[str]] = {}
+        #: class -> set of method names.
+        self.class_methods: dict[str, set[str]] = {}
+        #: module-level lock name -> site.
+        self.module_locks: dict[str, str] = {}
+        #: function key ("fn" / "Cls.meth" / "outer.inner") -> summary.
+        self.functions: dict[str, dict[str, Any]] = {}
+        self._module_sleep_aliases: set[str] = set()
+        self._collect(tree)
+
+    # -- assembly --------------------------------------------------------- #
+
+    def class_lock_site(self, cls: str | None, attr: str) -> str | None:
+        seen: set[str] = set()
+        while cls is not None and cls not in seen:
+            seen.add(cls)
+            site = self.class_locks.get(cls, {}).get(attr)
+            if site is not None:
+                return site
+            bases = self.class_bases.get(cls, [])
+            cls = bases[0] if bases else None  # single chain is enough here
+        return None
+
+    def _collect(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_aliases[alias.asname
+                                        or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    continue  # no relative imports in this tree
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    self.from_imports[local] = (node.module or "",
+                                                alias.name)
+                    if node.module == "time" and alias.name == "sleep":
+                        self._module_sleep_aliases.add(local)
+            elif isinstance(node, ast.Assign):
+                self._module_assign(node)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    self._declared_sites(node.target.id, node.value)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._function(node, cls=None, prefix="")
+            elif isinstance(node, ast.ClassDef):
+                self._class(node)
+
+    def _module_assign(self, node: ast.Assign) -> None:
+        if len(node.targets) != 1 or not isinstance(node.targets[0],
+                                                    ast.Name):
+            return
+        name = node.targets[0].id
+        value = node.value
+        if isinstance(value, ast.Call):
+            if _is_tracing_rlock_ctor(value) and value.args:
+                site = normalize_site(value.args[0])
+                if site:
+                    self.module_locks[name] = site
+            elif _is_raw_lock_ctor(value):
+                # Raw module-level locks are locks.py-internal; their
+                # identities come from FLOW_DECLARED_SITES (below) and
+                # fall back to a module-qualified name.
+                self.module_locks.setdefault(name, f"{self.name}:{name}")
+        self._declared_sites(name, value)
+
+    def _declared_sites(self, name: str, value: ast.expr) -> None:
+        """``FLOW_DECLARED_SITES = {"_race_lock": "locks/race", ...}`` —
+        the explicit lock-identity declaration utils/locks.py carries
+        for its raw internal locks."""
+        if name != "FLOW_DECLARED_SITES" or not isinstance(value, ast.Dict):
+            return
+        for k, v in zip(value.keys, value.values):
+            if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                self.module_locks[k.value] = v.value
+
+    def _class(self, node: ast.ClassDef) -> None:
+        self.class_bases[node.name] = [
+            b.id for b in node.bases if isinstance(b, ast.Name)]
+        methods = self.class_methods.setdefault(node.name, set())
+        self.class_locks.setdefault(node.name, {})
+        # Lock declarations can sit in any method (usually __init__).
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            methods.add(item.name)
+            for sub in ast.walk(item):
+                if (isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Attribute)
+                        and isinstance(sub.targets[0].value, ast.Name)
+                        and sub.targets[0].value.id == "self"
+                        and isinstance(sub.value, ast.Call)
+                        and _is_tracing_rlock_ctor(sub.value)
+                        and sub.value.args):
+                    site = normalize_site(sub.value.args[0])
+                    if site:
+                        self.class_locks[node.name][
+                            sub.targets[0].attr] = site
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._function(item, cls=node.name,
+                               prefix=f"{node.name}.")
+
+    def _function(self, node: Any, cls: str | None, prefix: str) -> None:
+        key = f"{prefix}{node.name}"
+        sleep_aliases = set(self._module_sleep_aliases)
+        # `sleep=time.sleep` injectable defaults: calling the parameter
+        # is calling time.sleep unless a test overrides it.
+        for arg, default in zip(
+                reversed(node.args.args + node.args.kwonlyargs),
+                reversed(list(node.args.defaults)
+                         + list(node.args.kw_defaults))):
+            if (default is not None and isinstance(default, ast.Attribute)
+                    and default.attr == "sleep"
+                    and isinstance(default.value, ast.Name)
+                    and default.value.id == "time"):
+                sleep_aliases.add(arg.arg)
+        visitor = _FuncVisitor(self, cls, sleep_aliases)
+        for stmt in node.body:
+            visitor.visit(stmt)
+        self.functions[key] = {
+            "line": node.lineno,
+            "cls": cls,
+            "acquires": visitor.acquires,
+            "edges": visitor.edges,
+            "blocking": visitor.blocking,
+            "scans": visitor.scans,
+            "calls": visitor.calls,
+        }
+        # Nested defs get their own (sub-keyed) summaries.
+        for stmt in ast.walk(node):
+            if stmt is not node and isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sub = _FuncVisitor(self, cls, sleep_aliases)
+                for inner in stmt.body:
+                    sub.visit(inner)
+                self.functions.setdefault(f"{key}.{stmt.name}", {
+                    "line": stmt.lineno,
+                    "cls": cls,
+                    "acquires": sub.acquires,
+                    "edges": sub.edges,
+                    "blocking": sub.blocking,
+                    "scans": sub.scans,
+                    "calls": sub.calls,
+                })
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "module": self.name,
+            "path": self.path,
+            "import_aliases": self.import_aliases,
+            "from_imports": {k: list(v)
+                             for k, v in self.from_imports.items()},
+            "class_locks": self.class_locks,
+            "class_bases": self.class_bases,
+            "class_methods": {k: sorted(v)
+                              for k, v in self.class_methods.items()},
+            "module_locks": self.module_locks,
+            "functions": self.functions,
+        }
+
+
+def summarize_module(name: str, path: str, src: str) -> dict[str, Any]:
+    """Parse one file into its JSON module summary (the unit the
+    mtime-keyed cache stores). Unparseable files summarize to an empty
+    module — the per-file ``syntax`` rule owns reporting that."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError:
+        tree = ast.Module(body=[], type_ignores=[])
+    return ModuleCollector(name, path, tree).to_json()

@@ -1,0 +1,79 @@
+"""mtime-keyed per-file summary cache for the flow pass.
+
+``make lint`` runs the whole-program analysis on every invocation; the
+expensive half is parsing ~100 files, and almost none of them change
+between runs. Each file's module summary is cached keyed on
+``(mtime_ns, size)`` — the interprocedural propagation itself is cheap
+and always runs fresh, so a cache hit can never make the analysis
+stale across files (a change in file A re-parses only A, and the
+propagation re-reads every summary).
+
+``VERSION`` invalidates the whole cache whenever the summary format
+(or rule semantics encoded into summaries) changes. The cache file
+lives under ``.vet_cache/`` at the repo root (gitignored); passing
+``cache_path=None`` disables persistence entirely (tests, one-shot
+runs on copies).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+#: Bump when the summary schema or the facts collected change.
+VERSION = 1
+
+
+def load(cache_path: str | None) -> dict[str, Any]:
+    """The cache document: {"version": N, "files": {path: entry}}."""
+    doc: dict[str, Any] = {"version": VERSION, "files": {}}
+    if cache_path is None:
+        return doc
+    try:
+        with open(cache_path, encoding="utf-8") as f:
+            loaded = json.load(f)
+    except (OSError, ValueError):
+        return doc
+    if loaded.get("version") != VERSION:
+        return doc
+    if isinstance(loaded.get("files"), dict):
+        doc["files"] = loaded["files"]
+    return doc
+
+
+def _stat_key(path: str) -> list[int] | None:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return [st.st_mtime_ns, st.st_size]
+
+
+def lookup(cache: dict[str, Any], path: str) -> dict[str, Any] | None:
+    """The cached summary for ``path`` when its (mtime, size) match."""
+    entry = cache["files"].get(path)
+    if entry is None:
+        return None
+    if entry.get("stat") != _stat_key(path):
+        return None
+    summary = entry.get("summary")
+    return summary if isinstance(summary, dict) else None
+
+
+def store(cache: dict[str, Any], path: str,
+          summary: dict[str, Any]) -> None:
+    cache["files"][path] = {"stat": _stat_key(path), "summary": summary}
+
+
+def save(cache: dict[str, Any], cache_path: str | None) -> None:
+    if cache_path is None:
+        return
+    try:
+        os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+        tmp = cache_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(cache, f)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass  # a cache that cannot persist is only a slower cache

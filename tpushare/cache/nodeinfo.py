@@ -355,49 +355,78 @@ class NodeInfo:
     def allocate(self, client: Any, pod: Pod, *, bind: bool = True) -> Pod:
         """Place ``pod``, persist the grant, bind, and update the ledger.
 
-        1. pick chips (policy above);
-        2. write the annotation set with one typed-conflict retry
-           (reference nodeinfo.go:150-168);
-        3. POST the binding (reference nodeinfo.go:174-189);
-        4. record the pod in the in-memory ledger (nodeinfo.go:191-203).
+        1. pick chips (policy above) and provisionally charge them, both
+           under the ledger lock;
+        2. with the lock RELEASED: write the annotation set with one
+           typed-conflict retry (reference nodeinfo.go:150-168) and POST
+           the binding (nodeinfo.go:174-189);
+        3. re-price the provisional hold with the document the apiserver
+           accepted (nodeinfo.go:191-203) — or roll the hold back if any
+           write failed.
+
+        The lock brackets only the pick/charge and the final re-price:
+        holding a ledger lock across an apiserver round-trip would stall
+        every filter/bind verb touching this node for the RTT — the
+        exact bug class vet-flow's ``blocking-under-lock`` rule pins.
+        The provisional charge is what keeps the two lock windows safe:
+        a concurrent allocate cannot pick the held chips while our
+        writes are in flight, and a failure frees them exactly once.
 
         Returns the annotated pod as accepted by the apiserver.
         """
         # The span opens BEFORE the ledger lock so a contended acquire
         # is attributed to this allocate phase, not its caller's.
-        with trace.span("allocate", node=self.name), self._lock:
-            chip_ids = self.pick_chips(pod)  # raises AllocationError
-            if podutils.get_chips_from_pod_resource(pod) > 0:
-                hbm_pod = sum(self.chips[c].total_hbm for c in chip_ids)
-            else:
-                hbm_pod = podutils.get_hbm_from_pod_resource(pod)
-            hbm_chip = self.chips[chip_ids[0]].total_hbm
+        with trace.span("allocate", node=self.name):
+            trace_id = trace.current_trace_id() or None
+            with self._lock:
+                chip_ids = self.pick_chips(pod)  # raises AllocationError
+                if podutils.get_chips_from_pod_resource(pod) > 0:
+                    hbm_pod = sum(self.chips[c].total_hbm
+                                  for c in chip_ids)
+                else:
+                    hbm_pod = podutils.get_hbm_from_pod_resource(pod)
+                hbm_chip = self.chips[chip_ids[0]].total_hbm
+                provisional = podutils.updated_pod_annotation_spec(
+                    pod, chip_ids, hbm_pod, hbm_chip,
+                    assume_time_ns=time.time_ns(), trace_id=trace_id
+                )
+                for cid in chip_ids:
+                    self.chips[cid].add_pod(provisional)
             trace.note("chips", list(chip_ids))
             trace.note("hbmGiB", hbm_pod)
 
-            trace_id = trace.current_trace_id() or None
-            new_pod = podutils.updated_pod_annotation_spec(
-                pod, chip_ids, hbm_pod, hbm_chip,
-                assume_time_ns=time.time_ns(), trace_id=trace_id
-            )
             try:
-                new_pod = client.update_pod(new_pod)
-            except ConflictError:
-                fresh = client.get_pod(pod.namespace, pod.name)
-                new_pod = podutils.updated_pod_annotation_spec(
-                    fresh, chip_ids, hbm_pod, hbm_chip,
-                    assume_time_ns=time.time_ns(), trace_id=trace_id,
-                )
-                new_pod = client.update_pod(new_pod)
-
-            if bind:
-                client.bind_pod(binding_doc(new_pod, self.name))
+                try:
+                    new_pod = client.update_pod(provisional)
+                except ConflictError:
+                    fresh = client.get_pod(pod.namespace, pod.name)
+                    new_pod = podutils.updated_pod_annotation_spec(
+                        fresh, chip_ids, hbm_pod, hbm_chip,
+                        assume_time_ns=time.time_ns(), trace_id=trace_id,
+                    )
+                    new_pod = client.update_pod(new_pod)
+                if bind:
+                    client.bind_pod(binding_doc(new_pod, self.name))
+            except BaseException:
+                with self._lock:
+                    for cid in chip_ids:
+                        self.chips[cid].remove_pod(provisional)
+                raise
             # Reflect the binding locally so the ledger/known-pods record
             # carries the node (the apiserver set spec.nodeName for us).
             new_pod.spec["nodeName"] = self.name
 
-            for cid in chip_ids:
-                self.chips[cid].add_pod(new_pod)
+            with self._lock:
+                # Same uid: re-adding replaces the provisional pricing
+                # with the document the apiserver accepted — UNLESS a
+                # deletion observed during the unlocked write window
+                # already freed the provisional hold (the informer's
+                # remove_pod ran; that DELETE is consumed and nothing
+                # will ever free a re-added charge again).
+                if any(provisional.uid in self.chips[c].pods
+                       for c in chip_ids):
+                    for cid in chip_ids:
+                        self.chips[cid].add_pod(new_pod)
             log.info(
                 "allocated pod %s/%s -> node %s chips %s (%d GiB)",
                 pod.namespace, pod.name, self.name, chip_ids, hbm_pod,

@@ -67,7 +67,7 @@ def _env_int(name: str, default: int) -> int:
         return int(os.environ.get(name, "") or default)
     # Config parsing, not telemetry: a malformed knob falls back to
     # the documented default.
-    # vet: ignore[swallowed-telemetry-error]
+    # vet: ignore[swallowed-telemetry-error] - config parse fallback, not a lost observation
     except ValueError:
         return default
 
@@ -76,7 +76,7 @@ def _env_float(name: str, default: float) -> float:
     try:
         return float(os.environ.get(name, "") or default)
     # Same config-parse fallback.
-    # vet: ignore[swallowed-telemetry-error]
+    # vet: ignore[swallowed-telemetry-error] - config parse fallback, not a lost observation
     except ValueError:
         return default
 
@@ -157,7 +157,7 @@ class DefragExecutor:
                 self.tick()
             # Control-flow failure, not telemetry loss: the stack
             # trace below IS the record.
-            # vet: ignore[swallowed-telemetry-error]
+            # vet: ignore[swallowed-telemetry-error] - control-flow failure; log.exception IS the record
             except Exception:  # noqa: BLE001 - the loop must survive
                 log.exception("defrag tick failed")
 
@@ -292,7 +292,7 @@ class DefragExecutor:
                 budget=self.budget, node=move.from_node)
         # Counted: _count_move below increments
         # tpushare_defrag_moves_total{outcome="failed"} via safe_inc.
-        # vet: ignore[swallowed-telemetry-error]
+        # vet: ignore[swallowed-telemetry-error] - counted by _count_move(outcome=failed) below
         except ApiError as e:
             log.warning("defrag eviction of %s failed (%s)",
                         move.key(), e)

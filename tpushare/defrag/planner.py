@@ -172,7 +172,7 @@ class WhatIf:
                 chips = info.pick_chips(pod)
             # Control flow, not telemetry: "no placement on this
             # node" just tries the next one.
-            # vet: ignore[swallowed-telemetry-error]
+            # vet: ignore[swallowed-telemetry-error] - control flow: no fit here, try the next node
             except AllocationError:
                 continue
             leftover = sum(info.get_available_hbm().values())

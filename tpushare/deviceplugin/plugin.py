@@ -178,13 +178,23 @@ class TPUSharePlugin:
         partial-grant state before any pod-state mutation happens
         (advisor findings: a mid-loop failure must not leave earlier
         containers' records — or a committed assigned=true — behind
-        while kubelet treats the whole RPC as failed)."""
+        while kubelet treats the whole RPC as failed).
+
+        The alloc lock deliberately spans the batch's apiserver traffic
+        (node-scoped LIST + the assigned-flag commit): it serializes
+        kubelet Allocate/GetPreferredAllocation RPCs against the
+        partial-grant state on ONE node — it is an RPC-consistency
+        lock, not a scheduler-verb ledger, and kubelet issues these
+        RPCs serially anyway. Splitting it would trade a non-contended
+        hold for a staged-state merge protocol."""
         with self._alloc_lock:
+            # vet: ignore[blocking-under-lock] - node-local kubelet RPC serialization; see docstring
             return self._allocate_batch(requests, chips=False)
 
     def allocate_chips_batch(
             self, requests: list[list[str]]) -> list[ContainerAllocation]:
         with self._alloc_lock:
+            # vet: ignore[blocking-under-lock] - node-local kubelet RPC serialization; see allocate_hbm_batch
             return self._allocate_batch(requests, chips=True)
 
     def _allocate_batch(self, requests: list[list[str]],
@@ -323,9 +333,11 @@ class TPUSharePlugin:
         with self._alloc_lock:
             base = self._partial_chips if chips else self._partial
             overlay = {uid: list(v) for uid, v in base.items()}
+            # vet: ignore[blocking-under-lock] - node-local kubelet RPC serialization; see allocate_hbm_batch
             pods = self._list_node_pods()
             for available, size in requests:
                 avail = set(available)
+                # vet: ignore[blocking-under-lock] - node-local kubelet RPC serialization; see allocate_hbm_batch
                 pod = self._match_pending_pod(size, chips=chips,
                                               partial=overlay, pods=pods)
                 if pod is None:

@@ -152,10 +152,10 @@ class GrantWatchdog:
             # bounded by the pods RESIDENT on one host, and dead series
             # are GC'd below each sweep — none of which holds for the
             # extender's fleet registry the vet rule protects.
-            # vet: ignore[unbounded-metric-cardinality]
+            # vet: ignore[unbounded-metric-cardinality] - node-local registry, bounded by resident pods, GC'd per sweep
             self._used.labels(pod.namespace, pod.name,
                               self.node_name).set(round(used_gib, 3))
-            # vet: ignore[unbounded-metric-cardinality]
+            # vet: ignore[unbounded-metric-cardinality] - node-local registry, bounded by resident pods, GC'd per sweep
             self._overrun.labels(pod.namespace, pod.name,
                                  self.node_name).set(1 if over else 0)
             streak = self._over_streak.get(pod.uid, 0)

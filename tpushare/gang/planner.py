@@ -67,12 +67,25 @@ class _Group:
         self.minimum = minimum
         self.deadline = time.monotonic() + ttl
         self.committed = False
+        #: TTL expiry detached this group and its rollback is running
+        #: (or done). The group stays IN the table until the rollback's
+        #: apiserver traffic finishes, so a racing re-reservation of a
+        #: victim pod fails the reserve liveness check and rolls itself
+        #: back — popping the key first would let a fresh same-key
+        #: group charge the same uids the stale rollback then destroys.
+        self.rolled_back = False
         # One shared site, not per-gang: gang names are unbounded over
         # the extender's lifetime and the contention registry keeps
         # every site it ever sees.
         self.lock = locks.TracingRLock("gang/group")
         #: uid -> (annotated pod, node name)
         self.reservations: dict[str, tuple[Pod, str]] = {}
+        #: uids whose reservation is being allocated right now (lock
+        #: released around the apiserver writes). A second bind RPC for
+        #: the same member mid-flight must be refused, not allocated
+        #: twice — the duplicate's fold would overwrite the first
+        #: reservation and leak its chip charges.
+        self.reserving: set[str] = set()
         #: uids whose binding POST succeeded
         self.bound: set[str] = set()
 
@@ -324,50 +337,136 @@ class GangPlanner:
 
     def bind_member(self, pod: Pod, node_name: str) -> None:
         """Reserve-or-commit one gang member; raises GangPending below
-        quorum and AllocationError/ApiError on real failures."""
+        quorum and AllocationError/ApiError on real failures.
+
+        The group lock serializes GROUP-STATE mutation only: every
+        apiserver round-trip on this path — the quorum pre-check's node
+        walk, the ledger allocate's annotation write, a failed
+        adoption's annotation strip, the binding POSTs — runs with no
+        gang lock held (vet-flow ``blocking-under-lock``: a slow
+        apiserver must never stall a sibling member's reserve, and in
+        the multi-replica deployment a peer's bind must never wait on
+        our I/O)."""
         if podutils.is_assumed(pod) and pod.node_name:
             return  # already fully placed (idempotent retry)
 
         key, group = self._get_group(pod)
-        with trace.span("gang", group=group.name), group.lock:
+        with trace.span("gang", group=group.name):
+            self._reserve_member(key, group, pod, node_name)
+            newly_committed = self._note_quorum(key, group)
+
+        for member_pod, member_node in newly_committed:
+            events.record(
+                self.client, member_pod, events.REASON_GANG_COMMITTED,
+                f"gang {group.name} reached quorum; "
+                f"committing to node {member_node}",
+                # Each member's Event must carry ITS OWN decision's id
+                # (the one in its bind annotation) — the thread-local
+                # default here is the quorum-COMPLETING member's trace.
+                trace_id=member_pod.annotations.get(const.ANN_TRACE_ID, ""))
+        # Raises only if THIS member's own binding failed.
+        self._commit(key, group, current_uid=pod.uid)
+
+    def _reserve_member(self, key: tuple[str, str], group: _Group,
+                        pod: Pod, node_name: str) -> None:
+        """Ensure ``pod`` holds a reservation in ``group``, allocating
+        (or adopting) its grant with the group lock RELEASED around
+        every apiserver write."""
+        with group.lock:
             trace.note("quorum",
                        f"{len(group.reservations)}/{group.minimum}")
-            if pod.uid not in group.reservations:
-                if podutils.is_assumed(pod):
-                    # Reserved in a previous life (e.g. planner restart):
-                    # adopt the existing grant instead of re-allocating.
-                    self._adopt(group, pod)
-                else:
-                    # The doomed-gang pre-check runs while the group holds
-                    # NOTHING (first member, or first after a rollback) —
-                    # that is when squatting until TTL would start. Once
-                    # members are reserved the gang was judged feasible;
-                    # later members are verified by allocate() itself and
-                    # a cluster that shrinks mid-gang is bounded by the
-                    # TTL rollback. Re-checking per member would put an
-                    # O(nodes) walk on every bind of a trickling gang.
-                    feasible, reason = (
-                        self.quorum_feasible(pod, group)
-                        if not group.reservations and not group.committed
-                        else (True, ""))
-                    if not feasible:
-                        if not group.reservations and not group.committed:
-                            # Never held anything: drop the empty group so
-                            # it doesn't sit in the table until TTL.
-                            with self._table_lock:
-                                if self._groups.get(key) is group:
-                                    del self._groups[key]
-                        raise AllocationError(reason)
-                    info = self.cache.get_node_info(node_name)
-                    if info is None:
-                        raise AllocationError(f"unknown node {node_name}")
-                    reserved = info.allocate(self.client, pod, bind=False)
-                    self.cache.add_or_update_pod(reserved)
-                    group.reservations[pod.uid] = (reserved, node_name)
-                    log.info("gang %s/%s: reserved member %s on %s (%d/%d)",
-                             pod.namespace, group.name, pod.name, node_name,
-                             len(group.reservations), group.minimum)
+            if group.rolled_back:
+                # TTL expiry is mid-rollback on this group; allocating
+                # into it would be destroyed by the stale rollback.
+                raise AllocationError(
+                    f"gang {group.name}: expired-reservation rollback "
+                    "in progress; scheduler will retry")
+            if pod.uid in group.reservations:
+                return
+            if pod.uid in group.reserving:
+                # A duplicate bind RPC for the SAME member while its
+                # reservation is mid-allocate (scheduler timeout retry
+                # racing the in-flight request): allocating twice would
+                # double-charge the ledger and the fold overwrite would
+                # leak the first charge. The pre-split lock provided
+                # this exclusion implicitly; the flag restores it.
+                raise AllocationError(
+                    f"gang {group.name}: reservation for {pod.key()} "
+                    "already in flight; scheduler will retry")
+            group.reserving.add(pod.uid)
+            first = not group.reservations and not group.committed
+        try:
+            self._reserve_member_unlocked(key, group, pod, node_name,
+                                          first)
+        finally:
+            with group.lock:
+                group.reserving.discard(pod.uid)
 
+    def _reserve_member_unlocked(self, key: tuple[str, str],
+                                 group: _Group, pod: Pod,
+                                 node_name: str, first: bool) -> None:
+        """The allocate/adopt half of :meth:`_reserve_member`; runs with
+        no gang lock held (``group.reserving`` excludes same-uid
+        duplicates)."""
+        if podutils.is_assumed(pod):
+            # Reserved in a previous life (e.g. planner restart):
+            # adopt the existing grant instead of re-allocating.
+            self._adopt(group, pod)
+            return
+        if first:
+            # The doomed-gang pre-check runs while the group holds
+            # NOTHING (first member, or first after a rollback) —
+            # that is when squatting until TTL would start. Once
+            # members are reserved the gang was judged feasible;
+            # later members are verified by allocate() itself and
+            # a cluster that shrinks mid-gang is bounded by the
+            # TTL rollback. Re-checking per member would put an
+            # O(nodes) walk on every bind of a trickling gang.
+            feasible, reason = self.quorum_feasible(pod, group)
+            if not feasible:
+                with group.lock:
+                    still_empty = (not group.reservations
+                                   and not group.committed)
+                    if still_empty:
+                        # Never held anything: drop the empty group so
+                        # it doesn't sit in the table until TTL.
+                        with self._table_lock:
+                            if self._groups.get(key) is group:
+                                del self._groups[key]
+                if still_empty:
+                    raise AllocationError(reason)
+                # A sibling reserved while we ran the pre-check: the
+                # group is live after all — fall through and allocate.
+        info = self.cache.get_node_info(node_name)
+        if info is None:
+            raise AllocationError(f"unknown node {node_name}")
+        reserved = info.allocate(self.client, pod, bind=False)
+        self.cache.add_or_update_pod(reserved)
+        with group.lock:
+            with self._table_lock:
+                live = (self._groups.get(key) is group
+                        and not group.rolled_back)
+            if live:
+                group.reservations[pod.uid] = (reserved, node_name)
+                log.info("gang %s/%s: reserved member %s on %s (%d/%d)",
+                         pod.namespace, group.name, pod.name, node_name,
+                         len(group.reservations), group.minimum)
+                return
+        # The group was rolled back (TTL expiry) while our allocate was
+        # in flight: undo the ledger hold and the annotations, then let
+        # the scheduler retry into a fresh group.
+        self.cache.remove_pod(reserved)
+        self._strip_annotations(reserved)
+        raise AllocationError(
+            f"gang {group.name}: reservation window expired during "
+            "allocation; rolled back — scheduler will retry")
+
+    def _note_quorum(self, key: tuple[str, str],
+                     group: _Group) -> list[tuple[Pod, str]]:
+        """Flip ``committed`` when quorum is reached; returns the
+        members committed by THIS call (empty on an already-committed
+        group). Raises GangPending below quorum."""
+        with group.lock:
             reserved_n = len(group.reservations)
             if not group.committed and reserved_n < group.minimum:
                 # Members already BOUND count toward quorum even though
@@ -392,26 +491,15 @@ class GangPlanner:
                              group.minimum, len(group.reservations))
                     group.committed = True
                     newly_committed = list(group.reservations.values())
-            else:
-                raise GangPending(
-                    f"gang {group.name}: {reserved_n}/{group.minimum} "
-                    f"members reserved; pod held {QUORUM_HOLD_MARKER}")
-
-        for member_pod, member_node in newly_committed:
-            events.record(
-                self.client, member_pod, events.REASON_GANG_COMMITTED,
-                f"gang {group.name} reached quorum "
-                f"({reserved_n}/{group.minimum}); "
-                f"committing to node {member_node}",
-                # Each member's Event must carry ITS OWN decision's id
-                # (the one in its bind annotation) — the thread-local
-                # default here is the quorum-COMPLETING member's trace.
-                trace_id=member_pod.annotations.get(const.ANN_TRACE_ID, ""))
-        # Raises only if THIS member's own binding failed.
-        self._commit(key, group, current_uid=pod.uid)
+                return newly_committed
+            raise GangPending(
+                f"gang {group.name}: {reserved_n}/{group.minimum} "
+                f"members reserved; pod held {QUORUM_HOLD_MARKER}")
 
     def _adopt(self, group: _Group, pod: Pod) -> None:
-        """Re-register an annotated-but-unbound member after a restart."""
+        """Re-register an annotated-but-unbound member after a restart.
+        Called with NO gang lock held — the failure path strips the
+        pod's annotations through the apiserver."""
         node_name = pod.node_name
         if not node_name:
             # The annotation write committed but we lost the node choice —
@@ -419,7 +507,8 @@ class GangPlanner:
             self._strip_annotations(pod)
             raise AllocationError(
                 f"gang member {pod.key()} had a stale reservation; reset")
-        group.reservations[pod.uid] = (pod, node_name)
+        with group.lock:
+            group.reservations.setdefault(pod.uid, (pod, node_name))
 
     # ------------------------------------------------------------------ #
 
@@ -458,17 +547,10 @@ class GangPlanner:
             return None
         return outcome  # ApiError
 
-    def _bind_one(self, group: _Group, uid: str) -> None:
-        """Serial POST+apply (housekeeping retries bind one at a time;
-        caller holds the group lock)."""
-        pod, node_name = group.reservations[uid]
-        outcome = self._post_binding(pod, node_name)
-        err = self._apply_binding_outcome(group, uid, outcome)
-        if err is not None:
-            raise err
-
-    def _commit(self, key, group: _Group, current_uid: str | None = None) -> None:
-        """Post bindings for every reserved member. Partial failures keep
+    def _commit(self, key, group: _Group,
+                current_uid: str | None = None) -> int:
+        """Post bindings for every reserved member; returns how many
+        POSTs were attempted. Partial failures keep
         the group tracked (finding: never report success while silently
         leaking an unbound member) and are retried by housekeeping — but
         only *this* member's own failure is raised, so a pod whose
@@ -523,27 +605,19 @@ class GangPlanner:
                 self._groups.pop(key, None)
         if current_error is not None:
             raise current_error
+        return len(pending)
 
     def retry_unbound(self) -> int:
         """Retry binding committed-but-unbound members; returns how many
-        bindings were attempted."""
+        bindings were attempted. Reuses :meth:`_commit`'s snapshot →
+        POST-unlocked → fold pattern, so a slow apiserver during the
+        housekeeping tick never stalls a live member's reserve path."""
         with self._table_lock:
             committed = [(k, g) for k, g in self._groups.items()
                          if g.committed]
         attempts = 0
         for key, group in committed:
-            with group.lock:
-                for uid in list(group.reservations):
-                    if uid in group.bound:
-                        continue
-                    attempts += 1
-                    try:
-                        self._bind_one(group, uid)
-                    except ApiError:
-                        pass
-                if group.fully_bound():
-                    with self._table_lock:
-                        self._groups.pop(key, None)
+            attempts += self._commit(key, group)
         return attempts
 
     # ------------------------------------------------------------------ #
@@ -555,6 +629,17 @@ class GangPlanner:
         schedule cleanly on retry. Committed groups are never rolled back
         here — their unbound members are retried by :meth:`retry_unbound`.
         Returns the number of groups rolled back.
+
+        The group lock covers only the detach (flag ``rolled_back``,
+        capture the victims, clear the reservations); the per-member
+        rollback — ledger free, annotation strip, Event — is apiserver
+        traffic and runs with no gang lock held. The table key is
+        popped only AFTER that rollback completes: until then a
+        scheduler retry of a victim pod finds the dying group, fails
+        ``_reserve_member``'s liveness check, and rolls its own
+        allocation back — popping first would hand the key to a fresh
+        group whose re-charged uids this stale rollback then destroys
+        (double allocation).
         """
         now = time.monotonic()
         with self._table_lock:
@@ -565,24 +650,27 @@ class GangPlanner:
             with group.lock:
                 if group.committed:  # raced with a commit
                     continue
-                log.warning("gang %s/%s: expired at %d/%d members; rolling "
-                            "back", key[0], group.name,
-                            len(group.reservations), group.minimum)
-                for pod, _node in group.reservations.values():
-                    self.cache.remove_pod(pod)
-                    self._strip_annotations(pod)
-                    events.record(
-                        self.client, pod, events.REASON_GANG_EXPIRED,
-                        f"gang {group.name} expired at "
-                        f"{len(group.reservations)}/{group.minimum} members; "
-                        "reservation rolled back", event_type="Warning",
-                        # Housekeeping thread: no thread-local trace —
-                        # correlate via the member's own annotation.
-                        trace_id=pod.annotations.get(const.ANN_TRACE_ID, ""))
+                group.rolled_back = True
+                victims = list(group.reservations.values())
                 group.reservations.clear()
-                with self._table_lock:
-                    self._groups.pop(key, None)
-                rolled += 1
+            log.warning("gang %s/%s: expired at %d/%d members; rolling "
+                        "back", key[0], group.name, len(victims),
+                        group.minimum)
+            for pod, _node in victims:
+                self.cache.remove_pod(pod)
+                self._strip_annotations(pod)
+                events.record(
+                    self.client, pod, events.REASON_GANG_EXPIRED,
+                    f"gang {group.name} expired at "
+                    f"{len(victims)}/{group.minimum} members; "
+                    "reservation rolled back", event_type="Warning",
+                    # Housekeeping thread: no thread-local trace —
+                    # correlate via the member's own annotation.
+                    trace_id=pod.annotations.get(const.ANN_TRACE_ID, ""))
+            with self._table_lock:
+                if self._groups.get(key) is group:
+                    del self._groups[key]
+            rolled += 1
         return rolled
 
     def _strip_annotations(self, pod: Pod) -> None:

@@ -84,7 +84,7 @@ def _parse_entry(name: str, raw: str) -> SLOSpec | None:
         doc = json.loads(raw)
     # Not a lost observation: the skip is warned and the caller falls
     # back to a safe table — nothing to count.
-    # vet: ignore[swallowed-telemetry-error]
+    # vet: ignore[swallowed-telemetry-error] - warned config-parse skip with safe fallback
     except (ValueError, TypeError):
         log.warning("SLO entry %r is not valid JSON; skipping it", name)
         return None
@@ -111,7 +111,7 @@ def _parse_entry(name: str, raw: str) -> SLOSpec | None:
         threshold = float(doc.get("thresholdSeconds", 0))
         fast_burn = float(doc.get("fastBurn", DEFAULT_FAST_BURN))
     # Same config-parse shape as above: warned skip, safe fallback.
-    # vet: ignore[swallowed-telemetry-error]
+    # vet: ignore[swallowed-telemetry-error] - warned config-parse skip with safe fallback
     except (TypeError, ValueError):
         log.warning("SLO entry %r has a non-numeric field; skipping "
                     "the whole entry", name)

@@ -25,7 +25,7 @@ def parse_rfc3339(raw: str) -> datetime | None:
                 tzinfo=timezone.utc)
         # Format probe, not a swallowed observation: the None sentinel
         # is the loud, typed "could not parse" answer.
-        # vet: ignore[swallowed-telemetry-error]
+        # vet: ignore[swallowed-telemetry-error] - format probe; the None sentinel is the answer
         except (ValueError, TypeError):
             continue
     return None

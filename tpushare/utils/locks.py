@@ -45,6 +45,18 @@ import time
 import traceback
 from typing import Any, Callable, Iterable, Mapping
 
+#: Declared lock identities for the static analyzer (tools/vet/flow):
+#: every TracingRLock carries its site string in its constructor call,
+#: which the analyzer reads from the AST — but the two raw locks below
+#: are this module's own internals (a TracingRLock cannot profile
+#: itself without recursing) and would otherwise be anonymous in the
+#: static lock-order graph. Keys are the module-level names, values
+#: the site strings the flow analysis uses for them.
+FLOW_DECLARED_SITES: dict[str, str] = {
+    "_registry_lock": "locks/contention-registry",
+    "_race_lock": "locks/race-detector",
+}
+
 _registry_lock = threading.Lock()
 #: site -> [contention events, total seconds spent waiting]
 _registry: dict[str, list] = {}
@@ -128,6 +140,14 @@ def _held_stack() -> list[str]:
     if stack is None:
         stack = _tls.held = []
     return stack
+
+
+def held_sites() -> tuple[str, ...]:
+    """Lock sites the CURRENT thread holds right now (outermost
+    first). Maintained whether or not the detector is armed — tests
+    use this to prove an I/O seam runs with no ledger lock held (the
+    static twin is vet-flow's blocking-under-lock rule)."""
+    return tuple(_held_stack())
 
 
 def _caller_site() -> str:
