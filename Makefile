@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: test lint test-race chipcheck cochipcheck native bench bench-workload all
+.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-workload all
 
 # CPU test suite (virtual 8-device mesh; kernels in interpreter mode).
 test:
@@ -25,7 +25,7 @@ lint:
 # fails on any lock-order cycle (potential deadlock) or any mutation of
 # a registered guarded container while its lock is unheld.
 test-race:
-	TPUSHARE_RACE_DETECT=1 python -m pytest tests/test_soak.py tests/test_scale.py tests/test_vet.py tests/test_trace.py -q
+	TPUSHARE_RACE_DETECT=1 python -m pytest tests/test_soak.py tests/test_scale.py tests/test_vet.py tests/test_trace.py tests/test_profiling.py -q
 
 # On-chip Pallas kernel regression — REQUIRES real TPU hardware.
 # Interpreter-mode tests cannot catch (8,128)-tiling / MXU lowering
@@ -48,6 +48,13 @@ native:
 # Scheduling benchmark (prints the one-line JSON contract).
 bench:
 	python bench.py
+
+# The 1k-node / 10k-pod scale scenario with the continuous profiler
+# armed: latency + attribution + profiler-overhead gates, and the
+# BENCH_SCALE.json / BENCH_SCALE.collapsed artifacts behind the
+# docs/perf.md hot-path budget.
+bench-scale:
+	python bench.py --scale --gate
 
 # On-chip workload perf: flash-vs-XLA attention + flagship MFU, with
 # regression gates — REQUIRES real TPU hardware (chipcheck's perf twin).

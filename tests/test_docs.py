@@ -18,6 +18,7 @@ QUOTA_MD = os.path.join(REPO_ROOT, "docs", "quota.md")
 SLO_MD = os.path.join(REPO_ROOT, "docs", "slo.md")
 DEFRAG_MD = os.path.join(REPO_ROOT, "docs", "defrag.md")
 VET_MD = os.path.join(REPO_ROOT, "docs", "vet.md")
+PERF_MD = os.path.join(REPO_ROOT, "docs", "perf.md")
 
 _METRIC_CTORS = {"Counter", "Gauge", "Histogram", "Summary"}
 
@@ -198,6 +199,46 @@ def test_vet_doc_covers_the_flow_layer():
     assert not missing, f"flow rules absent from docs/vet.md: {missing}"
 
 
+def test_perf_doc_covers_the_contract():
+    """docs/perf.md is the profiling + hot-path-budget contract: it
+    must keep naming the three engines, the env knobs, every surface,
+    the scale scenario with its gates, the handler-vs-wire clock
+    distinction, and a per-verb budget table with verdicts."""
+    with open(PERF_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("TPUSHARE_PROFILE", "TPUSHARE_PROFILE_HZ",
+                   "TPUSHARE_GC_TUNE", "ITIMER_PROF", "SIGPROF",
+                   "cost ledger", "decision probe", "cProfile",
+                   "thread_time_ns", "cpuSeconds",
+                   "/debug/profile/continuous", "/debug/hotspots",
+                   "kubectl inspect tpushare hotspots",
+                   "profile: on", "--scale", "--smoke",
+                   "BENCH_SCALE.json", "BENCH_SCALE.collapsed",
+                   "Server-Timing", "percentageOfNodesToScore",
+                   "attribution", "coverage", "Runbook",
+                   "gc.freeze", "Justified", "Target"):
+        assert needle in doc, needle
+    # every per-verb/profiler/process metric the code registers is in
+    # the observability catalogue (the blanket gate covers that); the
+    # budget doc must name at least the headline series.
+    for needle in ("tpushare_verb_self_cpu_seconds_total",
+                   "tpushare_verb_decisions_total",
+                   "tpushare_profiler_overhead_",
+                   "tpushare_process_rss_bytes",
+                   "tpushare_gc_collections_total"):
+        assert needle in doc, needle
+
+
+def test_perf_doc_is_linked():
+    """observability.md (the catalogue), the README, and the user
+    guide must keep pointing at the profiling contract."""
+    for path in (OBSERVABILITY_MD,
+                 os.path.join(REPO_ROOT, "README.md"),
+                 os.path.join(REPO_ROOT, "docs", "userguide.md")):
+        with open(path, encoding="utf-8") as f:
+            assert "perf.md" in f.read(), path
+
+
 def test_vet_doc_is_linked():
     """README and the user guide must keep pointing at the analysis
     gate's contract."""
@@ -232,6 +273,8 @@ if __name__ == "__main__":
                   test_slo_doc_is_linked,
                   test_defrag_doc_covers_the_contract,
                   test_defrag_doc_is_linked,
+                  test_perf_doc_covers_the_contract,
+                  test_perf_doc_is_linked,
                   test_vet_doc_covers_the_flow_layer,
                   test_vet_doc_is_linked):
         try:

@@ -110,6 +110,225 @@ def test_ledger_incremental_matches_recompute():
         controller.stop()
 
 
+class TestAdmissionSummaries:
+    """The verb fast paths read incrementally-maintained NodeSummary
+    digests instead of replaying assume per candidate (the 1k-node
+    refactor, docs/perf.md). These prove the two paths can never
+    disagree, across random fleet states and every request shape."""
+
+    def _random_fleet(self, seed: int, nodes: int = 12):
+        import random
+
+        rng = random.Random(seed)
+        api = FakeApiServer()
+        names = []
+        for i in range(nodes):
+            name = f"eq-{i:02d}"
+            names.append(name)
+            api.create_node(make_node(name, chips=4,
+                                      hbm_per_chip=rng.choice([16, 95]),
+                                      topology="2x2x1", tpu_type="v5p"))
+        stack = build_stack(api)
+        stack.controller.start(workers=2)
+        cache = stack.controller.cache
+        for n in names:
+            cache.get_node_info(n)
+        # random residents straight through the REAL allocate path
+        for i in range(rng.randint(10, 60)):
+            node = rng.choice(names)
+            info = cache.get_node_info(node)
+            kind = rng.random()
+            try:
+                if kind < 0.2:
+                    pod = api.create_pod(make_pod(f"w-{seed}-{i}",
+                                                  chips=rng.choice(
+                                                      [1, 2, 4])))
+                else:
+                    pod = api.create_pod(make_pod(
+                        f"s-{seed}-{i}", hbm=rng.choice([2, 8, 16, 44])))
+                info.allocate(api, pod)
+            except Exception:
+                api.delete_pod("default", pod.name)
+        stack.controller.wait_idle(timeout=20)
+        return api, stack, names, rng
+
+    def test_fast_path_matches_assume_across_random_states(self):
+        from tpushare.api.extender import ExtenderArgs
+
+        for seed in range(6):
+            api, stack, names, rng = self._random_fleet(seed)
+            pred = stack.predicate
+            try:
+                shapes = [{"hbm": 8}, {"hbm": 44}, {"hbm": 95},
+                          {"chips": 1}, {"chips": 4}]
+                for j, shape in enumerate(shapes):
+                    pod = api.create_pod(make_pod(f"probe-{seed}-{j}",
+                                                  **shape))
+                    args = ExtenderArgs.from_json(
+                        {"Pod": pod.raw, "NodeNames": names})
+                    result = pred.handle(args)
+                    fast_pass = set(result.node_names)
+                    # ground truth: the full assume replay per node
+                    for name in names:
+                        ok, reason = pred.filter_node(pod, name)
+                        assert (name in fast_pass) == ok, (
+                            seed, shape, name, reason,
+                            result.failed_nodes.get(name))
+                        if not ok:
+                            assert result.failed_nodes[name] == reason
+            finally:
+                stack.binder.gang_planner.stop()
+                stack.controller.stop()
+
+    def test_fast_path_scores_match_score_node(self):
+        from tpushare.api.extender import ExtenderArgs
+
+        api, stack, names, rng = self._random_fleet(99)
+        prio = stack.prioritize
+        try:
+            for shape in ({"hbm": 8}, {"hbm": 44}, {"chips": 2},
+                          {"chips": 4}):
+                pod = api.create_pod(make_pod(
+                    f"sprobe-{shape.get('hbm', 0)}-{shape.get('chips', 0)}",
+                    **shape))
+                out = prio.handle(ExtenderArgs.from_json(
+                    {"Pod": pod.raw, "NodeNames": names}))
+                for entry in out:
+                    slow = prio.score_node(pod, entry.host, set())
+                    assert entry.score == slow, (shape, entry.host)
+        finally:
+            stack.binder.gang_planner.stop()
+            stack.controller.stop()
+
+    def test_summary_invalidated_by_allocate_and_remove(self, api):
+        from tpushare.cache.cache import SchedulerCache
+
+        api.create_node(make_node("sum-n", chips=4, hbm_per_chip=16))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        info = cache.get_node_info("sum-n")
+        s0 = info.summary()
+        assert s0.max_free_chip == 16 and len(s0.free_chips) == 4
+        pod = api.create_pod(make_pod("sum-p", hbm=10))
+        info.allocate(api, pod)
+        s1 = info.summary()
+        assert s1 is not s0  # mutation invalidated and republished
+        assert s1.max_free_chip == 16  # other chips untouched
+        assert len(s1.free_chips) == 3
+        info.remove_pod(api.get_pod("default", "sum-p"))
+        s2 = info.summary()
+        assert len(s2.free_chips) == 4
+        # memos keyed on summary identity cannot serve stale verdicts
+        assert s2 is not s1
+
+    def test_refresh_node_applies_the_delivered_doc_without_a_get(self, api):
+        """The informer's node-update push path must fold the document
+        the watch already delivered — not re-GET it from the apiserver
+        on the dispatch thread (one blocking RTT per kubelet status
+        update at 1k nodes)."""
+        from tpushare.cache.cache import SchedulerCache
+        from tpushare.utils import const
+
+        api.create_node(make_node("push-n", chips=4, hbm_per_chip=16))
+        gets = []
+
+        def counting_getter(name):
+            gets.append(name)
+            return api.get_node(name)
+
+        cache = SchedulerCache(counting_getter, api.list_pods)
+        info = cache.get_node_info("push-n")
+        assert info.summary().sharing
+        baseline = len(gets)
+        # Flip the sharing bit via the document alone (capacity gone).
+        fresh = api.get_node("push-n")
+        fresh.raw.setdefault("status", {})["capacity"] = {}
+        fresh.raw["status"]["allocatable"] = {}
+        fresh.raw["metadata"]["resourceVersion"] = "999999"
+        cache.refresh_node(fresh)
+        assert len(gets) == baseline  # no wire call on the push path
+        assert cache.peek_node_info("push-n").summary().sharing is False
+        # Unchanged resourceVersion is a no-op; unknown nodes are left
+        # to first-use construction.
+        cache.refresh_node(fresh)
+        cache.refresh_node(api.create_node(
+            make_node("never-seen", chips=4, hbm_per_chip=16)))
+        assert len(gets) == baseline
+        with cache._lock:
+            assert "never-seen" not in cache._nodes
+        # A chip-set change through the push path rebuilds the ledger
+        # (still from the delivered doc). New Node instance: the watch
+        # delivers a distinct decode per event, never the cached one.
+        import copy
+
+        from tpushare.api.objects import Node
+        fresh = Node(copy.deepcopy(fresh.raw))
+        fresh.raw["metadata"]["annotations"][const.ANN_NODE_CHIP_HBM] = \
+            "32,32"
+        fresh.raw["metadata"]["resourceVersion"] = "1000000"
+        cache.refresh_node(fresh)
+        assert len(gets) == baseline
+        rebuilt = cache.peek_node_info("push-n")
+        assert rebuilt is not info and len(rebuilt.chips) == 2
+
+    def test_sharing_flip_invalidates_under_the_node_lock(self, api):
+        """A document-only sharing flip must invalidate the summary
+        while HOLDING the node lock: an in-flight summary() rebuild
+        (which holds it) could otherwise republish a digest built from
+        the pre-flip bit after the invalidation — and on an empty node
+        no chip mutation would ever re-invalidate it."""
+        from tpushare.cache.cache import SchedulerCache
+        from tpushare.utils import locks
+
+        api.create_node(make_node("flip-n", chips=4, hbm_per_chip=16))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        info = cache.get_node_info("flip-n")
+        info.summary()
+        seen = []
+        orig = info._invalidate_summary
+        info._invalidate_summary = (  # type: ignore[method-assign]
+            lambda: (seen.append(locks.held_sites()), orig())[1])
+        fresh = api.get_node("flip-n")
+        fresh.raw.setdefault("status", {})["capacity"] = {}
+        fresh.raw["status"]["allocatable"] = {}
+        for rv, apply in (("777777", cache.refresh_node),
+                          ("777778",
+                           lambda n: (api.update_node(n),
+                                      cache.get_node_info("flip-n")))):
+            fresh.raw["metadata"]["resourceVersion"] = rv
+            apply(fresh)
+        assert len(seen) == 2  # both twin branches actually invalidated
+        for sites in seen:
+            assert "node/flip-n" in sites, sites
+
+    def test_nominated_nodes_take_the_full_assume_path(self, api):
+        """A node with earmarked preemption demand must not admit a pod
+        through the summary (which cannot see nominees)."""
+        from tpushare.api.extender import ExtenderArgs
+
+        api.create_node(make_node("nom-n", chips=4, hbm_per_chip=16))
+        stack = build_stack(api)
+        stack.controller.start(workers=2)
+        try:
+            cache = stack.controller.cache
+            cache.get_node_info("nom-n")
+            # a nominee that earmarks the whole node's chips
+            api.create_pod(make_pod("nominee", chips=4, priority=100))
+            fresh = api.get_pod("default", "nominee")
+            fresh.raw.setdefault("status", {})["nominatedNodeName"] = \
+                "nom-n"
+            api.update_pod(fresh)
+            cache.note_nominated(api.get_pod("default", "nominee"))
+            probe = api.create_pod(make_pod("late", chips=4))
+            result = stack.predicate.handle(ExtenderArgs.from_json(
+                {"Pod": probe.raw, "NodeNames": ["nom-n"]}))
+            # summary says 4 free chips; the earmark must still deny
+            assert result.node_names == []
+            assert "nom-n" in result.failed_nodes
+        finally:
+            stack.binder.gang_planner.stop()
+            stack.controller.stop()
+
+
 @pytest.mark.perf
 def test_fleet_scale_filter_prioritize_256_nodes():
     """A 256-node fleet: the full webhook scan (filter all + prioritize

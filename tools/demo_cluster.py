@@ -37,6 +37,12 @@ def main() -> None:
             f"{args.tpu_type}-{i}", chips=args.chips, hbm_per_chip=args.hbm,
             topology=args.topology, tpu_type=args.tpu_type))
 
+    # The demo is an operator surface: arm the continuous profiler the
+    # way the real entrypoint does (TPUSHARE_PROFILE, default on), so
+    # /debug/hotspots and /debug/profile/continuous work out of the box.
+    from tpushare import profiling
+    profiling.arm_from_env()
+
     stack, server = serve_stack(api, ("127.0.0.1", args.port))
     print(f"extender listening on http://127.0.0.1:{args.port} with "
           f"{args.nodes} simulated {args.tpu_type} nodes "

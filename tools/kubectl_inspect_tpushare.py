@@ -10,9 +10,11 @@ from ``/debug/quota`` (docs/quota.md); the ``slo`` subcommand renders
 the error-budget / burn-rate table from ``/debug/slo`` (docs/slo.md);
 the ``defrag`` subcommand renders the fragmentation index and the last
 rebalance plan (proposed vs executed vs aborted moves, with trace-ids)
-from ``/debug/defrag`` (docs/defrag.md); ``explain`` heads its span
-timeline with the pod's journey (attempt N of M, cumulative
-queue-wait).
+from ``/debug/defrag`` (docs/defrag.md); the ``hotspots`` subcommand
+renders the continuous profiler's per-verb top frames and exact
+wall/CPU/lock-wait/apiserver cost splits from ``/debug/hotspots``
+(docs/perf.md); ``explain`` heads its span timeline with the pod's
+journey (attempt N of M, cumulative queue-wait).
 
 Install as a kubectl plugin by dropping an executable named
 ``kubectl-inspect_tpushare`` on PATH that execs this script, or run it
@@ -450,6 +452,84 @@ def render_defrag(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def fetch_hotspots(endpoint: str, top: int = 5) -> dict | None:
+    """The continuous profiler's hotspot view from ``/debug/hotspots``;
+    None when the profiler is disarmed (TPUSHARE_PROFILE=off) or debug
+    routes are disabled."""
+    try:
+        with urllib.request.urlopen(
+                f"{endpoint}/debug/hotspots?top={top}", timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def render_hotspots(doc: dict) -> str:
+    """Per-verb top-frames table + the exact cost-ledger splits."""
+    verbs = doc.get("verbs", {})
+    costs = doc.get("verbCosts", {})
+    lines = [
+        f"continuous profiler: {doc.get('samplingPasses', 0)} sampling "
+        f"passes at {doc.get('hz', '?')}Hz over the last "
+        f"{doc.get('windowSeconds', '?')}s, overhead "
+        f"{doc.get('overheadRatio', 0) * 100:.2f}%",
+    ]
+    interesting = {v: d for v, d in verbs.items()
+                   if v not in ("idle",)}
+    if not interesting:
+        lines.append("no samples in the window yet — drive some verbs "
+                     "and re-run")
+
+    def weight(vdoc: dict) -> float:
+        # Same units both engines: decision-probe entries carry exact
+        # profiled seconds, sampler entries a seconds ESTIMATE
+        # (samples x interval) — raw sample counts would out-sort the
+        # verbs by ~hz-fold.
+        return float(vdoc.get("profiledSeconds")
+                     or vdoc.get("estSeconds") or 0.0)
+
+    for verb, vdoc in sorted(interesting.items(),
+                             key=lambda kv: -weight(kv[1])):
+        lines.append("")
+        if vdoc.get("engine") == "decision-probe":
+            head = (f"{verb}: {vdoc['profiledDecisions']} decision(s) "
+                    f"profiled exactly (1 in {vdoc['duty']}), "
+                    f"{vdoc['profiledSeconds'] * 1e3:.1f}ms self time, "
+                    f"top frames cover {vdoc['coverage'] * 100:.0f}%")
+        else:
+            head = (f"{verb}: {vdoc['samples']} samples "
+                    f"(~{vdoc['estSeconds']}s), top frames cover "
+                    f"{vdoc['coverage'] * 100:.0f}% of verb time")
+        cost = costs.get(verb)
+        if cost:
+            head += (f"; exact: {cost['wallSeconds']:.3f}s wall = "
+                     f"{cost['cpuSeconds']:.3f} cpu + "
+                     f"{cost['lockWaitSeconds']:.3f} lock-wait + "
+                     f"{cost['apiSeconds']:.3f} apiserver + residue "
+                     f"across {cost['decisions']} decisions")
+        lines.append(head)
+        for f in vdoc.get("frames", []):
+            lines.append(f"  {f['share'] * 100:5.1f}%  {f['frame']}")
+    # Ledger-only verbs (closed while the sampler was off/missed them).
+    for verb, cost in sorted(costs.items()):
+        if verb in interesting:
+            continue
+        lines.append("")
+        lines.append(
+            f"{verb}: no samples in window; exact ledger "
+            f"{cost['wallSeconds']:.3f}s wall = {cost['cpuSeconds']:.3f} "
+            f"cpu + {cost['lockWaitSeconds']:.3f} lock-wait + "
+            f"{cost['apiSeconds']:.3f} apiserver across "
+            f"{cost['decisions']} decisions")
+    lines.append("")
+    lines.append("Flamegraph-grade detail: GET /debug/profile/continuous "
+                 "(collapsed stacks, speedscope-ready). Budget doc + "
+                 "runbook: docs/perf.md.")
+    return "\n".join(lines)
+
+
 def whatif_preempt(endpoint: str, hbm: int, chips: int, priority: int,
                    node: str | None) -> str:
     """Dry-run the preempt verb: which pods would a (hypothetical)
@@ -528,7 +608,9 @@ def main(argv: list[str] | None = None) -> int:
                              "or the literal 'slo' for the error-budget "
                              "/ burn-rate table; or the literal "
                              "'defrag' for the fragmentation index and "
-                             "the last rebalance plan")
+                             "the last rebalance plan; or the literal "
+                             "'hotspots' for the continuous profiler's "
+                             "per-verb top frames + cost splits")
     parser.add_argument("pod", nargs="?", metavar="[ns/]pod",
                         help="with 'explain': the pod whose placement "
                              "decision to explain (namespace defaults "
@@ -591,6 +673,24 @@ def main(argv: list[str] | None = None) -> int:
                   "(DEBUG_ROUTES=0)", file=sys.stderr)
             return 1
         print(render_defrag(doc))
+        return 0
+    if args.node == "hotspots":
+        if args.pod:
+            print(f"unexpected argument {args.pod!r} after 'hotspots'",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = fetch_hotspots(args.endpoint)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        if doc is None:
+            print("hotspots unavailable — the continuous profiler is "
+                  "disarmed (TPUSHARE_PROFILE=off) or debug routes are "
+                  "disabled (DEBUG_ROUTES=0)", file=sys.stderr)
+            return 1
+        print(render_hotspots(doc))
         return 0
     if args.node == "quota":
         if args.pod:

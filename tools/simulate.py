@@ -34,6 +34,10 @@ Scenario schema (YAML or JSON)::
                                  # unschedulable: dry-run reports the
                                  # move plan; active executes it and
                                  # re-binds the migrants (optional)
+    profile: on                  # arm the continuous profiler for the
+                                 # replay; the report gains a hotspots
+                                 # section (per-verb top frames + the
+                                 # exact cost-ledger splits) (optional)
     quotas:                      # per-tenant quota table  (optional) —
       team-a:                    # becomes the tpushare-quotas ConfigMap
         guaranteeHBM: 64         # GiB owed to the tenant
@@ -241,6 +245,15 @@ def simulate(scenario: dict) -> dict:
     # previous run's.
     from tpushare import slo as slo_mod
     slo_mod.reset()
+    # `profile: on` arms the continuous profiler for this replay; the
+    # singletons are reset so the report covers THIS run's verbs only.
+    profiled = str(scenario.get("profile", "")).lower() in (
+        "on", "true", "1", "yes")
+    from tpushare import profiling
+    if profiled:
+        profiling.reset()
+        # A replay is seconds long: sample fast enough to resolve it.
+        profiling.start(hz=100)
     api = _fresh_api(node_docs)
     quota_cm = _quota_configmap(scenario)
     if quota_cm is not None:
@@ -339,12 +352,22 @@ def simulate(scenario: dict) -> dict:
         # aggregates (e2e percentiles, attempts) — the numbers a real
         # fleet would alert on, read from the same /debug/slo surface.
         slo_doc = client.get("/debug/slo")
+        hotspots_doc = None
+        if profiled:
+            # Read over the wire like every other surface here, so the
+            # replay also proves the endpoint round-trips.
+            hotspots_doc = client.get("/debug/hotspots?top=5")
     finally:
+        if profiled:
+            profiling.stop()
         client.close()
         shutdown_stack(stack, server)
-    return _report(inspect_doc, placements, held, unschedulable,
-                   latencies, executed_preemptions, tenants, slo_doc,
-                   defrag_report)
+    report = _report(inspect_doc, placements, held, unschedulable,
+                     latencies, executed_preemptions, tenants, slo_doc,
+                     defrag_report)
+    if hotspots_doc is not None:
+        report["hotspots"] = hotspots_doc
+    return report
 
 
 def _run_defrag(api, client: _Client, stack, mode, unschedulable,
@@ -654,6 +677,36 @@ def _print_human(report: dict) -> None:
               + ", ".join(f"{w}={v['burnRate']}x"
                           for w, v in s["windows"].items())
               + f" (budget {s['errorBudgetRemaining'] * 100:.0f}% left)")
+    hot = report.get("hotspots")
+    if hot:
+        print(f"\nhotspots (continuous profiler, "
+              f"{hot.get('samplingPasses', 0)} passes at "
+              f"{hot.get('hz', '?')}Hz, overhead "
+              f"{hot.get('overheadRatio', 0) * 100:.2f}%):")
+        costs = hot.get("verbCosts", {})
+        shown = {v: d for v, d in hot.get("verbs", {}).items()
+                 if v != "idle"}
+        for verb, vdoc in sorted(
+                shown.items(),
+                key=lambda kv: -float(kv[1].get("profiledSeconds")
+                                      or kv[1].get("estSeconds")
+                                      or 0.0)):
+            cost = costs.get(verb, {})
+            extra = ""
+            if cost:
+                extra = (f" | exact {cost['wallSeconds']:.3f}s wall, "
+                         f"{cost['cpuSeconds']:.3f} cpu, "
+                         f"{cost['lockWaitSeconds']:.3f} lock, "
+                         f"{cost['apiSeconds']:.3f} api")
+            if vdoc.get("engine") == "decision-probe":
+                head = (f"{vdoc['profiledDecisions']} decision(s) "
+                        "profiled exactly")
+            else:
+                head = f"{vdoc['samples']} samples"
+            print(f"  {verb}: {head}, top frames cover "
+                  f"{vdoc['coverage'] * 100:.0f}%{extra}")
+            for f in vdoc.get("frames", [])[:3]:
+                print(f"    {f['share'] * 100:5.1f}%  {f['frame']}")
     if report.get("tenants"):
         print("\ntenants (quota):")
         for t in report["tenants"]:

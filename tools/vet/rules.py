@@ -86,6 +86,14 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
     # The shared eviction budget (tpushare/k8s/eviction.py) is hit
     # concurrently by the defrag executor and any parallel eviction.
     "EvictionBudget": ("_node_last", "_recent", "_in_flight"),
+    # Continuous profiling (tpushare/profiling/): the sampler's window
+    # and cumulative counters are written by the SIGPROF handler /
+    # sampler thread while /debug readers and the metrics scrape merge
+    # them; the ledger and decision-probe aggregates are written from
+    # every verb thread's phase hook.
+    "ContinuousProfiler": ("_buckets", "_cum", "_cum_verb", "_cum_idle"),
+    "VerbCostLedger": ("_verbs",),
+    "DecisionProfiler": ("_self_s", "_profiled"),
 }
 
 #: Method calls that mutate a dict/set/list in place.
@@ -297,7 +305,8 @@ def raw_lock(tree: ast.AST, src: str, path: str) -> list[Violation]:
 #: "quiet fleet" when the truth is "blind fleet". Every swallow must
 #: increment a drop/error counter so the loss itself is observable.
 _TELEMETRY_PATHS = ("k8s/events.py", "routes/metrics.py")
-_TELEMETRY_DIRS = ("tpushare/trace/", "tpushare/slo/", "tpushare/defrag/")
+_TELEMETRY_DIRS = ("tpushare/trace/", "tpushare/slo/",
+                   "tpushare/defrag/", "tpushare/profiling/")
 
 #: Call shapes that count as incrementing a drop/error counter
 #: (bare ``safe_inc(...)``, ``metrics.safe_inc(...)``, ``x.inc()``).

@@ -22,6 +22,7 @@ from tools.vet.engine import Violation
 CORE_PACKAGES = ("tpushare/cache/", "tpushare/scheduler/",
                  "tpushare/utils/", "tpushare/api/", "tpushare/quota/",
                  "tpushare/slo/", "tpushare/defrag/",
+                 "tpushare/profiling/",
                  "tpushare/k8s/eviction.py")
 
 #: Parameter names exempt from annotation (bound implicitly).

@@ -116,6 +116,16 @@ class SchedulerCache:
             return [p for p in self._nominated.values()
                     if p.nominated_node_name == node_name]
 
+    def nominated_node_names(self) -> set[str]:
+        """Nodes with ANY earmarked preemption demand — the filter fast
+        path's trigger set for falling back to the full per-node assume
+        (O(nominated), which is almost always zero)."""
+        with self._lock:
+            if not self._nominated:
+                return set()
+            return {p.nominated_node_name
+                    for p in self._nominated.values()}
+
     # ------------------------------------------------------------------ #
     # Node table (reference cache.go:36-46, 130-162)
     # ------------------------------------------------------------------ #
@@ -173,13 +183,62 @@ class SchedulerCache:
                     if pod.node_name == name and not podutils.is_complete_pod(pod):
                         info.add_or_update_pod(pod)
                 self._nodes[name] = info
-            else:
-                info.node = node  # keep the freshest node document
-            return info
+                return info
+        # Same chip set: fold the fresh document in OUTSIDE the table
+        # lock — apply_node_document takes the node lock, and keeping
+        # the two un-nested keeps the acquisition graph a DAG.
+        info.apply_node_document(node)
+        return info
 
     def get_node_infos(self) -> list[NodeInfo]:
         with self._lock:
             return list(self._nodes.values())
+
+    def node_table(self) -> dict[str, NodeInfo]:
+        """One-lock snapshot of the whole ledger table, for the verb
+        fast paths: at 1024 candidates, per-name ``get_node_info`` calls
+        (each re-validating the node document against the informer) cost
+        more than the verb's real work. The copy is a C-level dict copy;
+        freshness is push-maintained — the controller's node watch
+        handlers call :meth:`refresh_node`/:meth:`remove_node`, and a
+        name missing here (first sight) falls back to
+        :meth:`get_node_info`. Callers must treat values as read-only
+        ledgers."""
+        with self._lock:
+            return dict(self._nodes)
+
+    def refresh_node(self, node: Node) -> None:
+        """Push path for the informer's node add/update events: bring
+        the cached ledger (and its admission summary) in line with the
+        freshest node document — the watch-driven twin of the pull
+        re-validation inside :meth:`get_node_info`, applied from the
+        document the watch ALREADY delivered (no apiserver round-trip
+        on the informer dispatch thread — at 1k nodes, kubelet status
+        updates arrive continuously and a blocking GET per event
+        serializes pod handling behind network RTTs). Unknown nodes are
+        left to first-use construction (the fast paths' miss
+        fallback)."""
+        with self._lock:
+            info = self._nodes.get(node.name)
+            if info is None:
+                return
+            if (node.resource_version
+                    and info.node.resource_version == node.resource_version):
+                return
+            fresh_caps = nodeutils.get_chip_capacities(node)
+            if [c.total_hbm for c in
+                    (info.chips[i] for i in sorted(info.chips))] != fresh_caps:
+                log.info("rebuilding ledger for node %s (chip set changed)",
+                         node.name)
+                info = NodeInfo(node, self._default_scoring)
+                for pod in self._known_pods.values():
+                    if (pod.node_name == node.name
+                            and not podutils.is_complete_pod(pod)):
+                        info.add_or_update_pod(pod)
+                self._nodes[node.name] = info
+                return
+        # Outside the table lock, as in get_node_info's twin branch.
+        info.apply_node_document(node)
 
     def peek_node_info(self, name: str) -> NodeInfo | None:
         """The cached ledger WITHOUT the apiserver freshness round-trip
@@ -261,7 +320,14 @@ class SchedulerCache:
                     # exactly what the chip ledger just priced, so quota
                     # usage rebuilds from annotations alongside it.
                     self.quota.charge(pod)
-            return added
+        if added:
+            # Rebuild the admission summary HERE, on the mutating
+            # thread (a sync worker, usually) — a churn wave otherwise
+            # leaves hundreds of invalidated summaries for the next
+            # filter call to rebuild in one storm (a p99 spike the
+            # scale profile pinned; docs/perf.md).
+            info.summary()
+        return added
 
     def remove_pod(self, pod: Pod) -> None:
         """Forget a pod and free its chips (reference cache.go:116-127)."""
@@ -273,6 +339,7 @@ class SchedulerCache:
             info = self._nodes.get(pod.node_name)
         if info is not None:
             info.remove_pod(pod)
+            info.summary()  # rebuild off the verb path (see add path)
 
     # ------------------------------------------------------------------ #
     # Startup rebuild (reference BuildCache, cache.go:49-74)

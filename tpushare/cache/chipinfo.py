@@ -9,6 +9,7 @@ capacity as used regardless of the pod's aggregate HBM annotation.
 
 from __future__ import annotations
 
+from typing import Callable
 
 from tpushare.utils import locks
 from tpushare.api.objects import Pod
@@ -18,9 +19,16 @@ from tpushare.utils import pod as podutils
 class ChipInfo:
     """One TPU chip's allocation state."""
 
-    def __init__(self, idx: int, total_hbm: int) -> None:
+    def __init__(self, idx: int, total_hbm: int,
+                 on_change: Callable[[], None] | None = None) -> None:
         self.idx = idx
         self.total_hbm = total_hbm
+        #: Invoked after every resident-set mutation, with the chip lock
+        #: held. The owning NodeInfo uses it to invalidate its cached
+        #: admission summary; every mutation path already runs under the
+        #: node lock too (add_or_update_pod / remove_pod / allocate), so
+        #: an invalidation can never interleave with a summary rebuild.
+        self._on_change = on_change
         self._lock = locks.TracingRLock(f"chip/{idx}")
         # Guarded: `make test-race` fails mutations while chip/N unheld.
         self.pods: dict[str, Pod] = locks.guarded_dict(
@@ -60,6 +68,8 @@ class ChipInfo:
             self._used -= self._contrib.get(pod.uid, 0)
             self._contrib[pod.uid] = self._contribution(pod)
             self._used += self._contrib[pod.uid]
+            if self._on_change is not None:
+                self._on_change()
 
     def remove_pod(self, pod: Pod) -> None:
         """Drop ``pod`` (reference deviceinfo.go:68-80)."""
@@ -67,6 +77,8 @@ class ChipInfo:
             if self.pods.pop(pod.uid, None) is not None:
                 self._active.discard(pod.uid)
                 self._used -= self._contrib.pop(pod.uid, 0)
+                if self._on_change is not None:
+                    self._on_change()
 
     def has_active_pods(self) -> bool:
         """O(1) occupancy check for the whole-chip allocator (priced at

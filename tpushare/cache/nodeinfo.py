@@ -19,7 +19,7 @@ Counterpart of the reference's ``pkg/cache/nodeinfo.go`` (NodeInfo,
 from __future__ import annotations
 
 import time
-from typing import Any
+from typing import Any, NamedTuple
 
 from tpushare import trace
 from tpushare.utils import locks
@@ -37,6 +37,32 @@ log = logging.getLogger(__name__)
 
 class AllocationError(Exception):
     """No placement exists for the pod on this node."""
+
+
+#: Bound on the per-node verb memos (distinct request shapes cached).
+MEMO_CAP = 64
+
+
+class NodeSummary(NamedTuple):
+    """Immutable free-capacity digest of one node's ledger — the unit of
+    the admission index the 1k-node filter/prioritize fast paths scan.
+
+    Rebuilt lazily after any chip mutation (the ChipInfo ``on_change``
+    hook clears the cache) and published as one atomic attribute write,
+    so the verbs read it with NO lock: at 1024 nodes the per-candidate
+    cost of ``get_node_info`` + ``get_available_hbm`` (≈10 lock
+    acquire/release cycles and a dict build per node) was the top block
+    of the continuous profiler's filter flamegraph (docs/perf.md)."""
+
+    #: Node advertises shareable TPU HBM at all.
+    sharing: bool
+    #: (free GiB, capacity GiB) per chip, in chip-index order.
+    avail: tuple[tuple[int, int], ...]
+    #: Indices of wholly-free chips (no resident active pods).
+    free_chips: tuple[int, ...]
+    #: Largest single-chip free HBM — the slice-admission test.
+    max_free_chip: int
+    chip_count: int
 
 
 def apply_nominated_demand(avail: dict[int, int], free_chips: set[int],
@@ -110,13 +136,40 @@ class NodeInfo:
         #: fallback inside podutils.effective_scoring (standalone use).
         self.default_scoring = default_scoring
         self._lock = locks.TracingRLock(f"node/{self.name}")
+        #: Cached admission summary. Copy-on-write: rebuilt under the
+        #: node lock, published by one atomic attribute write, cleared
+        #: (set to None) by the chips' on_change hook — which only ever
+        #: fires with the node lock held (every chip mutation path runs
+        #: under it), so a rebuild can never publish over a fresher
+        #: invalidation. Readers take no lock.
+        self._summary: NodeSummary | None = None
+        #: The node document's sharing bit, cached apart from the chip
+        #: summary: chip churn invalidates summaries ~fleet-wide every
+        #: round, and re-parsing the node's annotations per rebuild was
+        #: a top filter frame in the 1k-node profile (docs/perf.md).
+        #: Refreshed only when the node DOCUMENT changes
+        #: (SchedulerCache.get_node_info's document swap).
+        self._sharing: bool = nodeutils.is_tpu_sharing_node(node)
+        #: Per-request-shape verdict/score memos for the verb fast
+        #: paths: key → (summary-at-compute-time, cached value). An
+        #: entry is valid only while its summary object IS the current
+        #: one (identity check), so any ledger mutation implicitly
+        #: invalidates both. GIL-atomic dict ops, no lock: a racing
+        #: double-compute stores the same value twice. Bounded by the
+        #: distinct request shapes in flight (callers clear past
+        #: MEMO_CAP).
+        self.admit_memo: dict[tuple[int, int],
+                              tuple[NodeSummary, bool, str]] = {}
+        self.score_memo: dict[tuple[int, int, str],
+                              tuple[NodeSummary, int]] = {}
         caps = nodeutils.get_chip_capacities(node)
         # Guarded: the chip table itself only mutates at construction,
         # but registering it keeps `make test-race` watching for any
         # future in-place rebuild landing outside the lock.
         self.chips: dict[int, ChipInfo] = locks.guarded_dict(
             self._lock, f"NodeInfo({self.name}).chips",
-            {i: ChipInfo(i, cap) for i, cap in enumerate(caps)})
+            {i: ChipInfo(i, cap, on_change=self._invalidate_summary)
+             for i, cap in enumerate(caps)})
         self.chip_count = len(caps)
         self.total_hbm = sum(caps)
         topo_spec = nodeutils.get_topology(node)
@@ -194,6 +247,69 @@ class NodeInfo:
                 i: max(chip.total_hbm - chip.get_used_hbm(), 0)
                 for i, chip in self.chips.items()
             }
+
+    def apply_node_document(self, node: Node) -> None:
+        """Fold a fresh node document (same chip set) into the ledger:
+        keep the freshest doc and re-derive the cached sharing bit a
+        document change may flip without touching chips. Under the node
+        lock so an in-flight :meth:`summary` rebuild (which holds it)
+        can't republish a digest built from the pre-flip bit AFTER this
+        invalidation — on an empty node no chip mutation would ever
+        re-invalidate it. Callers hold NO table lock here (the two
+        locks never nest, keeping the acquisition graph a DAG)."""
+        with self._lock:
+            self.node = node
+            self._sharing = nodeutils.is_tpu_sharing_node(node)
+            self._invalidate_summary()
+
+    def _invalidate_summary(self) -> None:
+        # One atomic write; the next summary() rebuilds. Not a guarded
+        # field: the invariant is copy-on-write publish, not mutate-
+        # under-lock (though every caller does hold the node lock).
+        self._summary = None
+
+    def summary(self) -> NodeSummary:
+        """The node's admission digest (see :class:`NodeSummary`).
+
+        Fast path is one attribute read of an immutable tuple; the
+        rebuild (only after a ledger mutation) is O(chips) under the
+        node lock. ``node`` document swaps invalidate too (see
+        ``SchedulerCache.get_node_info`` / ``refresh_node``) so the
+        ``sharing`` bit tracks annotation changes."""
+        s = self._summary
+        if s is not None:
+            return s
+        with self._lock:
+            s = self._summary
+            if s is not None:
+                return s
+            avail: list[tuple[int, int]] = []
+            free: list[int] = []
+            max_free = 0
+            # Chip counters read WITHOUT the chip locks: every chip
+            # mutation runs under THIS node lock (add_or_update_pod /
+            # remove_pod / allocate), which we hold — churn invalidates
+            # most of the fleet's summaries every round, and 8 lock
+            # round-trips per rebuild were a top filter frame in the
+            # 1k-node profile (docs/perf.md).
+            for i, chip in self.chips.items():
+                used = chip._used
+                cap = chip.total_hbm
+                f = cap - used if used < cap else 0
+                avail.append((f, cap))
+                if f > max_free:
+                    max_free = f
+                if used == 0 and not chip._active:
+                    free.append(i)
+            s = NodeSummary(
+                sharing=self._sharing,
+                avail=tuple(avail),
+                free_chips=tuple(free),
+                max_free_chip=max_free,
+                chip_count=self.chip_count,
+            )
+            self._summary = s
+            return s
 
     def get_free_chips(self) -> list[int]:
         """Chips with no resident pods at all (candidates for whole-chip
@@ -427,6 +543,9 @@ class NodeInfo:
                        for c in chip_ids):
                     for cid in chip_ids:
                         self.chips[cid].add_pod(new_pod)
+            # Rebuild the admission summary on the bind path's own
+            # thread (~µs) so the next filter reads it for free.
+            self.summary()
             log.info(
                 "allocated pod %s/%s -> node %s chips %s (%d GiB)",
                 pod.namespace, pod.name, self.name, chip_ids, hbm_pod,

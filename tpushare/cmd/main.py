@@ -37,6 +37,15 @@ Environment (reference cmd/main.go:23,92-98):
   ConfigMap (SLO objectives: error budgets + burn-rate alerting,
   docs/slo.md) is trusted from; default ``kube-system``. Absent
   ConfigMap = the built-in default objectives.
+* ``TPUSHARE_PROFILE`` — ``on`` (default) arms the ALWAYS-ON continuous
+  profiler (rolling-window sampler + per-verb cost ledger, served at
+  ``/debug/profile/continuous`` and ``/debug/hotspots``; docs/perf.md);
+  ``off`` disarms the sampler (the exact cost ledger still accrues).
+  ``TPUSHARE_PROFILE_HZ`` overrides the sampling rate (default 25).
+* ``TPUSHARE_GC_TUNE`` — ``on`` (default) applies the fleet-scale GC
+  posture (``utils/runtime.py``: gen-2 stop-the-world pauses over a
+  1k-node ledger otherwise surface as webhook p99 spikes);
+  ``TPUSHARE_GC_GEN0`` overrides the gen-0 threshold.
 * ``TPUSHARE_DEFRAG_MODE`` — ``off`` | ``dry-run`` (default) |
   ``active``: the defragmentation rebalancer's posture (docs/defrag.md).
   Dry-run plans and publishes moves without evicting; active executes
@@ -259,6 +268,17 @@ def configure_logging(level_name: str | None = None,
 
 def main() -> None:
     configure_logging()
+
+    # Fleet-scale GC posture (TPUSHARE_GC_TUNE, default on): default
+    # thresholds schedule gen-2 stop-the-world pauses that ARE the
+    # webhook p99 once the ledger holds a 1k-node fleet (docs/perf.md).
+    from tpushare.utils.runtime import tune_gc_from_env
+    tune_gc_from_env()
+    # Continuous profiler + per-verb cost ledger (TPUSHARE_PROFILE,
+    # default on — designed to be running BEFORE the incident; the
+    # sampler holds itself inside the bench's <=5% overhead gate).
+    from tpushare import profiling
+    profiling.arm_from_env()
 
     port = int(os.environ.get("PORT", "39999"))
     workers = int(os.environ.get("WORKERS", "4"))

@@ -91,7 +91,14 @@ class Controller:
             on_delete=self._on_pod_delete,
             filter_fn=self._is_relevant_pod,
         )
-        self.hub.add_node_handler(on_delete=self._on_node_delete)
+        # Update pushes keep the verb fast paths honest: they serve
+        # cached ledgers without the per-candidate document
+        # re-validation get_node_info does, so a changed node document
+        # (capacity, sharing annotation) must land in the cache from the
+        # watch instead of being discovered per filter call.
+        self.hub.add_node_handler(
+            on_update=lambda old, new: self.cache.refresh_node(new),
+            on_delete=self._on_node_delete)
         self.hub.add_configmap_handler(
             on_add=self._on_quota_configmap,
             on_update=lambda old, new: self._on_quota_configmap(new),

@@ -7,6 +7,8 @@ here are the source of the bench harness's latency numbers.
 
 from __future__ import annotations
 
+import os
+
 from tpushare.utils import locks
 
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
@@ -357,6 +359,115 @@ TRACE_ABANDONED = Counter(
     registry=REGISTRY,
 )
 
+# -- Per-verb cost ledger + continuous profiler (docs/perf.md) ------------- #
+# Monotonic sources live in tpushare/profiling (ledger counters, the
+# sampler's cumulative frame counts); these gauges are SET from them at
+# scrape time — the workqueue-retries pattern — so a bounded, rebuilt
+# label set replaces unbounded Counter children.
+
+VERB_DECISIONS = Gauge(
+    "tpushare_verb_decisions_total",
+    "Verb phases closed since process start, per verb (filter, "
+    "prioritize, preempt, bind, defrag:*). Monotonic; set at scrape "
+    "time from the profiling cost ledger",
+    ["verb"], registry=REGISTRY,
+)
+VERB_WALL = Gauge(
+    "tpushare_verb_wall_seconds_total",
+    "Cumulative wall time inside each verb's decision spans. The "
+    "denominator of the per-verb cost story: compare the cpu/lock/api "
+    "splits below against it",
+    ["verb"], registry=REGISTRY,
+)
+VERB_CPU = Gauge(
+    "tpushare_verb_cpu_seconds_total",
+    "Cumulative thread-CPU time per verb (time.thread_time_ns deltas "
+    "on the decision spans): the verb's own compute. wall - cpu - "
+    "lock - api is the GIL/scheduler residue",
+    ["verb"], registry=REGISTRY,
+)
+VERB_LOCK_WAIT = Gauge(
+    "tpushare_verb_lock_wait_seconds_total",
+    "Cumulative time each verb spent parked on contended "
+    "TracingRLocks (the mutex-profile hook, folded per decision span)",
+    ["verb"], registry=REGISTRY,
+)
+VERB_API = Gauge(
+    "tpushare_verb_apiserver_seconds_total",
+    "Cumulative apiserver round-trip time charged to each verb's "
+    "decision spans (instrumented in tpushare/k8s/client.py)",
+    ["verb"], registry=REGISTRY,
+)
+VERB_SELF_CPU = Gauge(
+    "tpushare_verb_self_cpu_seconds_total",
+    "Per-frame self-CPU attribution per (verb, frame_bucket): the "
+    "duty-cycled decision probe's exact frame-share distribution "
+    "scaled by the cost ledger's exact per-verb CPU totals (an "
+    "in-process sampler cannot see sub-GIL-slice verbs, so verbs get "
+    "the deterministic engine); background categories (idle/other) "
+    "come from the continuous sampler's counters scaled by its "
+    "interval. Bounded label set: top frames per verb plus an 'other' "
+    "residue, rebuilt each scrape from monotonic sources. Flamegraph-"
+    "grade detail: GET /debug/hotspots and /debug/profile/continuous "
+    "(docs/perf.md)",
+    ["verb", "frame_bucket"], registry=REGISTRY,
+)
+PROFILER_PASSES = Gauge(
+    "tpushare_profiler_sampling_passes_total",
+    "Continuous-profiler sampling passes since process start "
+    "(monotonic; set at scrape time). Flat while TPUSHARE_PROFILE=off",
+    registry=REGISTRY,
+)
+PROFILER_OVERHEAD = Gauge(
+    "tpushare_profiler_overhead_ratio",
+    "Fraction of the continuous profiler's scheduled time spent "
+    "walking stacks — its self-reported cost. The bench --scale "
+    "overhead gate additionally holds the profiler's p99 latency "
+    "impact to <= 5% (docs/perf.md)",
+    registry=REGISTRY,
+)
+
+# -- Process self-metrics -------------------------------------------------- #
+# The scheduler exports fleet state everywhere above; these are about
+# ITS OWN health — the leaks and runaway threads that take the fleet's
+# scheduler down with no fleet-side warning.
+
+PROCESS_RSS = Gauge(
+    "tpushare_process_rss_bytes",
+    "Resident set size of the extender process (/proc/self/statm; "
+    "peak-RSS via resource.getrusage where /proc is absent). Sustained "
+    "growth across scrapes is a leak — check the flight ring, journey "
+    "tables, and /debug/pprof/heap",
+    registry=REGISTRY,
+)
+PROCESS_FDS = Gauge(
+    "tpushare_process_open_fds",
+    "Open file descriptors (/proc/self/fd). Growth means leaked "
+    "sockets — watch streams or webhook keep-alives not being closed",
+    registry=REGISTRY,
+)
+PROCESS_THREADS = Gauge(
+    "tpushare_process_threads",
+    "Live Python threads (threading.active_count): HTTP handlers, "
+    "sync workers, informer watches, housekeeping, the profiler. "
+    "Unbounded growth means a thread leak in one of them",
+    registry=REGISTRY,
+)
+GC_TRACKED = Gauge(
+    "tpushare_gc_tracked_objects",
+    "Objects currently tracked per GC generation (gc.get_count). "
+    "Gen-2 growth is the heap the stop-the-world collections walk — "
+    "the pause source docs/perf.md budgets",
+    ["generation"], registry=REGISTRY,
+)
+GC_COLLECTIONS = Gauge(
+    "tpushare_gc_collections_total",
+    "Cumulative GC collections per generation (gc.get_stats; "
+    "monotonic, set at scrape time). A rising gen-2 rate on the "
+    "webhook path shows up as latency p99 spikes",
+    ["generation"], registry=REGISTRY,
+)
+
 
 def render() -> bytes:
     with _SCRAPE_LOCK:
@@ -482,6 +593,106 @@ def observe_frag(defrag) -> None:
             NODE_FRAG_SCORE.labels(node=node["node"]).set(node["score"])
 
 
+def observe_profiling() -> None:
+    """Refresh the per-verb cost gauges and the profiler's self-series
+    from tpushare.profiling's monotonic sources. Rebuilt each scrape so
+    the frame_bucket label set stays the CURRENT top frames (a frame
+    that left the top-N folds into 'other' instead of freezing)."""
+    # Lazy import, matching this module's cycle-avoidance pattern —
+    # profiling imports trace, which lazily imports this module.
+    from tpushare import profiling
+
+    with _SCRAPE_LOCK:
+        for gauge in (VERB_DECISIONS, VERB_WALL, VERB_CPU,
+                      VERB_LOCK_WAIT, VERB_API, VERB_SELF_CPU):
+            gauge.clear()
+        ledger_rows = profiling.ledger().snapshot()
+        for verb, row in ledger_rows.items():
+            VERB_DECISIONS.labels(verb=verb).set(row["decisions"])
+            VERB_WALL.labels(verb=verb).set(row["wallSeconds"])
+            VERB_CPU.labels(verb=verb).set(row["cpuSeconds"])
+            VERB_LOCK_WAIT.labels(verb=verb).set(row["lockWaitSeconds"])
+            VERB_API.labels(verb=verb).set(row["apiSeconds"])
+        # Verb frame buckets: the decision probe's exact frame-share
+        # distribution scaled by the ledger's exact CPU totals (the
+        # sampler cannot see sub-GIL-slice verbs — see
+        # tpushare/profiling/decisions.py).
+        for verb, shares in profiling.verb_frame_distribution().items():
+            cpu_total = ledger_rows.get(verb, {}).get("cpuSeconds", 0.0)
+            for frame, share in shares.items():
+                VERB_SELF_CPU.labels(verb=verb, frame_bucket=frame).set(
+                    round(cpu_total * share, 4))
+        # Background categories (idle/other and any long-running verb
+        # the sampler did catch) come from the sampler's cumulative
+        # counters, scaled by its sampling interval.
+        prof = profiling.profiler()
+        for verb, frames in prof.cumulative_frames().items():
+            if verb in ledger_rows:
+                continue  # verb buckets above are authoritative
+            for frame, seconds in frames.items():
+                VERB_SELF_CPU.labels(verb=verb, frame_bucket=frame).set(
+                    round(seconds, 3))
+        status = prof.status()
+        PROFILER_PASSES.set(status["samplingPasses"])
+        PROFILER_OVERHEAD.set(status["overheadRatio"])
+
+
+def _rss_bytes() -> int | None:
+    """Current RSS from /proc (Linux); PEAK RSS via resource elsewhere;
+    None when neither source exists (the gauge then keeps its last
+    value — a platform fact, not a lost sample)."""
+    import sys as _sys
+    try:
+        with open("/proc/self/statm", encoding="ascii") as f:
+            pages = int(f.read().split()[1])
+        return pages * (os.sysconf("SC_PAGE_SIZE") or 4096)
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+            peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # ru_maxrss units differ: BYTES on macOS, KiB on Linux/BSD.
+            return peak if _sys.platform == "darwin" else peak * 1024
+        except Exception:
+            safe_inc(TELEMETRY_ERRORS)
+            return None
+
+
+#: Tri-state /proc/self/fd availability: None = not probed yet, False =
+#: permanently absent on this platform (a fact, noted once — NOT a
+#: telemetry drop to re-count every scrape).
+_PROC_FDS_AVAILABLE: bool | None = None
+
+
+def observe_process() -> None:
+    """Refresh the process self-metrics (stdlib only: /proc, resource,
+    gc, threading) — the scheduler's own health next to the fleet's."""
+    import gc as _gc
+    import threading as _threading
+    global _PROC_FDS_AVAILABLE
+
+    with _SCRAPE_LOCK:
+        rss = _rss_bytes()
+        if rss is not None:
+            PROCESS_RSS.set(rss)
+        if _PROC_FDS_AVAILABLE is not False:
+            try:
+                PROCESS_FDS.set(len(os.listdir("/proc/self/fd")))
+                _PROC_FDS_AVAILABLE = True
+            except OSError:
+                # No /proc on this platform: the fd gauge simply never
+                # reports. A permanent platform fact — remembered, not
+                # re-counted as a lost sample per scrape.
+                if _PROC_FDS_AVAILABLE is None:
+                    safe_inc(TELEMETRY_ERRORS)
+                _PROC_FDS_AVAILABLE = False
+        PROCESS_THREADS.set(_threading.active_count())
+        for gen, tracked in enumerate(_gc.get_count()):
+            GC_TRACKED.labels(generation=str(gen)).set(tracked)
+        for gen, stats in enumerate(_gc.get_stats()):
+            GC_COLLECTIONS.labels(generation=str(gen)).set(
+                stats.get("collections", 0))
+
+
 def scrape(cache, gang_planner=None, leader=None, demand=None,
            workqueue=None, quota=None, defrag=None) -> bytes:
     """Atomic observe+render for the /metrics handler, timed and
@@ -497,6 +708,8 @@ def scrape(cache, gang_planner=None, leader=None, demand=None,
         with _SCRAPE_LOCK:
             observe_cache(cache)
             observe_slo()
+            observe_profiling()
+            observe_process()
             if quota is not None:
                 observe_quota(quota)
             if demand is not None:

@@ -90,6 +90,17 @@ _BLOCKED_LEAVES = {
     ("join", "threading.py"),
     ("get", "queue.py"),
     ("put", "queue.py"),
+    # concurrent.futures workers park in _queue.SimpleQueue.get — a C
+    # call with no Python frame, leaving the executor loop itself as
+    # the visible leaf. A _worker LEAF is always that park: while it
+    # runs a task, the task's frames sit on top.
+    ("_worker", "thread.py"),
+    # I/O parks: a serving thread waiting for its next request bytes
+    # and the accept loop waiting in select are idle capacity, not
+    # work — without these, every keep-alive handler thread shows up
+    # as busy in the continuous profiler's 'other' bucket.
+    ("readinto", "socket.py"),
+    ("select", "selectors.py"),
 }
 
 
@@ -282,6 +293,11 @@ def index(prefix: str = "/debug/pprof") -> str:
         f"  {prefix}/heap[?stop=1]             live-allocation snapshot "
         "(stop=1 disables tracing)\n"
         f"  {prefix}/goroutine                 all-threads stack dump\n"
+        "  /debug/profile/continuous[?window=S]  the ALWAYS-ON "
+        "profiler's rolling window, verb-rooted collapsed stacks "
+        "(docs/perf.md)\n"
+        "  /debug/hotspots[?top=N&window=S]   top self-time frames per "
+        "verb + the exact wall/cpu/lock/apiserver verb cost ledger\n"
         "  /debug/flight[?n=K]                decision flight recorder "
         "(last K placement decisions)\n"
         "  /debug/trace/<ns>/<pod>            one pod's latest decision "

@@ -26,6 +26,12 @@ Routes:
   (docs/defrag.md)
 * ``GET  /debug/slo``       — SLO objectives: error-budget remaining,
   burn rates per window, journey aggregates (docs/slo.md)
+* ``GET  /debug/profile/continuous`` — the always-on profiler's rolling
+  window as verb-rooted collapsed stacks (speedscope/flamegraph input;
+  ``?window=`` narrows; docs/perf.md)
+* ``GET  /debug/hotspots``  — top-N self-time frames per verb with
+  share-of-verb-time, joined with the exact per-verb
+  wall/CPU/lock-wait/apiserver cost ledger (``?top=``, ``?window=``)
 * ``GET  /debug/journey/<ns>/<pod>`` — the pod's journey: creation to
   bound, every attempt's trace-id, queue-wait vs in-verb split
 
@@ -61,6 +67,18 @@ from tpushare.utils import pod as podutils
 log = logging.getLogger(__name__)
 
 DEFAULT_PREFIX = "/tpushare-scheduler"
+
+
+def _server_timing(handler_ms: float) -> dict:
+    """RFC-8941 ``Server-Timing`` header for the scheduling verbs: the
+    HANDLER's own duration, excluding request framing and the caller's
+    side of the wire. Production callers can log it next to their
+    observed RTT to split 'slow extender' from 'slow network'; the
+    scale bench gates on it for exactly that reason (at 1k nodes the
+    in-process harness client shares the GIL with the extender's
+    background threads, so its wire clock charges the extender for
+    harness scheduling noise — docs/perf.md)."""
+    return {"Server-Timing": f"handler;dur={handler_ms:.3f}"}
 
 
 def _traced_pod(pod) -> bool:
@@ -120,6 +138,12 @@ class _Handler(BaseHTTPRequestHandler):
     # Webhook latency sits on the scheduler's critical path: never let
     # Nagle hold a small JSON response hostage to a delayed ACK.
     disable_nagle_algorithm = True
+    # The stdlib default (wbufsize=0) issues one SYSCALL per response
+    # write — status line, every header, body each pay their own
+    # send(2). Buffered, the whole response coalesces into one segment
+    # (handle_one_request flushes); at 1k-node webhook rates the
+    # per-verb syscall train was measurable (docs/perf.md).
+    wbufsize = 64 * 1024
     server: ExtenderHTTPServer
 
     _date_cache: tuple[float, str] = (0.0, "")
@@ -150,7 +174,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send_json(self, doc: dict, status: int = 200,
                    extra_headers: dict | None = None) -> None:
-        body = json.dumps(doc).encode()
+        # Compact separators: a 1k-candidate filter/prioritize response
+        # is kilobytes of ", " otherwise — bytes both sides re-parse.
+        body = json.dumps(doc, separators=(",", ":")).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -215,6 +241,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_text(sampler(seconds, hz).encode(), ctype=ctype)
         except pprof.ProfileBusyError as e:
             self._send_json({"Error": str(e)}, 409)
+
+    def _parse_window(self) -> tuple[bool, float | None]:
+        """``?window=`` seconds for the continuous-profile surfaces:
+        (True, seconds-or-None) — None meaning the profiler's full
+        window; (False, None) after sending the 400 for a malformed
+        value."""
+        raw = self._query().get("window", "")
+        if not raw:
+            return True, None
+        try:
+            window = float(raw)
+        except ValueError:
+            self._send_json({"Error": "window must be numeric"}, 400)
+            return False, None
+        return True, min(max(window, 1.0), 3600.0)
 
     # -- verbs -------------------------------------------------------------
     def _query(self) -> dict[str, str]:
@@ -299,6 +340,36 @@ class _Handler(BaseHTTPRequestHandler):
                                   "closed journeys)"}, 404)
                 else:
                     self._send_json(doc)
+            elif path == "/debug/profile/continuous":
+                from tpushare import profiling
+                if not profiling.running():
+                    self._send_json(
+                        {"Error": "continuous profiler is not running "
+                                  "(TPUSHARE_PROFILE=off, or the "
+                                  "process never armed it)"}, 404)
+                    return
+                ok, window = self._parse_window()
+                if ok:
+                    self._send_text(profiling.profiler()
+                                    .collapsed(window_s=window).encode())
+            elif path == "/debug/hotspots":
+                from tpushare import profiling
+                if not profiling.running():
+                    self._send_json(
+                        {"Error": "continuous profiler is not running "
+                                  "(TPUSHARE_PROFILE=off, or the "
+                                  "process never armed it)"}, 404)
+                    return
+                try:
+                    top = int(self._query().get("top", "5"))
+                except ValueError:
+                    self._send_json({"Error": "top must be an integer"},
+                                    400)
+                    return
+                ok, window = self._parse_window()
+                if ok:
+                    self._send_json(profiling.hotspots_report(
+                        top=min(max(top, 1), 50), window_s=window))
             elif path in ("/debug/threads", "/debug/pprof/goroutine"):
                 self._send_text(pprof.thread_dump().encode())
             elif path == "/debug/pprof":
@@ -350,6 +421,7 @@ class _Handler(BaseHTTPRequestHandler):
                                     args.pod.name, args.pod.uid,
                                     enabled=_traced_pod(args.pod)) as dec:
                     result = self.server.predicate.handle(args)
+                handler_ms = (time.perf_counter() - t0) * 1e3
                 if dec is not None:
                     # The per-verb half of the SLO story: one filter
                     # observation for the filter-latency objective ...
@@ -370,7 +442,8 @@ class _Handler(BaseHTTPRequestHandler):
                     # not — first filter wins the race, per docs/slo.md).
                     slo.note_decision(args.pod.namespace, args.pod.name,
                                       args.pod.uid, dec, pod=args.pod)
-                self._send_json(result.to_json())
+                self._send_json(result.to_json(),
+                                extra_headers=_server_timing(handler_ms))
             elif path == f"{prefix}/prioritize":
                 doc = self._read_json()
                 if doc is None:
@@ -380,13 +453,16 @@ class _Handler(BaseHTTPRequestHandler):
                                     404)
                     return
                 args = ExtenderArgs.from_json(doc)
+                t0 = time.perf_counter()
                 with metrics.PRIORITIZE_LATENCY.time(), \
                         trace.phase("prioritize", args.pod.namespace,
                                     args.pod.name, args.pod.uid,
                                     enabled=_traced_pod(args.pod)):
                     entries = self.server.prioritize.handle(args)
+                handler_ms = (time.perf_counter() - t0) * 1e3
                 # HostPriorityList is a bare JSON array on the wire.
-                self._send_json(host_priority_list_to_json(entries))
+                self._send_json(host_priority_list_to_json(entries),
+                                extra_headers=_server_timing(handler_ms))
             elif path == f"{prefix}/preempt":
                 doc = self._read_json()
                 if doc is None:
@@ -431,11 +507,13 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"Error": "not the leader"}, 503,
                                     extra_headers={"Retry-After": "1"})
                     return
+                t0 = time.perf_counter()
                 with metrics.BIND_LATENCY.time(), \
                         trace.phase("bind", args_parsed.pod_namespace,
                                     args_parsed.pod_name,
                                     args_parsed.pod_uid) as dec:
                     result = self.server.binder.handle(args_parsed)
+                handler_ms = (time.perf_counter() - t0) * 1e3
                 if result.error and not result.pending:
                     # GangPending is an expected hold (scheduler retries
                     # until quorum), not a failure — alerting on it would
@@ -463,7 +541,9 @@ class _Handler(BaseHTTPRequestHandler):
                                   open_new=False)
                 # Reference returns HTTP 500 when bind fails
                 # (routes.go:139-143) so the scheduler retries.
-                self._send_json(result.to_json(), 500 if result.error else 200)
+                self._send_json(result.to_json(),
+                                500 if result.error else 200,
+                                extra_headers=_server_timing(handler_ms))
             else:
                 self._send_json({"Error": f"no route for {path}"}, 404)
         except Exception as e:  # pragma: no cover - defensive

@@ -39,7 +39,7 @@ from typing import Any
 from tpushare import trace
 from tpushare.api.extender import ExtenderArgs, HostPriority
 from tpushare.api.objects import Pod
-from tpushare.cache.nodeinfo import NodeInfo
+from tpushare.cache.nodeinfo import MEMO_CAP, NodeInfo
 from tpushare.cache.cache import SchedulerCache
 from tpushare.utils import const
 from tpushare.utils import node as nodeutils
@@ -103,13 +103,18 @@ class Prioritize:
     # Per-node scoring
     # ------------------------------------------------------------------ #
 
-    def _score_hbm(self, info: NodeInfo, req: int, gang_nodes: set[str],
-                   policy: str) -> int:
-        avail = info.get_available_hbm()
-        fits = [(avail[i], info.chips[i].total_hbm)
-                for i in avail if avail[i] >= req]
+    @staticmethod
+    def _score_hbm_avail(avail: tuple[tuple[int, int], ...], req: int,
+                         policy: str) -> int | None:
+        """The HBM fit score from a per-chip ``(free, cap)`` view — ONE
+        home for the math, fed either by a live ledger walk
+        (:meth:`_score_hbm`) or by the admission summary (the fast
+        path), so the two can never disagree. ``None`` means no chip
+        fits at all (distinct from a fitting-but-zero score, which is
+        still eligible for the gang-consolidation bonus)."""
+        fits = [(f, c) for f, c in avail if f >= req]
         if not fits:
-            return 0
+            return None
         if policy == "binpack":
             # Representative chip = the one the node-local picker
             # (NodeInfo.pick_chips) will take: the tightest fit.
@@ -132,21 +137,32 @@ class Prioritize:
             # empty input and 500 the verb — filter them and score 0,
             # mirroring the binpack branch's cap==0 guard.
             nz_fits = [(f, c) for f, c in fits if c]
-            nz_caps = [(avail[i], info.chips[i].total_hbm)
-                       for i in avail if info.chips[i].total_hbm]
+            nz_caps = [(f, c) for f, c in avail if c]
             if not nz_fits or not nz_caps:
-                return 0
+                return None
             best = max((f - req) / c for f, c in nz_fits)
             emptiness = statistics.fmean(f / c for f, c in nz_caps)
             score = int(MAX_SCORE * (0.8 * best + 0.2 * emptiness))
+        return score
+
+    def _score_hbm(self, info: NodeInfo, req: int, gang_nodes: set[str],
+                   policy: str) -> int:
+        avail = info.get_available_hbm()
+        score = self._score_hbm_avail(
+            tuple((avail[i], info.chips[i].total_hbm)
+                  for i in avail), req, policy)
+        if score is None:
+            return 0
         if gang_nodes and info.name in gang_nodes and score < MAX_SCORE:
             score += 1  # consolidate gang slices onto fewer hosts
         return max(0, min(MAX_SCORE, score))
 
     def _score_chips(self, info: NodeInfo, req: int,
                      member_slices: dict | None,
-                     policy: str) -> int:
-        free = info.get_free_chips()
+                     policy: str,
+                     free: list[int] | None = None) -> int:
+        if free is None:
+            free = info.get_free_chips()
         if len(free) < req or info.chip_count == 0:
             return 0
         leftover = len(free) - req
@@ -263,10 +279,50 @@ class Prioritize:
                 member_slices = self._member_slices(gang_nodes)
 
         policy = self._policy_for(pod)
-        out = [HostPriority(host=n, score=self._score_one(
-                   n, req_chips, req_hbm, gang_nodes, member_slices,
-                   policy=policy))
-               for n in names]
+        if gang_nodes or member_slices:
+            # Gang member: the consolidation / slice-affinity bonuses
+            # are per-node facts the summary cannot carry — full path.
+            out = [HostPriority(host=n, score=self._score_one(
+                       n, req_chips, req_hbm, gang_nodes, member_slices,
+                       policy=policy))
+                   for n in names]
+        else:
+            # Fast path: score from the admission summaries (lock-free
+            # tuple reads), memoized PER NODE per request shape against
+            # the summary object's identity — in steady state each
+            # node's score recomputes only when its own ledger changed
+            # (docs/perf.md).
+            table = self.cache.node_table()
+            shape = (req_chips, req_hbm, policy)
+            out = []
+            for n in names:
+                info = table.get(n)
+                if info is None:
+                    out.append(HostPriority(host=n, score=self._score_one(
+                        n, req_chips, req_hbm, gang_nodes, member_slices,
+                        policy=policy)))
+                    continue
+                s = info._summary  # inline fast path, see predicate.py
+                if s is None:
+                    s = info.summary()
+                ent = info.score_memo.get(shape)
+                if ent is None or ent[0] is not s:
+                    if req_chips > 0:
+                        score = self._score_chips(
+                            info, req_chips, None, policy=policy,
+                            free=list(s.free_chips))
+                    elif req_hbm <= 0:
+                        score = 0
+                    else:
+                        base = self._score_hbm_avail(s.avail, req_hbm,
+                                                     policy)
+                        score = (0 if base is None
+                                 else max(0, min(MAX_SCORE, base)))
+                    memo = info.score_memo
+                    if len(memo) >= MEMO_CAP:
+                        memo.clear()
+                    ent = memo[shape] = (s, score)
+                out.append(HostPriority(host=n, score=ent[1]))
         if self.quota is not None:
             adjust = self.quota.score_adjust(pod)
             if adjust:
@@ -279,8 +335,15 @@ class Prioritize:
                        if e.score > 0 else e
                        for e in out]
                 trace.note("quotaFairShare", adjust)
-        trace.note("scores", {e.host: e.score for e in out})
+        # Bounded like the filter's rejection note: a 1k-entry score map
+        # per decision would pin megabytes across the flight ring.
+        from tpushare.scheduler.predicate import TRACE_NOTE_CAP
+        trace.note("scores", {e.host: e.score
+                              for e in out[:TRACE_NOTE_CAP]})
+        if len(out) > TRACE_NOTE_CAP:
+            trace.note("scoresTruncated", len(out) - TRACE_NOTE_CAP)
         trace.note("policy", policy)
-        log.debug("prioritize pod %s: %s", pod.key(),
-                  {e.host: e.score for e in out})
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug("prioritize pod %s: %s", pod.key(),
+                      {e.host: e.score for e in out})
         return out

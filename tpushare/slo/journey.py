@@ -131,6 +131,11 @@ class Journey:
         self.closed_at = closed_at
 
     def to_json(self, now: float) -> dict:
+        # Round once and derive the split from the ROUNDED halves:
+        # rounding all three independently can break the published
+        # e2e = queueWait + inVerb identity by 1e-6.
+        e2e = round(self.e2e_seconds(now), 6)
+        in_verb = round(self.in_verb_seconds(), 6)
         doc: dict[str, Any] = {
             "namespace": self.namespace,
             "name": self.name,
@@ -139,9 +144,9 @@ class Journey:
             "openedAt": _iso(self.opened_at),
             "source": self.source,
             "outcome": self.outcome,
-            "e2eSeconds": round(self.e2e_seconds(now), 6),
-            "inVerbSeconds": round(self.in_verb_seconds(), 6),
-            "queueWaitSeconds": round(self.queue_wait_seconds(now), 6),
+            "e2eSeconds": e2e,
+            "inVerbSeconds": in_verb,
+            "queueWaitSeconds": max(round(e2e - in_verb, 6), 0.0),
             "attemptsTotal": max(self.attempts_total,
                                  1 if self.source == "reconstructed"
                                  else self.attempts_total),

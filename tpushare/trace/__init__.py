@@ -24,14 +24,16 @@ from typing import Any
 
 from tpushare.trace.recorder import (DEFAULT_CAPACITY, Decision,
                                      DropCounter, FlightRecorder, Span,
-                                     new_trace_id)
+                                     add_phase_hook, new_trace_id,
+                                     remove_phase_hook, set_phase_probe)
 from tpushare.utils import locks
 
 __all__ = [
     "DEFAULT_CAPACITY", "Decision", "DropCounter", "FlightRecorder",
-    "Span", "complete", "current", "current_trace_id", "flight",
-    "get_trace", "new_trace_id", "note", "note_api_call", "phase",
-    "recorder", "reset", "span",
+    "Span", "add_phase_hook", "complete", "current", "current_trace_id",
+    "flight", "get_trace", "new_trace_id", "note", "note_api_call",
+    "phase", "recorder", "remove_phase_hook", "reset",
+    "set_phase_probe", "span",
 ]
 
 _recorder = FlightRecorder()

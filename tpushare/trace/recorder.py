@@ -77,20 +77,64 @@ def new_trace_id() -> str:
     return uuid.uuid4().hex[:12]
 
 
+#: Phase-exit sinks beyond the ring: ``hook(verb, span)`` runs as each
+#: verb phase closes (the per-verb cost ledger in
+#: :mod:`tpushare.profiling` registers one). Appended-at-import then
+#: read-only, like :data:`tpushare.utils.locks._contention_hooks` —
+#: iteration needs no lock; failures are drop-counted, never raised
+#: into the scheduling path.
+_phase_hooks: list[Any] = []
+
+
+def add_phase_hook(hook: Any) -> None:
+    """Register ``hook(verb: str, span: Span)``, invoked when a verb
+    phase closes (span timings final)."""
+    if hook not in _phase_hooks:
+        _phase_hooks.append(hook)
+
+
+def remove_phase_hook(hook: Any) -> None:
+    if hook in _phase_hooks:
+        _phase_hooks.remove(hook)
+
+
+#: Optional phase probe: ``probe(verb) -> context manager | None``,
+#: consulted as each verb phase opens. The duty-cycled decision
+#: profiler (:mod:`tpushare.profiling.decisions`) registers here to
+#: wrap its elected decisions in cProfile; None (the common case) costs
+#: one call. Single slot: two deterministic profilers on one thread
+#: would fight over sys.setprofile.
+_phase_probe: Any = None
+
+
+def set_phase_probe(probe: Any) -> None:
+    global _phase_probe
+    _phase_probe = probe
+
+
 class Span:
     """One timed phase of a decision. ``lock_wait_s`` and ``api_s`` are
     attributed by the contention hook / the k8s client while this span
-    is the innermost open span on its thread."""
+    is the innermost open span on its thread; ``cpu_s`` is the opening
+    thread's CPU time across the span (``time.thread_time_ns``), so
+    ``seconds - cpu_s`` is the span's involuntary share — GIL waits,
+    lock parks, apiserver RTTs — the wall/CPU split the per-verb cost
+    ledger (:mod:`tpushare.profiling`) aggregates."""
 
     __slots__ = ("phase", "depth", "start_offset_s", "seconds",
-                 "lock_wait_s", "api_s", "api_calls", "attrs", "_t0")
+                 "lock_wait_s", "api_s", "api_calls", "attrs", "_t0",
+                 "cpu_s", "_cpu0")
 
     def __init__(self, phase: str, depth: int, start_offset_s: float) -> None:
         self.phase = phase
         self.depth = depth
         self.start_offset_s = start_offset_s
         self._t0 = time.perf_counter()
+        # Spans open and close on one thread (context-manager API), so
+        # the thread-CPU delta is well-defined.
+        self._cpu0 = time.thread_time_ns()
         self.seconds = 0.0
+        self.cpu_s = 0.0
         self.lock_wait_s = 0.0
         self.api_s = 0.0
         self.api_calls = 0
@@ -98,6 +142,7 @@ class Span:
 
     def close(self) -> None:
         self.seconds = max(time.perf_counter() - self._t0, 0.0)
+        self.cpu_s = max(time.thread_time_ns() - self._cpu0, 0) / 1e9
 
     def to_json(self) -> dict:
         doc: dict[str, Any] = {
@@ -105,6 +150,7 @@ class Span:
             "depth": self.depth,
             "startOffsetSeconds": round(self.start_offset_s, 6),
             "seconds": round(self.seconds, 6),
+            "cpuSeconds": round(self.cpu_s, 6),
             "lockWaitSeconds": round(self.lock_wait_s, 6),
             "apiSeconds": round(self.api_s, 6),
             "apiCalls": self.api_calls,
@@ -209,6 +255,15 @@ class FlightRecorder:
         self._ring: deque[Decision] = deque(maxlen=capacity)
         self._open: dict[tuple[str, str], Decision] = {}
         self._tls = threading.local()
+        #: tid -> verb currently open on that thread. The continuous
+        #: profiler's attribution source: the sampler joins each
+        #: sampled stack against this map to charge the sample to the
+        #: verb running on that thread. Each thread writes ONLY its own
+        #: key (single GIL-atomic dict ops), so no lock — the sampler
+        #: reads racily by design: a sample landing exactly on a phase
+        #: boundary may attribute to either side, which a statistical
+        #: profile absorbs.
+        self._active_verbs: dict[int, str] = {}
         self.drops = DropCounter()
 
     # -- current-decision plumbing --------------------------------------- #
@@ -219,6 +274,13 @@ class FlightRecorder:
     def current_trace_id(self) -> str:
         dec = self.current()
         return dec.trace_id if dec is not None else ""
+
+    def active_verb_map(self) -> dict[int, str]:
+        """The live tid → open-verb map (see ``_active_verbs``). The
+        RETURNED OBJECT IS THE LIVE DICT — treat it as read-only; the
+        sampler reads it per pass without copying (a copy per sample at
+        profiling rates would be the profiler taxing itself)."""
+        return self._active_verbs
 
     # -- phases ----------------------------------------------------------- #
 
@@ -236,12 +298,41 @@ class FlightRecorder:
         dec = self._lookup_or_begin(namespace, name, uid)
         prev = getattr(self._tls, "decision", None)
         self._tls.decision = dec
+        tid = threading.get_ident()
+        prev_verb = self._active_verbs.get(tid)
+        self._active_verbs[tid] = verb
         sp = dec.open_span(verb)
+        probe_ctx = None
+        if _phase_probe is not None:
+            try:
+                probe_ctx = _phase_probe(verb)
+                if probe_ctx is not None:
+                    probe_ctx.__enter__()
+            except Exception:  # noqa: BLE001 - probes are telemetry
+                probe_ctx = None
+                self.drops.inc()
         try:
             yield dec
         finally:
             dec.close_span(sp)
             self._tls.decision = prev
+            if prev_verb is None:
+                self._active_verbs.pop(tid, None)
+            else:
+                self._active_verbs[tid] = prev_verb
+            # Probe exit AFTER the span closes: the fold-in cost of a
+            # profiled decision must not pollute the verb's own ledger
+            # timings.
+            if probe_ctx is not None:
+                try:
+                    probe_ctx.__exit__(None, None, None)
+                except Exception:  # noqa: BLE001 - probes are telemetry
+                    self.drops.inc()
+            for hook in _phase_hooks:
+                try:
+                    hook(verb, sp)
+                except Exception:  # noqa: BLE001 - hooks are telemetry
+                    self.drops.inc()
 
     def _lookup_or_begin(self, namespace: str, name: str,
                          uid: str) -> Decision:
@@ -383,4 +474,5 @@ class FlightRecorder:
         with self._lock:
             self._ring.clear()
             self._open.clear()
+            self._active_verbs.clear()
             self.drops = DropCounter()

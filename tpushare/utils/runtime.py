@@ -1,0 +1,66 @@
+"""Process runtime tuning: the GC posture for a fleet-scale ledger.
+
+Found by the continuous profiler's bench story (docs/perf.md): at 1024
+nodes the ledger holds ~10^6 long-lived objects, and CPython's default
+GC thresholds (700, 10, 10) schedule full gen-2 collections often
+enough that their 10–20 ms stop-the-world pauses WERE the webhook p99 —
+no verb frame in the flamegraph, just a fat latency tail.
+
+Two standard levers, both stdlib:
+
+* stretch the gen-1/gen-2 MULTIPLIERS so full collections run ~35×
+  less often (the verbs allocate heavily but acyclically — refcounting
+  reclaims them; the cyclic GC's job here is rare cycle cleanup, not
+  throughput). The gen-0 threshold stays near the interpreter default:
+  gen-0 pass cost scales with the young-object count, so raising it
+  only converts frequent ~0.1 ms pauses into rare multi-ms ones that
+  land straight in the webhook p99 (measured both ways);
+* ``gc.freeze()`` the warm, long-lived heap (ledgers, informer stores,
+  module graph) into the permanent generation so the collections that
+  do run stop walking it.
+
+Called from the extender entrypoint (``cmd/main.py``, gated by
+``TPUSHARE_GC_TUNE``) and by bench.py's ``--scale`` fleet warm-up.
+Deliberately NOT called by the test/tool harness (``serve_stack``):
+tests keep the interpreter's defaults.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+
+#: Near-default generation-0 threshold: gen-0 passes stay CHEAP (their
+#: cost scales with the young-object count, so a big gen-0 threshold
+#: trades frequent ~0.1 ms pauses for rare multi-ms ones that land
+#: straight in the webhook p99 — measured, docs/perf.md). The levers
+#: that matter are the gen-1/gen-2 MULTIPLIERS (full collections every
+#: ~2.5M allocations instead of ~70k) and the freeze.
+DEFAULT_GEN0 = 1_000
+DEFAULT_GEN1 = 50
+DEFAULT_GEN2 = 50
+
+
+def tune_gc(gen0: int = DEFAULT_GEN0, gen1: int = DEFAULT_GEN1,
+            gen2: int = DEFAULT_GEN2, freeze: bool = False) -> None:
+    """Apply the fleet-scale GC posture. ``freeze=True`` additionally
+    collects once and moves every CURRENTLY live object into the
+    permanent generation — call it after the warm start (cache built,
+    informer synced) so the steady-state heap stops being rescanned."""
+    gc.set_threshold(max(gen0, 1), max(gen1, 1), max(gen2, 1))
+    if freeze:
+        gc.collect()
+        gc.freeze()
+
+
+def tune_gc_from_env() -> bool:
+    """Entrypoint wrapper: ``TPUSHARE_GC_TUNE`` (default on; ``off``/
+    ``0`` keeps interpreter defaults), ``TPUSHARE_GC_GEN0`` overrides
+    the gen-0 threshold. Returns whether tuning was applied."""
+    mode = os.environ.get("TPUSHARE_GC_TUNE", "on").lower()
+    if mode in ("off", "0", "false", "no"):
+        return False
+    gen0_raw = os.environ.get("TPUSHARE_GC_GEN0", "")
+    gen0 = int(gen0_raw) if gen0_raw.isdigit() else DEFAULT_GEN0
+    tune_gc(gen0=gen0)
+    return True
