@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-workload all
+.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-workload bench-router all
 
 # CPU test suite (virtual 8-device mesh; kernels in interpreter mode).
 test:
@@ -60,5 +60,9 @@ bench-scale:
 # regression gates — REQUIRES real TPU hardware (chipcheck's perf twin).
 bench-workload:
 	python bench_workload.py --gate
+
+# Serving front-door traffic replay (deterministic, CPU-only).
+bench-router:
+	python bench_router.py --gate
 
 all: native test

@@ -79,6 +79,14 @@ MFU_FLOOR = 0.30
 #: ~5%; the old 0.55 floor predated the sweep).
 MFU_LARGE_FLOOR = 0.62
 
+#: Continuous-admission overhead ceiling: the slot server's chunked
+#: decode at mixed per-slot positions vs static-batch decode at the
+#: SAME cache length (bench_decode_continuous's honest baseline), in
+#: percent. BENCH_WORKLOAD_r05 measured 22.1% with the per-step
+#: scatter path; the fused chunk-ring step (serving._fused_chunk_step)
+#: is gated to hold it at or under this.
+ADMISSION_OVERHEAD_MAX_PCT = 10.0
+
 
 def _require_tpu(allow_cpu: bool) -> str:
     backend = jax.default_backend()
@@ -354,7 +362,15 @@ def bench_decode_continuous(allow_cpu: bool) -> dict:
     a separate prefill — the mid-flight path), then chunked decode
     with every slot at a DIFFERENT position. The per-slot-position
     decode is the capability ``generate``'s static batch lacks; this
-    measures what it costs."""
+    measures what it costs — ``admission_overhead_pct`` is a
+    first-class gated output (<= ADMISSION_OVERHEAD_MAX_PCT on TPU).
+
+    Admission accounting is explicit, not hidden in warmup: every
+    admission goes through ``admit_bucketed`` with its wall clock and
+    jit-cache outcome recorded per bucket (``admissions`` in the
+    result). The first admission per bucket pays the compile; the
+    bucketing win is the steady-state rows showing cache HITS — visible
+    in the artifact, not inferred."""
     from tpushare.workload import model as M
     from tpushare.workload import serving as S
 
@@ -369,10 +385,42 @@ def bench_decode_continuous(allow_cpu: bool) -> dict:
     key = jax.random.PRNGKey(0)
     params = M.init_params(key, cfg)
     state = S.init_server_state(cfg, slots, max_len)
+    S.reset_admission_stats()
+    admit_wall_ms: dict[int, list] = {}
     for i, lp in enumerate(prompt_lens):
         prompt = jax.random.randint(jax.random.fold_in(key, i), (lp,),
                                     0, cfg.vocab_size)
-        state = S.admit(params, state, prompt, jnp.int32(i))
+        bucket = S.bucket_len(lp, max_len=max_len)
+        t0 = time.perf_counter()
+        state = S.admit_bucketed(params, state, prompt, jnp.int32(i))
+        float(state["pos"][i])  # readback: the only real sync (tunnel)
+        wall = max(time.perf_counter() - t0 - _RTT_S, 0.0)
+        admit_wall_ms.setdefault(bucket, []).append(
+            round(wall * 1e3, 2))
+    # Steady-state admission cost: re-admit the same mix into recycled
+    # slots — every call a jit cache HIT now (the counter proves it).
+    for i, lp in enumerate(prompt_lens):
+        prompt = jax.random.randint(jax.random.fold_in(key, 100 + i),
+                                    (lp,), 0, cfg.vocab_size)
+        bucket = S.bucket_len(lp, max_len=max_len)
+        state = S.release(state, i)
+        t0 = time.perf_counter()
+        state = S.admit_bucketed(params, state, prompt, jnp.int32(i))
+        float(state["pos"][i])
+        wall = max(time.perf_counter() - t0 - _RTT_S, 0.0)
+        admit_wall_ms.setdefault(bucket, []).append(
+            round(wall * 1e3, 2))
+    admissions = {}
+    for bucket, entry in S.admission_stats().items():
+        walls = admit_wall_ms.get(bucket, [])
+        admissions[str(bucket)] = dict(
+            entry,
+            # First call per bucket holds the compile; the rest are
+            # the steady state the router actually pays.
+            first_ms=walls[0] if walls else None,
+            steady_ms=(round(statistics.median(walls[1:]), 2)
+                       if len(walls) > 1 else None),
+        )
 
     @jax.jit
     def run(params, state):
@@ -413,6 +461,35 @@ def bench_decode_continuous(allow_cpu: bool) -> dict:
     float(run_static(params, base_cache, logits0))
     ts = _time_scalar_fn(run_static, params, base_cache, logits0,
                          iters=20, reps=3)
+
+    # Chunked prefill: the co-tenant-visible admission pause. Whole-
+    # prompt admit stalls the batch for the full prefill; the chunked
+    # path bounds the pause at one piece. Both are timed at the
+    # longest prompt in the mix.
+    lp = prompt_lens[-1]
+    piece = min(64, lp)
+    prompt = jax.random.randint(jax.random.fold_in(key, 999), (lp,), 0,
+                                cfg.vocab_size)
+    state = S.release(state, 0)
+    st_warm = S.admit(params, state, prompt, jnp.int32(0))
+    float(st_warm["pos"][0])  # warm the whole-prompt compile: both
+    # sides of the comparison are steady-state stalls
+    t0 = time.perf_counter()
+    st2 = S.admit(params, state, prompt, jnp.int32(0))
+    float(st2["pos"][0])
+    whole_ms = max(time.perf_counter() - t0 - _RTT_S, 0.0) * 1e3
+    state = S.release(state, 0)
+    st3 = S.admit_chunked(params, state, prompt, jnp.int32(0),
+                          chunk=piece)  # warm the piece compile
+    float(st3["pos"][0])
+    state = S.release(state, 0)
+    t0 = time.perf_counter()
+    st4 = S.admit_chunked(params, state, prompt, jnp.int32(0),
+                          chunk=piece)
+    float(st4["pos"][0])
+    chunked_ms = max(time.perf_counter() - t0 - _RTT_S, 0.0) * 1e3
+    n_pieces = -(-lp // piece)
+
     return {
         "slots": slots, "chunk": chunk,
         "prompt_lens": prompt_lens, "max_len": max_len,
@@ -421,6 +498,14 @@ def bench_decode_continuous(allow_cpu: bool) -> dict:
         "per_token_ms": round((t / chunk) * 1e3, 3),
         "static_same_maxlen_tokens_per_s": round(slots * chunk / ts),
         "admission_overhead_pct": round(100.0 * (t - ts) / ts, 1),
+        "admissions": admissions,
+        "chunked_prefill": {
+            "prompt_len": lp, "piece": piece, "pieces": n_pieces,
+            "whole_admit_ms": round(whole_ms, 2),
+            "chunked_admit_ms": round(chunked_ms, 2),
+            # The pause a co-resident slot sees per interleave point.
+            "max_pause_ms": round(chunked_ms / n_pieces, 2),
+        },
     }
 
 
@@ -492,6 +577,7 @@ def main() -> None:
     flash_mfu = train["flash"]["mfu"]
     large_mfu = large["flash"]["mfu"]
     long_l = attn.get("32768", {})
+    overhead = continuous["admission_overhead_pct"]
     gates = {
         "flash_beats_xla_8k": bool(
             attn.get("8192", {}).get("speedup") is not None
@@ -501,9 +587,16 @@ def main() -> None:
                           and flash_mfu >= MFU_FLOOR),
         "mfu_large_floor": bool(large_mfu is None  # CPU smoke: no claim
                                 or large_mfu >= MFU_LARGE_FLOOR),
+        # CPU smoke: no claim — tiny shapes are dispatch-dominated and
+        # say nothing about the TPU's HBM-bound decode step.
+        "continuous_admission_overhead": bool(
+            args.allow_cpu or overhead <= ADMISSION_OVERHEAD_MAX_PCT),
     }
     doc = {
         "metric": "workload_perf",
+        # First-class: the continuous-batching tax vs static decode,
+        # gated at ADMISSION_OVERHEAD_MAX_PCT (ROADMAP item 5).
+        "continuous_admission_overhead_pct": overhead,
         # Headline: the best demonstrated MFU on the chip — the
         # scale-up shape. The flagship (co-tenant-sized) figure stays
         # in train_step for continuity with earlier artifacts.

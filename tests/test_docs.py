@@ -285,3 +285,50 @@ if __name__ == "__main__":
         else:
             print(f"ok   {check.__name__}")
     sys.exit(1 if failures else 0)
+
+
+SERVING_MD = os.path.join(REPO_ROOT, "docs", "serving.md")
+
+
+def test_serving_doc_covers_the_contract():
+    """docs/serving.md is the serving-fast-path + front-door contract:
+    it must keep naming the slot-server mechanisms that closed the
+    admission-overhead gap, the router's policy knobs and surfaces,
+    the benches with their gates, and a runbook."""
+    with open(SERVING_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("_fused_chunk_step", "admit_chunked",
+                   "admit_interleaved", "admit_bucketed",
+                   "PROMPT_BUCKETS", "admission_stats",
+                   "max_batch_for_grant", "admission_overhead_pct",
+                   "continuous_admission_overhead", "22.1%",
+                   "shed_slack", "queue_limit", "scaleout_queue_factor",
+                   "on_scaleout", "DecodeReplica", "QuotaManager",
+                   "/debug/router", "kubectl inspect tpushare serving",
+                   "bench_router.py", "fairness_min",
+                   "shed_isolated_to_surge_tenant", "queues_drain",
+                   "BENCH_ROUTER_r01.json", "Runbook"):
+        assert needle in doc, needle
+    # the headline router series must be named in the serving doc too
+    # (the blanket observability gate covers the full catalogue).
+    for needle in ("tpushare_router_shed_total",
+                   "tpushare_router_scaleout_signals_total",
+                   "tpushare_router_ttft_seconds",
+                   "tpushare_router_fleet_tokens_per_s"):
+        assert needle in doc, needle
+
+
+def test_serving_doc_is_linked():
+    """observability.md (the catalogue), the README, and the user
+    guide must keep pointing at the serving contract."""
+    for path in (OBSERVABILITY_MD,
+                 os.path.join(REPO_ROOT, "README.md"),
+                 os.path.join(REPO_ROOT, "docs", "userguide.md")):
+        with open(path, encoding="utf-8") as f:
+            assert "serving.md" in f.read(), path
+
+
+def test_observability_doc_covers_router_surface():
+    with open(OBSERVABILITY_MD, encoding="utf-8") as f:
+        doc = f.read()
+    assert "/debug/router" in doc

@@ -526,6 +526,52 @@ class TestSimulateDefragScenario:
         assert "migrated" not in report["defrag"]
 
 
+class TestSimulateServing:
+    """scenario `serving:` — the replay's bound decode pods are
+    fronted by the REAL router; traffic replays on a deterministic
+    clock and scale-out binds new decode pods through the real verbs
+    mid-replay (the simulator face of docs/serving.md)."""
+
+    def test_example_serving_surge_sheds_scales_drains(self):
+        import yaml
+
+        import simulate
+
+        scenario = yaml.safe_load(simulate.EXAMPLE_SERVING)
+        report = simulate.simulate(scenario)
+        s = report["serving"]
+        # Shed isolation: the flooder sheds, the in-quota tenant never.
+        assert s["outcomes"]["chat"]["shed"] == 0
+        assert s["outcomes"]["burst"]["shed"] >= 1
+        assert s["snapshot"]["tenants"]["chat"]["shed"] == 0
+        # The scale-out loop ran against the real verbs: signalled,
+        # pod bound, replica registered, and the packing includes it.
+        assert s["scaleOut"]["signals"] >= 1
+        bound = [p for p in s["scaleOut"]["provisioned"] if p["bound"]]
+        assert bound, s["scaleOut"]
+        via = [p for p in report["placements"]
+               if p.get("via") == "router scale-out"]
+        assert len(via) == len(bound)
+        assert len(s["snapshot"]["replicas"]) == \
+            len(s["replicas"]) + len(bound)
+        # Everyone eventually drains; completions cover all admitted.
+        assert s["drainedAtS"] is not None
+        assert s["snapshot"]["queuedTotal"] == 0
+        chat = s["snapshot"]["tenants"]["chat"]
+        assert chat["completed"] == 24
+        assert chat["ttft"]["p99"] is not None
+
+    def test_serving_errors_without_fronted_pods(self):
+        import yaml
+
+        import simulate
+
+        scenario = yaml.safe_load(simulate.EXAMPLE_SERVING)
+        scenario["serving"]["pods"] = "nonesuch"
+        report = simulate.simulate(scenario)
+        assert "no bound pod" in report["serving"]["error"]
+
+
 class TestDefragAdvisor:
     def test_repack_reclaims_whole_chips(self, api):
         """Churn leaves 8-GiB holes across chips; the advisor shows the

@@ -13,8 +13,11 @@ rebalance plan (proposed vs executed vs aborted moves, with trace-ids)
 from ``/debug/defrag`` (docs/defrag.md); the ``hotspots`` subcommand
 renders the continuous profiler's per-verb top frames and exact
 wall/CPU/lock-wait/apiserver cost splits from ``/debug/hotspots``
-(docs/perf.md); ``explain`` heads its span timeline with the pod's
-journey (attempt N of M, cumulative queue-wait).
+(docs/perf.md); the ``serving`` subcommand renders the decode fleet's
+per-tenant queue depth / slot occupancy / shed counts / TTFT
+percentiles from ``/debug/router`` (docs/serving.md); ``explain``
+heads its span timeline with the pod's journey (attempt N of M,
+cumulative queue-wait).
 
 Install as a kubectl plugin by dropping an executable named
 ``kubectl-inspect_tpushare`` on PATH that execs this script, or run it
@@ -452,6 +455,83 @@ def render_defrag(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def fetch_router(endpoint: str) -> dict | None:
+    """The serving front door's snapshot from ``/debug/router``; None
+    when the extender runs without a router wired or with debug routes
+    disabled."""
+    try:
+        with urllib.request.urlopen(f"{endpoint}/debug/router",
+                                    timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def render_serving(doc: dict) -> str:
+    """Per-tenant queue/occupancy/shed/TTFT table + the replica fleet
+    and the scale-out signal state."""
+
+    def pctl(p: dict | None) -> str:
+        if not p or p.get("p50") is None:
+            return "-/-"
+        return f"{p['p50'] * 1e3:.0f}/{p['p99'] * 1e3:.0f}ms"
+
+    fleet = doc.get("fleetSlots", 0)
+    in_use = doc.get("slotsInUse", 0)
+    lines = [
+        f"decode fleet: {len(doc.get('replicas', []))} replica(s), "
+        f"{in_use}/{fleet} slot(s) in use, "
+        f"{doc.get('queuedTotal', 0)} queued, "
+        f"{doc.get('fleetTokensPerS', 0.0):g} tok/s, "
+        f"TTFT p50/p99 {pctl(doc.get('ttft'))}",
+    ]
+    tenants = doc.get("tenants") or {}
+    if tenants:
+        rows = [["TENANT", "REQS", "INFLIGHT", "QUEUED", "SHED",
+                 "COMPLETED", "TTFT p50/p99"]]
+        for name, t in sorted(tenants.items()):
+            rows.append([name, str(t["requests"]), str(t["inflight"]),
+                         str(t["queued"]), str(t["shed"]),
+                         str(t["completed"]), pctl(t.get("ttft"))])
+        widths = [max(len(r[i]) for r in rows)
+                  for i in range(len(rows[0]))]
+        lines.append("")
+        lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                  for r in rows]
+    else:
+        lines.append("no requests routed yet")
+    reps = doc.get("replicas") or []
+    if reps:
+        lines.append("")
+        rows = [["REPLICA", "NODE", "SLOTS", "IN USE", "HBM GiB",
+                 "DECODE tok/s"]]
+        for r in reps:
+            rows.append([r["name"], r.get("node") or "-",
+                         str(r["slots"]), str(r["inUse"]),
+                         f"{r['hbmGiB']:g}", f"{r['decodeTokS']:g}"])
+        widths = [max(len(row[i]) for row in rows)
+                  for i in range(len(rows[0]))]
+        lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
+                  for row in rows]
+    so = doc.get("scaleOut") or {}
+    lines.append("")
+    state = "WANTED" if so.get("wanted") else "quiet"
+    spec = so.get("spec") or {}
+    lines.append(
+        f"scale-out: {state}, {so.get('signals', 0)} signal(s) raised "
+        f"(next replica shape: {spec.get('hbmGiB', '?')} GiB, "
+        f"max_len {spec.get('maxLen', '?')})")
+    lines.append("")
+    lines.append("SHED = requests refused (429): over quota standing on "
+                 "a saturated fleet, or the fleet queue is full. A "
+                 "sustained queue raises the scale-out signal; the "
+                 "scheduler places the decode pod. Policy + runbook: "
+                 "docs/serving.md.")
+    return "\n".join(lines)
+
+
 def fetch_hotspots(endpoint: str, top: int = 5) -> dict | None:
     """The continuous profiler's hotspot view from ``/debug/hotspots``;
     None when the profiler is disarmed (TPUSHARE_PROFILE=off) or debug
@@ -610,7 +690,9 @@ def main(argv: list[str] | None = None) -> int:
                              "'defrag' for the fragmentation index and "
                              "the last rebalance plan; or the literal "
                              "'hotspots' for the continuous profiler's "
-                             "per-verb top frames + cost splits")
+                             "per-verb top frames + cost splits; or the "
+                             "literal 'serving' for the decode fleet's "
+                             "per-tenant queue/shed/TTFT table")
     parser.add_argument("pod", nargs="?", metavar="[ns/]pod",
                         help="with 'explain': the pod whose placement "
                              "decision to explain (namespace defaults "
@@ -673,6 +755,24 @@ def main(argv: list[str] | None = None) -> int:
                   "(DEBUG_ROUTES=0)", file=sys.stderr)
             return 1
         print(render_defrag(doc))
+        return 0
+    if args.node == "serving":
+        if args.pod:
+            print(f"unexpected argument {args.pod!r} after 'serving'",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = fetch_router(args.endpoint)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        if doc is None:
+            print("serving view unavailable — the extender runs without "
+                  "a serving router, or debug routes are disabled "
+                  "(DEBUG_ROUTES=0)", file=sys.stderr)
+            return 1
+        print(render_serving(doc))
         return 0
     if args.node == "hotspots":
         if args.pod:

@@ -45,6 +45,29 @@ Scenario schema (YAML or JSON)::
         guaranteeChips: 2
         limitChips: 4
       "*": {limitHBM: 256}       # default for unlisted tenants
+    serving:                     # after the replay, front the bound
+      pods: decode               # decode pods (this name prefix) with
+                                 # the REAL router (tpushare/router/)
+                                 # and replay open-loop traffic on a
+                                 # deterministic clock (docs/serving.md)
+      slots_per_replica: 4       # analytic service model per replica
+      decode_tok_s: 1000         # aggregate decode rate (tokens/s)
+      prefill_tok_s: 200000      # serial FIFO prefill rate
+      admission_overhead: 0.1    # prefill tax on co-resident decode
+                                 # (<=0.10 chunked, 0.221 the r05 gap)
+      scale_out: true            # play the scheduler side of the
+                                 # loop: a queue-depth signal binds one
+                                 # more decode pod through the real
+                                 # verbs, mid-replay
+      duration: 8                # seconds of traffic
+      tick: 0.05                 # service-model integration step
+      traffic:                   # open-loop arrival groups
+        - tenant: chat           # quota tenant (shedding standing)
+          requests: 24           # arrivals spread evenly over
+          start: 0               # [start, start+over) sim-seconds
+          over: 8
+          prompt_len: 100        # bucketed like the slot server
+          max_new: 200
     workload:                    # ordered arrival stream
       - count: 8                 # pods in this group      (default 1)
         name: trainer            # names name-0..          (required)
@@ -145,6 +168,40 @@ workload:
     hbm: 6
     annotations: {tpushare.io/scoring: spread}
   - {count: 1, name: ring, chips: 4}
+"""
+
+
+EXAMPLE_SERVING = """\
+# Serving front door over the placed decode fleet: the replay binds
+# two decode pods, fronts them with the router (tpushare/router/), and
+# replays a traffic surge on a deterministic clock — chat stays inside
+# its standing and never sheds, burst floods far past its entitlement
+# and sheds, queues past the threshold raise the scale-out signal, the
+# SCHEDULER binds one more decode pod through the real verbs
+# mid-replay, and the queues drain. The `serving` report section (and
+# the packing's `router scale-out` placement) tells the story.
+fleet:
+  - count: 2
+    prefix: v5e
+    chips: 4
+    hbm_per_chip: 16
+quotas:
+  chat:  {guaranteeHBM: 16, limitHBM: 32}
+  burst: {guaranteeHBM: 16, limitHBM: 32}
+workload:
+  - {count: 2, name: decode, hbm: 8}
+serving:
+  pods: decode
+  slots_per_replica: 4
+  decode_tok_s: 1000
+  prefill_tok_s: 1000000000
+  scale_out: true
+  duration: 8
+  traffic:
+    - {tenant: chat, requests: 24, prompt_len: 100, max_new: 200,
+       over: 8}
+    - {tenant: burst, requests: 60, prompt_len: 100, max_new: 200,
+       start: 2, over: 2}
 """
 
 
@@ -345,6 +402,13 @@ def simulate(scenario: dict) -> dict:
             defrag_report = _run_defrag(
                 api, client, stack, scenario["defrag"],
                 unschedulable, placements, all_nodes)
+        # Serving round (scenario `serving:` key): front the bound
+        # decode pods with the REAL router and replay the traffic
+        # stream — scale-out binds land in the packing below.
+        serving_report = None
+        if scenario.get("serving"):
+            serving_report = _run_serving(
+                api, client, stack, scenario, all_nodes, placements)
         inspect_doc = client.get("/tpushare-scheduler/inspect")
         tenants = (client.get("/debug/quota").get("tenants", [])
                    if quota_cm is not None else [])
@@ -364,7 +428,7 @@ def simulate(scenario: dict) -> dict:
         shutdown_stack(stack, server)
     report = _report(inspect_doc, placements, held, unschedulable,
                      latencies, executed_preemptions, tenants, slo_doc,
-                     defrag_report)
+                     defrag_report, serving_report)
     if hotspots_doc is not None:
         report["hotspots"] = hotspots_doc
     return report
@@ -436,6 +500,126 @@ def _run_defrag(api, client: _Client, stack, mode, unschedulable,
             recovered.append(f"{pod.namespace}/{pod.name}")
     out["recovered"] = recovered
     return out
+
+
+def _run_serving(api, client: _Client, stack, scenario, all_nodes,
+                 placements) -> dict:
+    """Front the replay's bound decode pods with the REAL router
+    (:mod:`tpushare.router`) and replay the scenario's open-loop
+    traffic stream on a deterministic clock. Shedding standing comes
+    from the controller's live QuotaManager (the same ``quotas:``
+    table the scheduler just enforced), and with ``scale_out: true``
+    the router's queue-depth signal is played against the real verbs:
+    the spec becomes a pod, filter → prioritize → bind places it, and
+    the new replica joins the fleet MID-REPLAY — the report's packing
+    includes it (``via: router scale-out``). This is the offline
+    dry-run of the request-traffic → chip-placement loop
+    (docs/serving.md)."""
+    from tpushare.k8s.builders import make_pod
+    from tpushare.router import DecodeReplica, Router
+    from tpushare.utils import const as _c
+    from tpushare.utils import node as nodeutils
+
+    cfg = scenario["serving"]
+    prefix = str(cfg.get("pods", "decode"))
+    fronted = [p for p in placements
+               if p["pod"].startswith(prefix)]
+    if not fronted:
+        return {"error": f"serving: no bound pod named {prefix}*"}
+    slots = int(cfg.get("slots_per_replica", 4))
+    model = {
+        "decode_tok_s": float(cfg.get("decode_tok_s", 1000.0)),
+        "prefill_tok_s": float(cfg.get("prefill_tok_s", 200_000.0)),
+        "admission_overhead": float(
+            cfg.get("admission_overhead", 0.10)),
+    }
+    now = 0.0
+    router = Router(
+        quota=stack.controller.quota, clock=lambda: now,
+        scaleout_cooldown_s=float(cfg.get("scaleout_cooldown", 1.0)))
+    namespace = fronted[0].get("namespace", "default")
+    for p in fronted:
+        pod = api.get_pod(p.get("namespace", "default"), p["pod"])
+        ann = pod.raw["metadata"].get("annotations") or {}
+        router.add_replica(DecodeReplica(
+            p["pod"], slots=slots, node=p.get("node", ""),
+            hbm_gib=float(ann.get(_c.ANN_HBM_POD, 0) or 0), **model))
+
+    provisioned: list[dict] = []
+    if cfg.get("scale_out"):
+        def _provision(spec: dict) -> None:
+            """The scheduler's side of the loop, mid-replay: one
+            decode pod of the signalled shape through the real
+            verbs, then the replica registers."""
+            name = f"{prefix}-scale-{len(provisioned)}"
+            pod = api.create_pod(make_pod(
+                name, hbm=int(spec.get("hbmGiB", 8)) or 8,
+                namespace=namespace))
+            candidates = [n.name for n in all_nodes
+                          if nodeutils.is_schedulable(n, pod)]
+            verdict = _schedule_one(client, pod, candidates)
+            bound = verdict.get("state") == "bound"
+            provisioned.append(
+                {"pod": name, "spec": spec, "bound": bound})
+            if not bound:
+                return
+            placements.append({"pod": name, "namespace": namespace,
+                               "node": verdict.get("node"),
+                               "via": "router scale-out"})
+            router.add_replica(DecodeReplica(
+                name, slots=slots, node=verdict.get("node") or "",
+                hbm_gib=float(spec.get("hbmGiB", 0) or 0), **model))
+        router.on_scaleout = _provision
+
+    arrivals: list[tuple[float, str, int, int]] = []
+    duration = float(cfg.get("duration", 10.0))
+    for grp in cfg.get("traffic", []):
+        n = int(grp.get("requests", 1))
+        start = float(grp.get("start", 0.0))
+        over = float(grp.get("over", duration)) or duration
+        for i in range(n):
+            arrivals.append((start + over * i / max(n, 1),
+                             str(grp.get("tenant", "default")),
+                             int(grp.get("prompt_len", 128)),
+                             int(grp.get("max_new", 64))))
+    arrivals.sort(key=lambda a: a[0])
+
+    tick = float(cfg.get("tick", 0.05))
+    outcomes: dict[str, dict[str, int]] = {}
+    nxt = 0
+    while now < duration:
+        while nxt < len(arrivals) and arrivals[nxt][0] <= now:
+            _, tenant, plen, mnew = arrivals[nxt]
+            nxt += 1
+            dec = router.submit(tenant, plen, mnew, now=now)
+            row = outcomes.setdefault(
+                tenant, {"assigned": 0, "queued": 0, "shed": 0})
+            row[dec["outcome"]] += 1
+        router.tick(now)
+        now += tick
+    # Drain: keep the model running until every queued/in-flight
+    # request retires (bounded — a report must terminate even if a
+    # pathological scenario cannot drain).
+    drained_at = None
+    deadline = now + 600.0
+    while now < deadline:
+        router.tick(now)
+        snap = router.snapshot()
+        if snap["queuedTotal"] == 0 and snap["slotsInUse"] == 0:
+            drained_at = round(now, 2)
+            break
+        now += max(tick, 0.5)
+    stack.controller.wait_idle(timeout=10)
+    snap = router.snapshot()
+    return {
+        "replicas": sorted(p["pod"] for p in fronted),
+        "slotsPerReplica": slots,
+        "outcomes": outcomes,
+        "scaleOut": {"signals": snap["scaleOut"]["signals"],
+                     "provisioned": provisioned},
+        "drainedAtS": drained_at,
+        "snapshot": snap,
+    }
 
 
 def _quota_configmap(scenario: dict) -> dict | None:
@@ -557,7 +741,7 @@ def _execute_preemption(api, client: _Client, controller, pod,
 
 def _report(inspect_doc, placements, held, unschedulable,
             latencies, executed_preemptions=(), tenants=(),
-            slo_doc=None, defrag_report=None):
+            slo_doc=None, defrag_report=None, serving_report=None):
     nodes = []
     total_hbm = used_hbm = free_whole_chips = cordoned_hbm = 0
     for n in inspect_doc.get("nodes", []):
@@ -602,6 +786,7 @@ def _report(inspect_doc, placements, held, unschedulable,
         "tenants": list(tenants),
         "slo": slo_doc or {},
         **({"defrag": defrag_report} if defrag_report else {}),
+        **({"serving": serving_report} if serving_report else {}),
     }
 
 
@@ -716,6 +901,26 @@ def _print_human(report: dict) -> None:
                   f"({t['borrowedHBM']} borrowed), "
                   f"{t['usedChips']} chip(s), guarantee/limit HBM "
                   f"{spec}, {t['pods']} pod(s)")
+    if report.get("serving"):
+        s = report["serving"]
+        if s.get("error"):
+            print(f"\nserving: {s['error']}")
+        else:
+            scaled = [p["pod"] for p in s["scaleOut"]["provisioned"]
+                      if p["bound"]]
+            print(f"\nserving (router over {len(s['replicas'])} "
+                  f"fronted + {len(scaled)} scaled replica(s)):")
+            snap = s["snapshot"]
+            for tenant, o in sorted(s["outcomes"].items()):
+                ttft = snap["tenants"].get(tenant, {}).get("ttft", {})
+                print(f"  {tenant}: {o['assigned']} assigned, "
+                      f"{o['queued']} queued, {o['shed']} shed; "
+                      f"ttft p99 {ttft.get('p99')}s")
+            drained = (f"drained at {s['drainedAtS']}s"
+                       if s["drainedAtS"] is not None
+                       else "DID NOT drain")
+            print(f"  scale-out: {s['scaleOut']['signals']} "
+                  f"signal(s), bound {scaled or 'none'}; {drained}")
     for g in report.get("gangs", []):
         print(f"\ngang {g.get('name')}: {g}")
 
@@ -957,6 +1162,11 @@ def main() -> None:
                     help="print a defragmentation demo scenario "
                          "(fragment -> plan -> migrate -> pending pod "
                          "binds in one run) and exit")
+    ap.add_argument("--example-serving", action="store_true",
+                    help="print a serving front-door demo scenario "
+                         "(surge -> shed the flooder -> scale-out "
+                         "binds a decode pod -> queues drain) and "
+                         "exit")
     ap.add_argument("--drain", metavar="NODE",
                     help="with --defrag: ask whether NODE can be "
                          "drained — only its residents are re-packed "
@@ -977,6 +1187,9 @@ def main() -> None:
         return
     if args.example_defrag:
         print(EXAMPLE_DEFRAG, end="")
+        return
+    if args.example_serving:
+        print(EXAMPLE_SERVING, end="")
         return
     if not args.scenario and not args.defrag:
         ap.error("scenario file required (or --example / --defrag)")

@@ -94,6 +94,10 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
     "ContinuousProfiler": ("_buckets", "_cum", "_cum_verb", "_cum_idle"),
     "VerbCostLedger": ("_verbs",),
     "DecisionProfiler": ("_self_s", "_profiled"),
+    # The serving front door (tpushare/router/): request threads
+    # submit, the serving loop ticks, and the scrape/debug handlers
+    # snapshot — the queue and tenant ledger are hit from all three.
+    "Router": ("_replicas", "_queue", "_requests", "_tenants"),
 }
 
 #: Method calls that mutate a dict/set/list in place.
@@ -306,7 +310,8 @@ def raw_lock(tree: ast.AST, src: str, path: str) -> list[Violation]:
 #: increment a drop/error counter so the loss itself is observable.
 _TELEMETRY_PATHS = ("k8s/events.py", "routes/metrics.py")
 _TELEMETRY_DIRS = ("tpushare/trace/", "tpushare/slo/",
-                   "tpushare/defrag/", "tpushare/profiling/")
+                   "tpushare/defrag/", "tpushare/profiling/",
+                   "tpushare/router/")
 
 #: Call shapes that count as incrementing a drop/error counter
 #: (bare ``safe_inc(...)``, ``metrics.safe_inc(...)``, ``x.inc()``).

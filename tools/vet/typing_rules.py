@@ -22,7 +22,7 @@ from tools.vet.engine import Violation
 CORE_PACKAGES = ("tpushare/cache/", "tpushare/scheduler/",
                  "tpushare/utils/", "tpushare/api/", "tpushare/quota/",
                  "tpushare/slo/", "tpushare/defrag/",
-                 "tpushare/profiling/",
+                 "tpushare/profiling/", "tpushare/router/",
                  "tpushare/k8s/eviction.py")
 
 #: Parameter names exempt from annotation (bound implicitly).

@@ -164,12 +164,17 @@ def build_stack(client, is_leader=None) -> Stack:
                  preempt, admission)
 
 
-def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2):
+def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2,
+                router=None):
     """Boot a fully-wired stack and HTTP server over ``client`` and
     return ``(stack, server)`` — the shared harness for the offline
     tools (demo cluster, capacity simulator). Wires EVERY verb,
     including ``gang_planner`` (the gangs-pending gauge freezes
-    silently when it is omitted — see routes/server.py)."""
+    silently when it is omitted — see routes/server.py). ``router``
+    (a :class:`tpushare.router.Router`) additionally serves
+    ``GET /debug/router`` + the ``tpushare_router_*`` gauges — the
+    serving front door normally runs in its own process, but the
+    harness hosts it in-process for e2e stories (docs/serving.md)."""
     stack = build_stack(client)
     stack.controller.start(workers=workers)
     server = ExtenderHTTPServer(
@@ -179,7 +184,8 @@ def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2):
         gang_planner=stack.binder.gang_planner,
         workqueue=stack.controller.queue,
         quota=stack.controller.quota,
-        defrag=stack.controller.defrag)
+        defrag=stack.controller.defrag,
+        router=router)
     serve_forever(server)
     return stack, server
 

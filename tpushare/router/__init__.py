@@ -1,0 +1,16 @@
+"""Fleet-scale serving front door: a tenant-aware request router over
+shared-chip decode servers (docs/serving.md).
+
+The scheduler places decode pods on shared chips; the workload side
+runs the continuous-batching slot server
+(:mod:`tpushare.workload.serving`). This package composes them into the
+million-user story: an open-loop request stream routed by tenant +
+slot-queue depth + KV-cache HBM headroom, load shedding by tenant quota
+standing (reusing the :class:`tpushare.quota.QuotaManager` spec), and a
+scale-out signal into the scheduler when queues build.
+"""
+
+from tpushare.router.router import (DecodeReplica, ReplicaEvent, Request,
+                                    Router)
+
+__all__ = ["DecodeReplica", "ReplicaEvent", "Request", "Router"]

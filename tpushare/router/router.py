@@ -1,0 +1,636 @@
+"""Tenant-aware router over a fleet of shared-chip decode servers.
+
+The decode fleet is the co-tenancy payoff: many low-HBM slot servers
+(:mod:`tpushare.workload.serving`) packed onto shared chips, each sized
+by its scheduler grant (``max_batch_for_grant``). This module is the
+front door that makes those servers a SERVICE:
+
+* **Routing** — a request lands on the replica with the most free slots
+  (= the most KV-cache HBM headroom: a replica's slot count IS its
+  grant divided by the per-sequence cache cost, see
+  :meth:`DecodeReplica.from_grant`), queue depth breaking ties. A full
+  fleet queues the request on the shortest queue.
+* **Shedding** — when the fleet is saturated, tenants holding more than
+  their quota-derived share of the fleet's slots are shed (HTTP-429
+  semantics), everyone else queues. Standing comes from the SAME
+  ``tpushare-quotas`` guarantees the scheduler enforces
+  (:class:`tpushare.quota.QuotaManager`), so "over quota" means one
+  thing platform-wide.
+* **Scale-out** — sustained queue depth raises a signal (a counter, a
+  snapshot field, and an optional callback) carrying the replica shape
+  to provision; the scheduler places the pod, the operator registers
+  the new replica, the queues drain. The e2e test drives exactly that
+  loop over the real filter/bind verbs.
+* **Telemetry** — rolling TTFT windows (p50/p99 via
+  :mod:`tpushare.utils.stats`), per-tenant served/shed/queued counts,
+  fleet tokens/s; surfaced at ``GET /debug/router``, in
+  ``tpushare_router_*`` metrics (set at scrape time from this ledger's
+  monotonic counters), and by ``kubectl-inspect serving``.
+
+:class:`DecodeReplica` carries an analytic service model (slots,
+aggregate decode tokens/s, serial FIFO prefill, and an
+``admission_overhead`` — the fraction of decode throughput an in-flight
+prefill costs co-tenants: ~0.22 for whole-prompt admission, <= 0.10 for
+the chunked-prefill server, the numbers ``bench_workload.py`` measures
+on silicon). The traffic-replay bench, the simulator, and the e2e tests
+all drive this model; a production deployment backs the same Router
+policy with RPC stubs reporting real slot-server state.
+
+Control-plane discipline: no jax import at module level (the router
+runs in the scheduler/operator process), every shared-state mutation
+under the ledger lock, clock injectable for deterministic replay.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import TYPE_CHECKING, Callable, Deque, Iterable
+
+from tpushare.utils import locks, stats
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from tpushare.quota.manager import QuotaManager
+    from tpushare.runtime.jaxenv import ShareGrant
+
+#: Mirror of ``serving.PROMPT_BUCKETS`` — the router pads prompt
+#: lengths to the same admission buckets the slot server compiles for,
+#: without importing the jax-heavy workload module into the control
+#: plane (tests cross-check the two stay equal).
+PROMPT_BUCKETS: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048)
+
+#: Rolling-window sizes.
+TTFT_WINDOW = 512          #: TTFT samples kept per tenant and fleet-wide
+TOKENS_WINDOW_S = 10.0     #: horizon for the fleet tokens/s figure
+
+
+def _bucket(n: int, buckets: tuple[int, ...], max_len: int) -> int:
+    """Padded admission length for an ``n``-token prompt (the compiled
+    shape the slot server will reuse), capped at the cache."""
+    for b in sorted(buckets):
+        if b >= n:
+            return min(b, max_len)
+    return max_len
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request riding through the router."""
+
+    rid: str
+    tenant: str
+    prompt_len: int
+    max_new: int
+    arrival: float
+    #: Prompt length padded to the admission bucket — what the prefill
+    #: actually costs the replica.
+    bucket: int = 0
+    replica: str = ""
+    admitted_at: float | None = None
+    first_token_at: float | None = None
+    done_at: float | None = None
+    #: Prefill tokens still owed before the first token emits.
+    prefill_remaining: float = 0.0
+    #: Decode progress in tokens (float: rate-integrated).
+    progress: float = 0.0
+
+    @property
+    def ttft(self) -> float | None:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.arrival
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaEvent:
+    """Something a replica's service model produced during advance()."""
+
+    kind: str       #: ``first-token`` | ``complete``
+    rid: str
+    at: float
+
+
+class DecodeReplica:
+    """One decode pod behind the router: slot capacity + an analytic
+    service model (exact piecewise-linear integration — events land at
+    their true timestamps, not tick boundaries).
+
+    ``slots`` is the KV-cache headroom story: build via
+    :meth:`from_grant` and the count is ``max_batch_for_grant`` over
+    the pod's jaxenv HBM grant — the same arithmetic the tenant uses to
+    size itself (COTENANCY runs). ``decode_tok_s`` is the replica's
+    aggregate continuous-decode throughput (HBM-bound: the step reads
+    the whole cache regardless of occupancy, so per-slot rate is
+    aggregate/slots). ``admission_overhead`` is the decode throughput
+    fraction an in-flight prefill steals from co-resident slots: 1.0
+    models whole-prompt admission stalling the batch; the chunked
+    server holds it <= 0.10 (the bench_workload gate)."""
+
+    def __init__(self, name: str, *, slots: int, node: str = "",
+                 hbm_gib: float = 0.0, max_len: int = 2048,
+                 decode_tok_s: float = 8400.0,
+                 prefill_tok_s: float = 200_000.0,
+                 admission_overhead: float = 0.10) -> None:
+        if slots <= 0:
+            raise ValueError(f"replica {name}: slots must be > 0")
+        self.name = name
+        self.node = node
+        self.slots = slots
+        self.hbm_gib = hbm_gib
+        self.max_len = max_len
+        self.decode_tok_s = decode_tok_s
+        self.prefill_tok_s = prefill_tok_s
+        self.admission_overhead = min(max(admission_overhead, 0.0), 1.0)
+        #: Owned by the Router (mutated only under its lock).
+        self.inflight: list[Request] = []
+        self._now: float | None = None
+
+    @classmethod
+    def from_grant(cls, name: str, grant: "ShareGrant", *,
+                   node: str = "", max_len: int = 2048,
+                   cfg: object | None = None,
+                   **kw: float) -> "DecodeReplica":
+        """Size a replica from its scheduler HBM grant: slots =
+        ``serving.max_batch_for_grant`` (weights once, then one KV-cache
+        row per concurrent sequence). Imports the jax-backed workload
+        module lazily — control-plane callers that already know their
+        slot count use the constructor directly."""
+        from tpushare.workload import model as M
+        from tpushare.workload import serving as S
+
+        model_cfg = cfg if cfg is not None else M.ModelConfig()
+        slots = S.max_batch_for_grant(model_cfg, grant.hbm_pod_gib,
+                                      max_len=max_len)
+        if slots <= 0:
+            raise ValueError(
+                f"replica {name}: grant {grant.hbm_pod_gib} GiB cannot "
+                "hold the model weights — ask the scheduler for a "
+                "bigger slice")
+        return cls(name, slots=slots, node=node,
+                   hbm_gib=float(grant.hbm_pod_gib), max_len=max_len,
+                   **kw)  # type: ignore[arg-type]
+
+    # -- service model -----------------------------------------------------
+
+    def free_slots(self) -> int:
+        return self.slots - len(self.inflight)
+
+    def admit(self, req: Request, now: float) -> None:
+        """Place ``req`` into a free slot; its prefill starts queueing
+        behind earlier admissions (serial FIFO, like the slot server)."""
+        req.replica = self.name
+        req.admitted_at = now
+        req.prefill_remaining = float(req.bucket)
+        req.progress = 0.0
+        self.inflight.append(req)
+        if self._now is None:
+            self._now = now
+
+    def advance(self, now: float) -> tuple[list[ReplicaEvent], float]:
+        """Integrate the service model up to ``now``. Returns (events,
+        tokens generated) — events carry exact timestamps so TTFT
+        percentiles are not quantized to the caller's tick."""
+        events: list[ReplicaEvent] = []
+        tokens = 0.0
+        if self._now is None:
+            self._now = now
+        per_slot = self.decode_tok_s / self.slots
+        guard = 0
+        while self._now < now - 1e-12:
+            guard += 1
+            if guard > 10_000:  # defensive: float stall must not hang
+                self._now = now
+                break
+            prefilling = [r for r in self.inflight
+                          if r.prefill_remaining > 0]
+            prefilling.sort(key=lambda r: (r.admitted_at or 0.0, r.rid))
+            head = prefilling[0] if prefilling else None
+            # A prefill cannot progress before its own admission: the
+            # head's clock starts at max(model time, admitted_at), or
+            # TTFT would go negative for requests admitted mid-tick.
+            head_start = self._now
+            if head is not None:
+                head_start = max(self._now, head.admitted_at
+                                 or self._now)
+            head_active = head is not None and head_start <= self._now
+            rate = per_slot * (1.0 - (self.admission_overhead
+                                      if head_active else 0.0))
+            decoding = [r for r in self.inflight
+                        if r.prefill_remaining <= 0]
+            # Completion is decided by EVENT TIME, not by residual
+            # counters: at high rates an event's dt can underflow
+            # against the clock (0.35 + 1e-17 == 0.35 in float64), and
+            # a residual-only test then spins the loop at dt == 0
+            # until the guard trips — every advance() call. When a
+            # request's own completion time IS the chosen next event,
+            # it completes, whatever float residue the subtraction
+            # leaves.
+            t_next = now
+            t_pf = None
+            if head is not None and self.prefill_tok_s > 0:
+                t_pf = (max(head_start, self._now)
+                        + head.prefill_remaining / self.prefill_tok_s)
+                t_next = min(t_next, t_pf)
+            t_dec: dict[str, float] = {}
+            if rate > 0:
+                for r in decoding:
+                    t_dec[r.rid] = (self._now
+                                    + (r.max_new - r.progress) / rate)
+                    t_next = min(t_next, t_dec[r.rid])
+            dt = max(t_next - self._now, 0.0)
+            if head is not None:
+                pf_dt = max(t_next - max(head_start, self._now), 0.0)
+                head.prefill_remaining = max(
+                    head.prefill_remaining
+                    - pf_dt * self.prefill_tok_s, 0.0)
+                if t_pf is not None and t_next >= t_pf:
+                    head.prefill_remaining = 0.0
+                if head.prefill_remaining <= 1e-9:
+                    head.prefill_remaining = 0.0
+                    # The admit's own first token emits with the
+                    # finalize step — TTFT stops here.
+                    head.first_token_at = t_next
+                    head.progress = 1.0
+                    tokens += 1.0
+                    events.append(ReplicaEvent("first-token", head.rid,
+                                               t_next))
+            if rate > 0:
+                for r in decoding:
+                    before = r.progress
+                    r.progress = min(r.progress + dt * rate,
+                                     float(r.max_new))
+                    if t_next >= t_dec[r.rid]:
+                        r.progress = float(r.max_new)
+                    tokens += r.progress - before
+                    if r.progress >= r.max_new - 1e-9:
+                        r.done_at = t_next
+                        events.append(ReplicaEvent("complete", r.rid,
+                                                   t_next))
+            self.inflight = [r for r in self.inflight
+                             if r.done_at is None]
+            self._now = t_next
+        return events, tokens
+
+
+class _TenantStats:
+    """Per-tenant ledger row (owned by the Router, under its lock)."""
+
+    __slots__ = ("requests", "shed", "served_tokens", "completed",
+                 "ttft")
+
+    def __init__(self) -> None:
+        self.requests = 0
+        self.shed = 0
+        self.served_tokens = 0.0
+        self.completed = 0
+        self.ttft: Deque[float] = deque(maxlen=TTFT_WINDOW)
+
+
+class Router:
+    """The decode fleet's front door. See the module docstring for the
+    policy; every public method is thread-safe (one ledger lock)."""
+
+    def __init__(self, quota: "QuotaManager | None" = None, *,
+                 clock: Callable[[], float] = time.monotonic,
+                 buckets: tuple[int, ...] = PROMPT_BUCKETS,
+                 queue_limit: int = 1024,
+                 shed_slack: float = 2.0,
+                 scaleout_queue_factor: float = 0.5,
+                 scaleout_cooldown_s: float = 5.0,
+                 on_scaleout: Callable[[dict], None] | None = None
+                 ) -> None:
+        #: Quota spec source for shedding standing; None = equal shares.
+        self.quota = quota
+        self.clock = clock
+        self.buckets = buckets
+        #: Fleet-wide cap on QUEUED requests — past it even
+        #: under-standing tenants shed (memory is finite).
+        self.queue_limit = queue_limit
+        #: Outstanding-demand multiple of entitlement past which a
+        #: saturated fleet sheds the tenant (see _should_shed).
+        self.shed_slack = shed_slack
+        #: Queues deeper than factor * fleet slots raise the signal.
+        self.scaleout_queue_factor = scaleout_queue_factor
+        self.scaleout_cooldown_s = scaleout_cooldown_s
+        self.on_scaleout = on_scaleout
+        self._lock = locks.TracingRLock("router/state")
+        self._replicas: dict[str, DecodeReplica] = {}
+        #: ONE fleet-wide FIFO: a request waits for the NEXT free slot
+        #: anywhere, so a queued request can never strand behind one
+        #: replica while another frees up.
+        self._queue: Deque[Request] = deque()
+        self._requests: dict[str, Request] = {}
+        self._tenants: dict[str, _TenantStats] = {}
+        self._ttft: Deque[float] = deque(maxlen=TTFT_WINDOW)
+        self._token_events: Deque[tuple[float, float]] = deque(
+            maxlen=4096)
+        self._seq = 0
+        self._scaleout_signals = 0
+        self._scaleout_last = 0.0
+        self._scaleout_wanted = False
+
+    # -- fleet membership --------------------------------------------------
+
+    def add_replica(self, replica: DecodeReplica) -> None:
+        with self._lock:
+            self._replicas[replica.name] = replica
+
+    def remove_replica(self, name: str) -> None:
+        """Drop a replica. Queued requests are unaffected (the queue
+        is fleet-wide); its in-flight ones are the pod's to finish or
+        lose."""
+        with self._lock:
+            gone = self._replicas.pop(name, None)
+            if gone is not None:
+                for req in gone.inflight:
+                    self._requests.pop(req.rid, None)
+
+    def replicas(self) -> list[DecodeReplica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, tenant: str, prompt_len: int, max_new: int,
+               now: float | None = None) -> dict:
+        """Route one request. Returns the decision document:
+        ``{"outcome": "assigned"|"queued"|"shed", "rid", ...}``."""
+        if now is None:
+            now = self.clock()
+        with self._lock:
+            self._seq += 1
+            rid = f"r{self._seq}"
+            ts = self._tenants.setdefault(tenant, _TenantStats())
+            ts.requests += 1
+            max_len = (max(r.max_len for r in self._replicas.values())
+                       if self._replicas else 2048)
+            req = Request(rid=rid, tenant=tenant,
+                          prompt_len=prompt_len, max_new=max_new,
+                          arrival=now,
+                          bucket=_bucket(prompt_len, self.buckets,
+                                         max_len))
+            if not self._replicas:
+                ts.shed += 1
+                return {"outcome": "shed", "rid": rid,
+                        "reason": "no-replicas"}
+            # No replica's cache can hold the prompt: capping it to the
+            # bucket table would admit a request the slot server must
+            # reject (serving.bucket_len raises for the same length)
+            # while billing its prefill short — refuse it up front.
+            if prompt_len > max_len:
+                ts.shed += 1
+                return {"outcome": "shed", "rid": rid,
+                        "reason": "prompt-too-long"}
+            # Earlier arrivals first: drain the queues into any freed
+            # slots BEFORE considering this one — a new arrival must
+            # not jump a nonempty queue (a surge tenant's arrival rate
+            # would let it monopolize every slot the instant one
+            # frees), and queues must only persist under true
+            # saturation (a queue lingering beside a free slot would
+            # fire the scale-out signal on an idle fleet).
+            self._drain_locked(now)
+            # Most KV headroom first (free slots ARE free cache rows
+            # under the replica's grant), name breaking ties.
+            best = max(
+                self._replicas.values(),
+                key=lambda r: (r.free_slots(), r.name))
+            if best.free_slots() > 0 and not self._queue:
+                self._requests[rid] = req
+                best.admit(req, now)
+                return {"outcome": "assigned", "rid": rid,
+                        "replica": best.name}
+            # Saturated: shed over-standing tenants, queue the rest.
+            if len(self._queue) >= self.queue_limit:
+                ts.shed += 1
+                return {"outcome": "shed", "rid": rid,
+                        "reason": "queue-full"}
+            if self._should_shed(tenant):
+                ts.shed += 1
+                return {"outcome": "shed", "rid": rid,
+                        "reason": "over-quota"}
+            self._requests[rid] = req
+            self._queue.append(req)
+            return {"outcome": "queued", "rid": rid,
+                    "depth": len(self._queue)}
+
+    def _active_tenants(self) -> set[str]:
+        """Tenants currently holding slots or waiting in the queue.
+        Entitlement divides the fleet over THESE, not every tenant the
+        ledger has ever seen — a stats row outlives its traffic, and
+        splitting over historical tenants would permanently dilute the
+        active ones into false sheds. Callers hold the lock."""
+        active = {r.tenant for rep in self._replicas.values()
+                  for r in rep.inflight}
+        active.update(r.tenant for r in self._queue)
+        return active
+
+    def _entitled(self, tenant: str) -> float:
+        """The tenant's slot entitlement: its share of the fleet. Share
+        comes from the quota guarantees when configured
+        (``guaranteeHBM`` weights — the platform's one definition of
+        entitlement), equal split over active tenants otherwise.
+        Callers hold the lock."""
+        fleet = sum(r.slots for r in self._replicas.values())
+        active = self._active_tenants()
+        active.add(tenant)
+        share = None
+        if self.quota is not None:
+            mine = self.quota.config_for(tenant)
+            if self.quota.configured(tenant):
+                weights = {
+                    t: (self.quota.config_for(t).guarantee_hbm or 0)
+                    for t in active}
+                total = sum(weights.values())
+                if total > 0:
+                    share = (mine.guarantee_hbm or 0) / total
+        if share is None:
+            share = 1.0 / max(len(active), 1)
+        return share * fleet
+
+    def _should_shed(self, tenant: str) -> bool:
+        """Shed decision for a new arrival on a saturated fleet: the
+        tenant's QUEUED backlog is past ``shed_slack`` times its
+        entitlement. Queued only, deliberately not held+queued: the
+        dequeue skip already caps a tenant's HELD slots at its
+        entitlement under contention, so held adds no signal — but it
+        does add noise exactly when shedding must be precise (at surge
+        onset a flooder grabs the whole idle pool work-conservingly,
+        the in-quota tenants' queues spike while those borrowed slots
+        retire, and counting their capped holds on top of the spike
+        sheds the surge's VICTIMS). A flooder is the tenant whose queue
+        cannot drain — offered load past entitlement — and that is the
+        backlog this bounds. The slack keeps a tenant hovering AT its
+        share queueing (quota policy must not punish in-quota spikes;
+        the fleet-wide queue_limit backstops memory). Callers hold the
+        lock."""
+        queued = sum(1 for r in self._queue if r.tenant == tenant)
+        return queued > self.shed_slack * self._entitled(tenant)
+
+    def tick(self, now: float | None = None) -> list[ReplicaEvent]:
+        """Advance every replica's service model, record TTFT and
+        throughput, refill freed slots from the queues, and evaluate
+        the scale-out signal. Drive this from the serving loop (or the
+        bench/simulator clock)."""
+        if now is None:
+            now = self.clock()
+        fired: Callable[[dict], None] | None = None
+        spec: dict = {}
+        out: list[ReplicaEvent] = []
+        with self._lock:
+            for rep in self._replicas.values():
+                events, tokens = rep.advance(now)
+                if tokens > 0:
+                    self._token_events.append((now, tokens))
+                for ev in events:
+                    out.append(ev)
+                    req = self._requests.get(ev.rid)
+                    if req is None:
+                        continue
+                    ts = self._tenants.setdefault(req.tenant,
+                                                  _TenantStats())
+                    if ev.kind == "first-token" and req.ttft is not None:
+                        ts.ttft.append(req.ttft)
+                        self._ttft.append(req.ttft)
+                    elif ev.kind == "complete":
+                        ts.completed += 1
+                        ts.served_tokens += req.max_new
+                        self._requests.pop(ev.rid, None)
+            self._drain_locked(now)
+            queued_total = len(self._queue)
+            fleet = sum(r.slots for r in self._replicas.values())
+            self._scaleout_wanted = (
+                queued_total > self.scaleout_queue_factor * max(fleet, 1))
+            if (self._scaleout_wanted
+                    and now - self._scaleout_last
+                    >= self.scaleout_cooldown_s):
+                self._scaleout_signals += 1
+                self._scaleout_last = now
+                fired = self.on_scaleout
+                spec = self.scaleout_spec()
+        if fired is not None:
+            # Outside the ledger lock: the callback schedules pods
+            # (apiserver round-trips must never run under it).
+            fired(spec)
+        return out
+
+    def _drain_locked(self, now: float) -> None:
+        """Pull queued requests into free slots: fleet-wide FIFO,
+        preferring tenants inside their standing — a shed-at-submit
+        policy alone would still let an over-quota backlog drain into
+        every freed slot ahead of in-quota tenants. WORK-CONSERVING:
+        when only over-standing tenants wait, the FIFO head takes the
+        slot anyway (idle capacity is exactly what quota borrowing is
+        for; it returns at the request's completion). Callers hold the
+        lock (re-entrant — re-taken here so the mutation is lexically
+        guarded). A candidate drains while its HELD slots are at or
+        under its entitlement (strictly over skips it — a tenant
+        sitting exactly at its share still drains, so a sole tenant
+        may fill the whole fleet; queued requests deliberately don't
+        count against it, see _should_shed). Held counts and
+        entitlements are computed ONCE per drain and maintained
+        incrementally: the active-tenant set is stable across the loop
+        (admission moves a request queue → inflight, membership
+        unchanged), and re-deriving both per queued candidate per
+        admission would make a deep-queue drain O(queue × tenants ×
+        inflight) under the ledger lock, on the submit hot path."""
+        with self._lock:
+            if not self._queue:
+                return
+            held: dict[str, int] = {}
+            for rep in self._replicas.values():
+                for r in rep.inflight:
+                    held[r.tenant] = held.get(r.tenant, 0) + 1
+            entitled: dict[str, float] = {}
+            while self._queue:
+                free = [r for r in self._replicas.values()
+                        if r.free_slots() > 0]
+                if not free:
+                    return
+                picked = 0
+                for idx, cand in enumerate(self._queue):
+                    ent = entitled.get(cand.tenant)
+                    if ent is None:
+                        ent = entitled[cand.tenant] = self._entitled(
+                            cand.tenant)
+                    if held.get(cand.tenant, 0) <= ent:
+                        picked = idx
+                        break
+                nxt = self._queue[picked]
+                del self._queue[picked]
+                best = max(free, key=lambda r: (r.free_slots(), r.name))
+                best.admit(nxt, now)
+                held[nxt.tenant] = held.get(nxt.tenant, 0) + 1
+
+    def scaleout_spec(self) -> dict:
+        """The replica shape to provision: the fleet's modal grant (or
+        a 1-chip 8-GiB decode slice when the fleet is empty)."""
+        reps = list(self._replicas.values())
+        if not reps:
+            return {"hbmGiB": 8, "maxLen": 2048, "reason": "cold-start"}
+        best = max(reps, key=lambda r: r.slots)
+        return {"hbmGiB": best.hbm_gib or 8, "maxLen": best.max_len,
+                "reason": "queue-depth"}
+
+    # -- views -------------------------------------------------------------
+
+    def _fleet_tokens_per_s(self, now: float) -> float:
+        """Tokens/s over the trailing window (callers hold the lock)."""
+        horizon = now - TOKENS_WINDOW_S
+        total = sum(n for (t, n) in self._token_events if t > horizon)
+        return total / TOKENS_WINDOW_S
+
+    @staticmethod
+    def _percentiles(window: Iterable[float]) -> dict:
+        vals = sorted(window)
+        if not vals:
+            return {"p50": None, "p99": None, "samples": 0}
+        return {"p50": round(stats.quantile_sorted(vals, 0.50), 6),
+                "p99": round(stats.quantile_sorted(vals, 0.99), 6),
+                "samples": len(vals)}
+
+    def snapshot(self) -> dict:
+        """The ``GET /debug/router`` document (also what the metrics
+        scrape and kubectl-inspect render)."""
+        now = self.clock()
+        with self._lock:
+            fleet_slots = sum(r.slots for r in self._replicas.values())
+            in_use = sum(len(r.inflight)
+                         for r in self._replicas.values())
+            tenants = {}
+            for name, ts in sorted(self._tenants.items()):
+                tenants[name] = {
+                    "requests": ts.requests,
+                    "shed": ts.shed,
+                    "completed": ts.completed,
+                    "servedTokens": round(ts.served_tokens, 1),
+                    "inflight": sum(
+                        1 for rep in self._replicas.values()
+                        for r in rep.inflight if r.tenant == name),
+                    "queued": sum(1 for r in self._queue
+                                  if r.tenant == name),
+                    "ttft": self._percentiles(ts.ttft),
+                }
+            replicas = [{
+                "name": r.name, "node": r.node, "slots": r.slots,
+                "inUse": len(r.inflight),
+                "hbmGiB": r.hbm_gib, "maxLen": r.max_len,
+                "decodeTokS": r.decode_tok_s,
+                "admissionOverhead": r.admission_overhead,
+            } for r in sorted(self._replicas.values(),
+                              key=lambda r: r.name)]
+            return {
+                "fleetSlots": fleet_slots,
+                "slotsInUse": in_use,
+                "queuedTotal": len(self._queue),
+                "fleetTokensPerS": round(
+                    self._fleet_tokens_per_s(now), 1),
+                "ttft": self._percentiles(self._ttft),
+                "tenants": tenants,
+                "replicas": replicas,
+                "scaleOut": {
+                    "signals": self._scaleout_signals,
+                    "wanted": self._scaleout_wanted,
+                    "spec": self.scaleout_spec(),
+                },
+            }

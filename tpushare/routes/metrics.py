@@ -287,6 +287,68 @@ DEFRAG_PLANS_ABORTED = Counter(
     ["reason"], registry=REGISTRY,
 )
 
+# -- Serving front door (tpushare/router/, docs/serving.md) ---------------- #
+# All router series are SET at scrape time from the Router ledger's
+# monotonic counters and rolling windows (the workqueue-retries
+# pattern): the router itself stays import-light and lock-cheap.
+
+ROUTER_REQUESTS = Gauge(
+    "tpushare_router_requests_total",
+    "Requests the serving router has accepted per tenant (assigned, "
+    "queued, or shed — the open-loop arrival count). Monotonic; set "
+    "at scrape time from the router ledger",
+    ["tenant"], registry=REGISTRY,
+)
+ROUTER_SHED = Gauge(
+    "tpushare_router_shed_total",
+    "Requests shed per tenant (429 semantics): over quota standing "
+    "while the fleet is saturated, fleet queue full, or no replicas. "
+    "An under-guarantee tenant shedding means the fleet needs "
+    "scale-out, not policy",
+    ["tenant"], registry=REGISTRY,
+)
+ROUTER_QUEUE_DEPTH = Gauge(
+    "tpushare_router_queue_depth",
+    "Requests queued (admitted to no slot yet) per tenant. Sustained "
+    "growth raises tpushare_router_scaleout_signals_total",
+    ["tenant"], registry=REGISTRY,
+)
+ROUTER_SLOTS_IN_USE = Gauge(
+    "tpushare_router_slots_in_use",
+    "Decode slots currently serving each tenant across the fleet",
+    ["tenant"], registry=REGISTRY,
+)
+ROUTER_FLEET_SLOTS = Gauge(
+    "tpushare_router_fleet_slots",
+    "Total decode slots across registered replicas (each replica's "
+    "count is its HBM grant over the per-sequence KV-cache cost — "
+    "serving.max_batch_for_grant)",
+    registry=REGISTRY,
+)
+ROUTER_TOKENS_PER_S = Gauge(
+    "tpushare_router_fleet_tokens_per_s",
+    "Fleet decode throughput over the router's trailing window",
+    registry=REGISTRY,
+)
+ROUTER_TTFT = Gauge(
+    "tpushare_router_ttft_seconds",
+    "Time-to-first-token percentiles over the router's rolling window "
+    "(arrival to first emitted token, queue wait included)",
+    ["quantile"], registry=REGISTRY,
+)
+ROUTER_SCALEOUT_SIGNALS = Gauge(
+    "tpushare_router_scaleout_signals_total",
+    "Scale-out signals the router has raised (queues sustained past "
+    "the threshold): each one asks the scheduler for another decode "
+    "pod of the fleet's modal shape. Monotonic; set at scrape time",
+    registry=REGISTRY,
+)
+ROUTER_REPLICAS = Gauge(
+    "tpushare_router_replicas",
+    "Decode replicas currently registered with the router",
+    registry=REGISTRY,
+)
+
 TELEMETRY_ERRORS = Counter(
     "tpushare_telemetry_errors_total",
     "Errors swallowed on telemetry paths (metrics scrape parse, trace "
@@ -593,6 +655,31 @@ def observe_frag(defrag) -> None:
             NODE_FRAG_SCORE.labels(node=node["node"]).set(node["score"])
 
 
+def observe_router(router) -> None:
+    """Refresh the serving-router gauges from the router ledger's
+    snapshot. Rebuilt from scratch each scrape (the per-node-gauge
+    pattern) so a tenant whose last request drained drops its label
+    series instead of freezing."""
+    with _SCRAPE_LOCK:
+        snap = router.snapshot()
+        for gauge in (ROUTER_REQUESTS, ROUTER_SHED, ROUTER_QUEUE_DEPTH,
+                      ROUTER_SLOTS_IN_USE, ROUTER_TTFT):
+            gauge.clear()
+        for tenant, row in snap["tenants"].items():
+            ROUTER_REQUESTS.labels(tenant=tenant).set(row["requests"])
+            ROUTER_SHED.labels(tenant=tenant).set(row["shed"])
+            ROUTER_QUEUE_DEPTH.labels(tenant=tenant).set(row["queued"])
+            ROUTER_SLOTS_IN_USE.labels(tenant=tenant).set(
+                row["inflight"])
+        ROUTER_FLEET_SLOTS.set(snap["fleetSlots"])
+        ROUTER_TOKENS_PER_S.set(snap["fleetTokensPerS"])
+        for q in ("p50", "p99"):
+            if snap["ttft"][q] is not None:
+                ROUTER_TTFT.labels(quantile=q).set(snap["ttft"][q])
+        ROUTER_SCALEOUT_SIGNALS.set(snap["scaleOut"]["signals"])
+        ROUTER_REPLICAS.set(len(snap["replicas"]))
+
+
 def observe_profiling() -> None:
     """Refresh the per-verb cost gauges and the profiler's self-series
     from tpushare.profiling's monotonic sources. Rebuilt each scrape so
@@ -694,7 +781,7 @@ def observe_process() -> None:
 
 
 def scrape(cache, gang_planner=None, leader=None, demand=None,
-           workqueue=None, quota=None, defrag=None) -> bytes:
+           workqueue=None, quota=None, defrag=None, router=None) -> bytes:
     """Atomic observe+render for the /metrics handler, timed and
     error-counted (a scrape that raises is a sample Prometheus never
     saw — that loss must itself be countable)."""
@@ -712,6 +799,8 @@ def scrape(cache, gang_planner=None, leader=None, demand=None,
             observe_process()
             if quota is not None:
                 observe_quota(quota)
+            if router is not None:
+                observe_router(router)
             if demand is not None:
                 pods, hbm, chips = demand.snapshot()
                 UNSCHED_PODS.set(pods)

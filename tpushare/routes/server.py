@@ -26,6 +26,9 @@ Routes:
   (docs/defrag.md)
 * ``GET  /debug/slo``       — SLO objectives: error-budget remaining,
   burn rates per window, journey aggregates (docs/slo.md)
+* ``GET  /debug/router``    — serving front door: per-tenant queue
+  depth / shed counts / TTFT percentiles, replica slot occupancy, the
+  scale-out signal (docs/serving.md)
 * ``GET  /debug/profile/continuous`` — the always-on profiler's rolling
   window as verb-rooted collapsed stacks (speedscope/flamegraph input;
   ``?window=`` narrows; docs/perf.md)
@@ -97,7 +100,7 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
                  prefix: str = DEFAULT_PREFIX, prioritize=None,
                  preempt=None, admission=None, leader=None,
                  gang_planner=None, debug_routes: bool = True,
-                 workqueue=None, quota=None, defrag=None):
+                 workqueue=None, quota=None, defrag=None, router=None):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
@@ -130,6 +133,11 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
         #: gauges in /metrics and GET /debug/defrag. Wired explicitly
         #: like quota: dropping it must 404, not freeze the frag score.
         self.defrag = defrag
+        #: Serving front door (router.Router), for the tpushare_router_*
+        #: gauges in /metrics and GET /debug/router. Wired explicitly
+        #: like the rest: dropping it must 404, not freeze the fleet
+        #: TTFT series.
+        self.router = router
         super().__init__(addr, _Handler)
 
 
@@ -285,7 +293,8 @@ class _Handler(BaseHTTPRequestHandler):
                                    demand=self.server.predicate.demand,
                                    workqueue=self.server.workqueue,
                                    quota=self.server.quota,
-                                   defrag=self.server.defrag),
+                                   defrag=self.server.defrag,
+                                   router=self.server.router),
                     ctype="text/plain; version=0.0.4")
             elif path.startswith("/debug/") and not self.server.debug_routes:
                 self._send_json({"Error": "debug routes disabled"}, 404)
@@ -311,6 +320,12 @@ class _Handler(BaseHTTPRequestHandler):
                                     404)
                 else:
                     self._send_json(self.server.defrag.status())
+            elif path == "/debug/router":
+                if self.server.router is None:
+                    self._send_json({"Error": "router not configured"},
+                                    404)
+                else:
+                    self._send_json(self.server.router.snapshot())
             elif path.startswith("/debug/trace/"):
                 rest = path[len("/debug/trace/"):]
                 ns, sep, pod_name = rest.partition("/")

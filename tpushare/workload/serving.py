@@ -212,10 +212,18 @@ def _generate(params: dict, tokens: jax.Array, cfg: M.ModelConfig,
 #   serves every mix of in-flight requests.
 # * ``admit`` prefills a prompt into a free slot mid-flight — other
 #   slots' streams are untouched (tests pin exactness vs solo runs).
+#   ``admit_chunked``/``admit_interleaved`` slice that prefill into
+#   fixed pieces so a long admission never stalls the running batch
+#   behind a whole-prompt prefill (Sarathi-style chunked prefill), and
+#   ``admit_bucketed`` pads prompts to a small bucket table so
+#   admissions reuse compiled shapes (jit hits counted, not assumed).
 # * ``serve_chunk`` advances every active slot by n tokens in one
 #   lax.scan (chunked iteration batching: the chunk amortizes host
 #   round-trips; a released slot is recyclable at the next chunk
-#   boundary).
+#   boundary). Its step writes K/V into a small per-chunk ring at ONE
+#   shared index (the static path's write shape) and flushes to the
+#   big cache once per chunk — the fused design that closed the
+#   continuous-admission overhead gap (see _fused_chunk_step).
 
 
 def init_server_state(cfg: M.ModelConfig, slots: int,
@@ -375,37 +383,66 @@ def release(state: dict, slot) -> dict:
     return dict(state, active=state["active"].at[slot].set(False))
 
 
-def _slot_decode_step(params: dict, state: dict,
-                      temperature: jax.Array | None = None,
-                      key: jax.Array | None = None
-                      ) -> tuple[dict, jax.Array]:
-    """One token for every ACTIVE slot, per-slot positions. Inactive
-    slots compute masked work (static shapes) but neither advance nor
-    emit. ``temperature`` [SLOTS] samples per slot (0 = greedy for that
-    slot — mixed greedy/sampled batches in one compiled step)."""
-    cache, pos, active = state["cache"], state["pos"], state["active"]
-    token = state["token"]
+def _fused_chunk_step(params: dict, cache: list[dict],
+                      base_mask: jax.Array, n_steps: int,
+                      pos: jax.Array, active: jax.Array,
+                      token: jax.Array, ring: list[dict], t: jax.Array,
+                      temperature: jax.Array | None,
+                      key: jax.Array | None
+                      ) -> tuple[tuple, jax.Array]:
+    """One token for every ACTIVE slot — the inner step of the fused
+    chunk scan. Inactive slots compute masked work (static shapes) but
+    neither advance nor emit.
+
+    The fusion that closed the admission-overhead gap: the old step
+    scattered every slot's K/V into the [SLOTS, max_len] cache at
+    per-slot positions (a vmapped dynamic_update_slice lowers to a
+    batched scatter — TPU's slow path — and threading the full cache
+    through the scan carry serializes every step behind a whole-buffer
+    alias). Here each step writes ALL slots' K/V at the SAME chunk-ring
+    index ``t`` — one plain dynamic_update_slice into a [SLOTS,
+    n_steps] ring, exactly the static path's write shape — and the big
+    cache is a read-only scan invariant. Attention spans both: the
+    committed prefix rows (``base_mask``: rows written before this
+    chunk) plus the ring's rows so far (``t' <= t``) — the same
+    (position, K/V) set the per-step scatter produced, so streams are
+    unchanged. The ring flushes to the cache once per chunk
+    (:func:`_serve_chunk`), amortizing the one unavoidable scatter over
+    the whole chunk."""
     B = token.shape[0]
     max_len = cache[0]["k"].shape[1]
+    if key is not None:
+        key, sub = jax.random.split(key)
+    else:
+        sub = None
     x = params["embed"][token][:, None, :]          # [B, 1, d]
     positions = pos[:, None]                        # per-slot rotary
-    write = jax.vmap(
-        lambda buf, val, p: jax.lax.dynamic_update_slice(
-            buf, val, (p, 0, 0)))
-    new_cache = []
-    for block, slots_ in zip(params["blocks"], cache):
+    ring_mask = jnp.arange(n_steps)[None, :] <= t   # [1, C]
+    new_ring = []
+    for block, slots_, rg in zip(params["blocks"], cache, ring):
         q, k, v = M.qkv_proj(block, x, positions)
-        ck = write(slots_["k"], k, pos)
-        cv = write(slots_["v"], v, pos)
-        new_cache.append({"k": ck, "v": cv})
-        # Per-slot decode mask: slot b attends cache rows 0..pos[b].
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
-                            preferred_element_type=jnp.float32)
-        scores = scores / jnp.sqrt(jnp.float32(q.shape[-1]))
-        mask = jnp.arange(max_len)[None, :] <= pos[:, None]   # [B, L]
-        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+        rk = jax.lax.dynamic_update_slice(rg["k"], k, (0, t, 0, 0))
+        rv = jax.lax.dynamic_update_slice(rg["v"], v, (0, t, 0, 0))
+        new_ring.append({"k": rk, "v": rv})
+        scale = 1.0 / jnp.sqrt(jnp.float32(q.shape[-1]))
+        # Slot b attends its committed prefix (cache rows < start pos,
+        # stale rows beyond masked off) + this chunk's ring rows 0..t.
+        s_main = jnp.einsum("bqhd,bkhd->bhqk", q, slots_["k"],
+                            preferred_element_type=jnp.float32) * scale
+        s_ring = jnp.einsum("bqhd,bkhd->bhqk", q, rk,
+                            preferred_element_type=jnp.float32) * scale
+        s_main = jnp.where(base_mask[:, None, None, :], s_main, -1e30)
+        s_ring = jnp.where(ring_mask[None, None, :, :], s_ring, -1e30)
+        probs = jax.nn.softmax(
+            jnp.concatenate([s_main, s_ring], axis=-1), axis=-1)
+        # Masked entries softmax to exactly 0 (exp(-1e30 - max)
+        # underflows), so stale cache rows and unwritten ring rows
+        # contribute 0 * finite = 0 — the same invariant the old
+        # full-cache mask relied on.
+        p_main = probs[..., :max_len].astype(v.dtype)
+        p_ring = probs[..., max_len:].astype(v.dtype)
+        out = (jnp.einsum("bhqk,bkhd->bqhd", p_main, slots_["v"])
+               + jnp.einsum("bhqk,bkhd->bqhd", p_ring, rv))
         x = x + M.out_proj(block, out)
         x = M.ffn_block(block, x)
     x = M.rms_norm(x[:, 0], params["final_norm"])
@@ -417,18 +454,17 @@ def _slot_decode_step(params: dict, state: dict,
         # Per-slot select (the generate() pattern, vectorized over
         # slots): both arms are trivial next to the decode matmuls.
         scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
-        sampled = jax.random.categorical(key, scaled,
+        sampled = jax.random.categorical(sub, scaled,
                                          axis=-1).astype(token.dtype)
         nxt = jnp.where(temperature > 0, sampled, greedy)
     token = jnp.where(active, nxt, token)
     emitted = jnp.where(active, token, -1)  # BEFORE self-retire: the
     # token generated at the last legal position still counts.
-    # A slot whose next write would spill past max_len self-retires
-    # (dynamic_update_slice would CLAMP and corrupt the last row).
+    # A slot whose next write would land past max_len self-retires
+    # (its flush row would be out of range).
     pos = jnp.where(active, pos + 1, pos)
     active = active & (pos < max_len)
-    return {"cache": new_cache, "pos": pos, "active": active,
-            "token": token}, emitted
+    return (pos, active, token, new_ring, key), emitted
 
 
 def serve_chunk(params: dict, state: dict, n_steps: int,
@@ -473,21 +509,358 @@ def serve_chunk(params: dict, state: dict, n_steps: int,
 def _serve_chunk(params: dict, state: dict, n_steps: int,
                  temperature: jax.Array | None,
                  key: jax.Array | None) -> tuple[dict, jax.Array]:
-    if temperature is None:
-        def step(st, _):
-            return _slot_decode_step(params, st)
+    cache, start_pos = state["cache"], state["pos"]
+    B = state["token"].shape[0]
+    max_len = cache[0]["k"].shape[1]
+    H, D = cache[0]["k"].shape[2], cache[0]["k"].shape[3]
+    # Rows COMMITTED before this chunk: the slot's prefix. Rows >=
+    # start pos are stale (a previous occupant's leavings, or garbage)
+    # and masked off; this chunk's own K/V live in the ring below.
+    base_mask = jnp.arange(max_len)[None, :] < start_pos[:, None]
+    zeros = jnp.zeros((B, n_steps, H, D), cache[0]["k"].dtype)
+    ring0 = [{"k": zeros, "v": zeros} for _ in cache]
 
-        return jax.lax.scan(step, state, None, length=n_steps)
+    def step(carry, t):
+        pos, active, token, ring, k = carry
+        return _fused_chunk_step(params, cache, base_mask, n_steps,
+                                 pos, active, token, ring, t,
+                                 temperature, k)
 
-    def step(carry, _):
-        st, k = carry
-        k, sub = jax.random.split(k)
-        st, emitted = _slot_decode_step(params, st, temperature, sub)
-        return (st, k), emitted
+    carry0 = (start_pos, state["active"], state["token"], ring0, key)
+    (pos, active, token, ring, _), emitted = jax.lax.scan(
+        step, carry0, jnp.arange(n_steps))
 
-    (state, _), emitted = jax.lax.scan(step, (state, key), None,
-                                       length=n_steps)
-    return state, emitted
+    # Flush the chunk ring into the cache: ONE scatter per layer per
+    # chunk instead of one per layer per STEP. Row b,t goes to the
+    # cache row the old per-step write used (start + t); steps where
+    # the slot was inactive (free, or self-retired mid-chunk) point at
+    # row max_len — out of range, dropped by the scatter.
+    valid = (emitted >= 0).T                          # [B, C]
+    rows = start_pos[:, None] + jnp.arange(n_steps)[None, :]
+    rows = jnp.where(valid, rows, max_len)
+    b_idx = jnp.arange(B)[:, None]
+    new_cache = [
+        {"k": slots_["k"].at[b_idx, rows].set(rg["k"], mode="drop"),
+         "v": slots_["v"].at[b_idx, rows].set(rg["v"], mode="drop")}
+        for slots_, rg in zip(cache, ring)]
+    return ({"cache": new_cache, "pos": pos, "active": active,
+             "token": token}, emitted)
+
+
+# --------------------------------------------------------------------------
+# Chunked prefill (Sarathi-style): admission sliced into decode chunks
+# --------------------------------------------------------------------------
+#
+# ``admit`` prefills the WHOLE prompt in one call: a 1024-token
+# admission stalls every running slot for the full prefill. The chunked
+# path slices the prompt into fixed-size pieces — each piece one
+# invocation of ONE compiled function (offset and slot are traced) —
+# so the driver can interleave ``serve_chunk`` steps between pieces
+# (:func:`admit_interleaved`) and an admission costs the running batch
+# a bounded pause per piece instead of the whole prompt. Chunking also
+# subsumes the per-length-compilation problem: any prompt is
+# ceil(L/chunk) calls of the same compiled piece.
+
+
+@partial(jax.jit, donate_argnums=())
+def _prefill_chunk(params: dict, state: dict, chunk_tokens: jax.Array,
+                   slot: jax.Array, offset: jax.Array,
+                   true_len: jax.Array, carry_h: jax.Array
+                   ) -> tuple[dict, jax.Array]:
+    """Prefill ONE ``[C]`` piece of a prompt into ``slot``'s cache rows
+    ``[offset, offset + C)``. ``carry_h`` accumulates the final-layer
+    hidden state at position ``true_len - 1`` (selected by the piece
+    that contains it); :func:`_finalize_admit` turns it into the first
+    token. One compilation serves every piece of every prompt: C is the
+    only static shape — slot, offset and true_len are traced."""
+    C = chunk_tokens.shape[0]
+    max_len = state["cache"][0]["k"].shape[1]
+    # Traced-slot defense, exactly _admit's: clamp so the cache writes
+    # and the later bookkeeping agree on ONE in-range slot.
+    slot = jnp.clip(jnp.asarray(slot, jnp.int32), 0,
+                    state["pos"].shape[0] - 1)
+    positions = (offset + jnp.arange(C))[None, :]
+    x = params["embed"][chunk_tokens][None, :]
+    cache = []
+    for block, slots_ in zip(params["blocks"], state["cache"]):
+        q, k, v = M.qkv_proj(block, x, positions)
+        ck_all = jax.lax.dynamic_update_slice(slots_["k"], k,
+                                              (slot, offset, 0, 0))
+        cv_all = jax.lax.dynamic_update_slice(slots_["v"], v,
+                                              (slot, offset, 0, 0))
+        cache.append({"k": ck_all, "v": cv_all})
+        # The piece attends the slot's cache — earlier pieces' rows
+        # plus its own, causally (q_offset does the masking; stale
+        # rows beyond the piece are kv_pos > q_pos, masked). The score
+        # block is [C, max_len] — already streaming-sized, so the
+        # flash hook whole-prompt admit offers is unnecessary here.
+        ck = jax.lax.dynamic_slice(
+            ck_all, (slot, 0, 0, 0), (1,) + ck_all.shape[1:])
+        cv = jax.lax.dynamic_slice(
+            cv_all, (slot, 0, 0, 0), (1,) + cv_all.shape[1:])
+        out = M.causal_attention(q, ck, cv, q_offset=offset)
+        x = x + M.out_proj(block, out)
+        x = M.ffn_block(block, x)
+    idx = true_len - 1 - offset
+    inside = (idx >= 0) & (idx < C)
+    h = jax.lax.dynamic_index_in_dim(x[0], jnp.clip(idx, 0, C - 1),
+                                     axis=0, keepdims=False)
+    carry_h = jnp.where(inside, h, carry_h)
+    return dict(state, cache=cache), carry_h
+
+
+@jax.jit
+def _finalize_admit(params: dict, state: dict, slot: jax.Array,
+                    true_len: jax.Array, carry_h: jax.Array,
+                    temperature: jax.Array, key: jax.Array) -> dict:
+    """_admit's tail for the chunked path: first token from the
+    carried hidden state, slot bookkeeping flipped active. Same
+    traced-input defenses: slot clamped, a no-decode-room true_len
+    admits INERT rather than corrupting row max_len - 1."""
+    max_len = state["cache"][0]["k"].shape[1]
+    slot = jnp.clip(jnp.asarray(slot, jnp.int32), 0,
+                    state["pos"].shape[0] - 1)
+    true_len = jnp.clip(true_len, 1, max_len)
+    has_room = true_len < max_len
+    h = M.rms_norm(carry_h[None, :], params["final_norm"])
+    logits = (h @ params["embed"].T).astype(jnp.float32)
+    greedy = jnp.argmax(logits[0], axis=-1)
+    sampled = jax.random.categorical(
+        key, logits[0] / jnp.maximum(temperature, 1e-6), axis=-1)
+    first = jnp.where(temperature > 0, sampled,
+                      greedy).astype(state["token"].dtype)
+    return {
+        "cache": state["cache"],
+        "pos": state["pos"].at[slot].set(true_len),
+        "active": state["active"].at[slot].set(has_room),
+        "token": state["token"].at[slot].set(first),
+    }
+
+
+def _chunk_plan(prompt: jax.Array, chunk: int, max_len: int, slots: int,
+                slot: jax.Array, true_len: jax.Array | None,
+                temperature, key: jax.Array | None
+                ) -> tuple[jax.Array, jax.Array, int, jax.Array]:
+    """Shared validation + padding for the chunked admission paths.
+    Returns (padded prompt, true_len, n_pieces, key) after admit()'s
+    concrete-boundary checks."""
+    if not isinstance(chunk, int) or chunk <= 0:
+        raise ValueError(f"chunk must be a positive int, got {chunk!r}")
+    Lp = prompt.shape[0]
+    if not isinstance(slot, jax.core.Tracer):
+        s = int(slot)
+        if not 0 <= s < slots:
+            raise ValueError(
+                f"slot {s} outside [0, {slots}) — an out-of-range slot "
+                f"would silently corrupt slot {slots - 1}'s cache")
+    if Lp > max_len:
+        raise ValueError(
+            f"prompt length {Lp} exceeds cache max_len {max_len}")
+    if true_len is None and Lp >= max_len:
+        raise ValueError(
+            f"prompt length {Lp} leaves no decode room in cache "
+            f"max_len {max_len} (need Lp < max_len, or pass true_len)")
+    if true_len is not None and not isinstance(true_len,
+                                               jax.core.Tracer):
+        tl = int(true_len)
+        if not 1 <= tl <= Lp:
+            raise ValueError(
+                f"true_len {tl} outside [1, {Lp}] (the prompt's "
+                f"length) — a clamped index would silently corrupt "
+                f"the stream")
+        if tl >= max_len:
+            raise ValueError(
+                f"true_len {tl} leaves no decode room in cache "
+                f"max_len {max_len}")
+    if isinstance(temperature, jax.core.Tracer):
+        if key is None:
+            raise ValueError(
+                "traced temperature requires an explicit PRNG key")
+    else:
+        if temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature} "
+                "(a negative value would silently mean greedy)")
+        if temperature > 0 and key is None:
+            raise ValueError(
+                "temperature > 0 requires an explicit PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused by the greedy branch
+    if true_len is None:
+        true_len = jnp.int32(Lp)
+    n_pieces = -(-Lp // chunk)
+    Lpad = n_pieces * chunk
+    if Lpad > max_len:
+        raise ValueError(
+            f"prompt length {Lp} padded to {Lpad} (chunk {chunk}) "
+            f"exceeds cache max_len {max_len} — pick a chunk size "
+            f"dividing max_len")
+    if Lpad == Lp:
+        padded = prompt
+    else:
+        padded = jnp.concatenate(
+            [prompt, jnp.zeros((Lpad - Lp,), prompt.dtype)])
+    return padded, jnp.asarray(true_len, jnp.int32), n_pieces, key
+
+
+def admit_chunked(params: dict, state: dict, prompt: jax.Array,
+                  slot: jax.Array, *, chunk: int = 64,
+                  true_len: jax.Array | None = None,
+                  temperature: float = 0.0,
+                  key: jax.Array | None = None) -> dict:
+    """``admit``, sliced: prefill ``prompt`` into ``slot`` in
+    ``chunk``-token pieces. The output state — and the slot's whole
+    subsequent stream — matches whole-prompt ``admit`` (same math, same
+    (position, K/V) sets; tests pin token-exactness). End-padding to a
+    multiple of ``chunk`` is safe by admit's bucket argument: pads are
+    causally invisible and ``pos`` starts at ``true_len``."""
+    max_len = state["cache"][0]["k"].shape[1]
+    slots = state["pos"].shape[0]
+    padded, true_len, n_pieces, key = _chunk_plan(
+        prompt, chunk, max_len, slots, slot, true_len, temperature, key)
+    carry = jnp.zeros((params["embed"].shape[1],),
+                      params["embed"].dtype)
+    for i in range(n_pieces):
+        state, carry = _prefill_chunk(
+            params, state, padded[i * chunk:(i + 1) * chunk],
+            jnp.asarray(slot, jnp.int32), jnp.int32(i * chunk),
+            true_len, carry)
+    return _finalize_admit(params, state, jnp.asarray(slot, jnp.int32),
+                           true_len, carry, jnp.float32(temperature),
+                           key)
+
+
+def admit_interleaved(params: dict, state: dict, prompt: jax.Array,
+                      slot: jax.Array, *, chunk: int = 64,
+                      decode_steps: int = 8,
+                      true_len: jax.Array | None = None,
+                      temperature: float = 0.0,
+                      key: jax.Array | None = None,
+                      serve_temperature: jax.Array | None = None,
+                      serve_key: jax.Array | None = None
+                      ) -> tuple[dict, jax.Array]:
+    """Admission that does NOT stall the running batch: each prefill
+    piece is followed by ``decode_steps`` tokens of ``serve_chunk`` for
+    the slots already in flight, so a long prompt's admission costs
+    co-tenants a bounded pause per piece instead of the whole prefill.
+
+    Returns ``(state, emitted)`` — emitted ``[n_pieces * decode_steps,
+    SLOTS]`` stacks the interleaved decode output (the admitted slot is
+    inactive until its finalize, so its column is all -1). Existing
+    slots' streams are bit-identical to an undisturbed run (the prefill
+    writes only the admitted slot's cache rows; tests pin it)."""
+    max_len = state["cache"][0]["k"].shape[1]
+    slots = state["pos"].shape[0]
+    padded, true_len, n_pieces, key = _chunk_plan(
+        prompt, chunk, max_len, slots, slot, true_len, temperature, key)
+    carry = jnp.zeros((params["embed"].shape[1],),
+                      params["embed"].dtype)
+    emitted = []
+    for i in range(n_pieces):
+        state, carry = _prefill_chunk(
+            params, state, padded[i * chunk:(i + 1) * chunk],
+            jnp.asarray(slot, jnp.int32), jnp.int32(i * chunk),
+            true_len, carry)
+        if decode_steps > 0:
+            if serve_key is not None:
+                serve_key, sub = jax.random.split(serve_key)
+            else:
+                sub = None
+            state, em = serve_chunk(params, state, decode_steps,
+                                    temperature=serve_temperature,
+                                    key=sub)
+            emitted.append(em)
+    state = _finalize_admit(params, state, jnp.asarray(slot, jnp.int32),
+                            true_len, carry, jnp.float32(temperature),
+                            key)
+    if emitted:
+        out = jnp.concatenate(emitted, axis=0)
+    else:
+        out = jnp.zeros((0, slots), jnp.int32)
+    return state, out
+
+
+# --------------------------------------------------------------------------
+# Bucketed admission (+ jit-cache accounting)
+# --------------------------------------------------------------------------
+
+#: Default admission buckets: distinct prompt lengths each compile
+#: ``_admit`` once; padding up to a bucket makes every prompt <= 2048
+#: reuse one of these 7 shapes. Powers of two keep the padded-FLOPs
+#: waste under 2x while the compile count stays O(len(buckets)).
+PROMPT_BUCKETS: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048)
+
+#: bucket length -> {"admits": n, "jitMisses": n} — the proof the
+#: bucketing works: after warmup every admission is a jit cache HIT
+#: (misses stay flat). Single-writer by design: the slot-server driver
+#: loop owns admissions; surfaced via :func:`admission_stats`.
+_ADMISSION_STATS: dict[int, dict[str, int]] = {}
+
+
+def bucket_len(n: int, buckets: tuple[int, ...] = PROMPT_BUCKETS,
+               max_len: int | None = None) -> int:
+    """Smallest bucket >= ``n`` (the compiled shape the admission will
+    reuse), capped at ``max_len`` when given — padding past the cache
+    is illegal, but padding TO it is fine (admit's true_len contract),
+    so a prompt whose bucket overshoots the cache pads to max_len
+    exactly. Raises when the prompt exceeds every bucket or the cache
+    itself (capping would return a bucket SMALLER than the prompt and
+    hand pad_to_bucket a negative pad width)."""
+    if max_len is not None and n > max_len:
+        raise ValueError(
+            f"prompt length {n} exceeds cache max_len {max_len}")
+    for b in sorted(buckets):
+        if b >= n:
+            return b if max_len is None else min(b, max_len)
+    raise ValueError(
+        f"prompt length {n} exceeds the largest admission bucket "
+        f"{max(buckets)}")
+
+
+def pad_to_bucket(prompt: jax.Array,
+                  buckets: tuple[int, ...] = PROMPT_BUCKETS,
+                  max_len: int | None = None
+                  ) -> tuple[jax.Array, jax.Array]:
+    """(padded prompt, true_len) for :func:`admit`'s bucket contract."""
+    n = prompt.shape[0]
+    b = bucket_len(n, buckets, max_len)
+    if b == n:
+        return prompt, jnp.int32(n)
+    return (jnp.concatenate([prompt, jnp.zeros((b - n,), prompt.dtype)]),
+            jnp.int32(n))
+
+
+def admit_bucketed(params: dict, state: dict, prompt: jax.Array,
+                   slot: jax.Array, *,
+                   buckets: tuple[int, ...] = PROMPT_BUCKETS,
+                   attn_fn=None, temperature: float = 0.0,
+                   key: jax.Array | None = None) -> dict:
+    """``admit`` through the bucket table: pad to the bucket, pass the
+    real length as ``true_len``, and account the jit cache outcome —
+    the counter that PROVES admissions reuse compiled shapes instead of
+    paying a per-length retrace (bench_decode_continuous reports it)."""
+    max_len = state["cache"][0]["k"].shape[1]
+    padded, tl = pad_to_bucket(prompt, buckets, max_len)
+    before = _admit._cache_size()
+    out = admit(params, state, padded, slot, attn_fn=attn_fn,
+                true_len=tl, temperature=temperature, key=key)
+    entry = _ADMISSION_STATS.setdefault(
+        int(padded.shape[0]), {"admits": 0, "jitMisses": 0})
+    entry["admits"] += 1
+    if _admit._cache_size() > before:
+        entry["jitMisses"] += 1
+    return out
+
+
+def admission_stats() -> dict[int, dict[str, int]]:
+    """Per-bucket admission counts with derived hits:
+    ``{bucket: {admits, jitMisses, jitHits}}``."""
+    return {b: dict(e, jitHits=e["admits"] - e["jitMisses"])
+            for b, e in sorted(_ADMISSION_STATS.items())}
+
+
+def reset_admission_stats() -> None:
+    _ADMISSION_STATS.clear()
 
 
 def max_batch_for_grant(cfg: M.ModelConfig, grant_hbm_gib: float,
