@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-workload bench-router all
+.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-topo bench-workload bench-router all
 
 # CPU test suite (virtual 8-device mesh; kernels in interpreter mode).
 test:
@@ -55,6 +55,13 @@ bench:
 # docs/perf.md hot-path budget.
 bench-scale:
 	python bench.py --scale --gate
+
+# Topology-aware gang placement: the contiguous-vs-scattered proof on
+# a 4x4x4 host torus, priced by the ring-latency model and gated
+# (contiguous >= 15% lower predicted step time; placer ring
+# contiguity 1.0). Writes BENCH_TOPO_r01.json (docs/topology.md).
+bench-topo:
+	python bench.py --topology --gate
 
 # On-chip workload perf: flash-vs-XLA attention + flagship MFU, with
 # regression gates — REQUIRES real TPU hardware (chipcheck's perf twin).

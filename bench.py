@@ -121,7 +121,8 @@ class _Fleet:
 
     def __init__(self, prefix: str, nodes: int,
                  chips: int = CHIPS, chip_hbm: int = CHIP_HBM,
-                 topology: str = "2x2x1", tpu_type: str = "v5p"):
+                 topology: str = "2x2x1", tpu_type: str = "v5p",
+                 slice_id: str = "", slice_topology: str = ""):
         from tpushare.cmd.main import build_stack
         from tpushare.k8s.builders import make_node
         from tpushare.k8s.fake import FakeApiServer
@@ -129,11 +130,15 @@ class _Fleet:
 
         self.api = FakeApiServer()
         self.names = [f"{prefix}-{i:02d}" for i in range(nodes)]
-        for n in self.names:
-            self.api.create_node(make_node(n, chips=chips,
-                                           hbm_per_chip=chip_hbm,
-                                           topology=topology,
-                                           tpu_type=tpu_type))
+        for i, n in enumerate(self.names):
+            self.api.create_node(make_node(
+                n, chips=chips, hbm_per_chip=chip_hbm,
+                topology=topology, tpu_type=tpu_type,
+                # Multi-host slice labels (the --topology scenario):
+                # every host carries its slice id, the slice's chip
+                # dims, and its worker index on the host grid.
+                slice_id=slice_id, slice_topology=slice_topology,
+                worker_index=i if slice_topology else None))
         # build_stack reads the fleet scoring default from env ONCE at
         # construction and pins it through the cache into every ledger
         # — callers needing a non-default policy export TPUSHARE_SCORING
@@ -621,6 +626,230 @@ def bench_gang_preempt(hosts: int = 4) -> tuple[float, int]:
     assert len(placed) == hosts, f"gang landed on {len(placed)} hosts"
     fleet.close()
     return dt, evicted
+
+
+# ------------------------------------------------------------------------- #
+# --topology: contiguous slices on the ICI torus (docs/topology.md)
+# ------------------------------------------------------------------------- #
+
+#: A 64-host v5p pod slice: 8x8x4 chips of 2x2x1 hosts = a 4x4x4 host
+#: torus (every slice dim >= 4, so the host grid wraps).
+TOPO_HOSTS = 64
+TOPO_SLICE_TOPOLOGY = "8x8x4"
+#: The gang under test: a pp=4 x sp=4 mesh, one whole host per worker.
+TOPO_GANG = 16
+TOPO_PP, TOPO_SP = 4, 4
+#: Requested sub-slice (chip dims): 4x4x4 = a 2x2x4 host block.
+TOPO_SLICE_SHAPE = "4x4x4"
+#: Gate: the placer's contiguous placement must predict a step time at
+#: least this much lower than the topology-blind placement of the SAME
+#: gang on the SAME fragmented fleet (ring-latency model,
+#: tpushare/workload/parallel.py).
+GATE_TOPO_STEP_GAIN = 0.15
+
+
+def _topo_block_indices() -> list[int]:
+    """Worker indices of the one contiguous 2x2x4 host block the
+    occupancy pattern keeps free: coords x,y in {2,3}, z in 0..3 —
+    deliberately in the HIGH name range, because the topology-blind
+    baseline binds to the first (lowest-named) filter candidates and
+    must not stumble into the block by accident."""
+    return sorted((x * 4 + y) * 4 + z
+                  for x in (2, 3) for y in (2, 3) for z in range(4))
+
+
+def _bench_topology_once(mode: str, seed: int = 13) -> dict:
+    """Fragment a 64-host slice (one contiguous block + 16 scattered
+    hosts free), schedule the 16-worker pp x sp gang through the real
+    wire protocol, and price the resulting placement with the
+    ring-latency model. Modes:
+
+    * ``placer``   — slice-shape annotation + filter -> prioritize ->
+      bind: election + steering, the full feature.
+    * ``scored``   — NO slice-shape, same scored wire dance: exactly
+      what production does with TPUSHARE_TOPOLOGY=off (prioritize's
+      slice-affinity term still runs) — the honest baseline the gate
+      compares against.
+    * ``first-fit`` — NO slice-shape, filter -> bind to the first
+      candidate: a scheduler with no extender prioritize verb at all
+      (the historical bench's "unscored" strawman, reported for
+      context, never gated)."""
+    from tpushare.api.objects import Node
+    from tpushare.k8s.builders import make_pod
+    from tpushare.topology import fleet as topo
+    from tpushare.utils import const
+    from tpushare.utils import node as nodeutils
+    from tpushare.workload import parallel as PL
+
+    rng = random.Random(seed)
+    fleet = _Fleet("tp", TOPO_HOSTS, slice_id="pod-a",
+                   slice_topology=TOPO_SLICE_TOPOLOGY)
+    api, client, names = fleet.api, fleet.client, fleet.names
+    block = set(_topo_block_indices())
+    scattered_free = set(rng.sample(range(40), 16))
+    free = block | scattered_free
+    for i, name in enumerate(names):
+        if i in free:
+            continue
+        filler = api.create_pod(make_pod(f"fill-{i:02d}", hbm=CHIP_HBM))
+        status, result = client.post("/tpushare-scheduler/bind", {
+            "PodName": filler.name, "PodNamespace": "default",
+            "PodUID": filler.uid, "Node": name})
+        assert status == 200 and not result.get("Error"), result
+    fleet.stack.controller.wait_idle(timeout=30)
+
+    ann = {const.ANN_POD_GROUP: "mesh",
+           const.ANN_POD_GROUP_MIN: str(TOPO_GANG)}
+    if mode == "placer":
+        ann[const.ANN_SLICE_SHAPE] = TOPO_SLICE_SHAPE
+    lat = []
+    for i in range(TOPO_GANG):
+        pod = api.create_pod(make_pod(f"w-{i:02d}", chips=CHIPS,
+                                      annotations=ann))
+        t0 = time.perf_counter()
+        status, result = client.post("/tpushare-scheduler/filter",
+                                     {"Pod": pod.raw, "NodeNames": names})
+        assert status == 200, result
+        cands = result["NodeNames"]
+        assert cands, result["FailedNodes"]
+        if mode in ("placer", "scored"):
+            status, ranked = client.post(
+                "/tpushare-scheduler/prioritize",
+                {"Pod": pod.raw, "NodeNames": cands})
+            assert status == 200, ranked
+            best = max(ranked, key=lambda e: e["Score"])["Host"]
+        else:
+            best = cands[0]
+        client.post("/tpushare-scheduler/bind", {
+            "PodName": pod.name, "PodNamespace": "default",
+            "PodUID": pod.uid, "Node": best})
+        lat.append((time.perf_counter() - t0) * 1e3)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if all(api.get_pod("default", f"w-{i:02d}").node_name
+               for i in range(TOPO_GANG)):
+            break
+        time.sleep(0.0005)
+
+    # -- price the placement (worker order = pod ordinal order) ------- #
+    coords: list[tuple[int, ...] | None] = []
+    grid = None
+    hosts = []
+    for i in range(TOPO_GANG):
+        node_name = api.get_pod("default", f"w-{i:02d}").node_name
+        assert node_name, f"member w-{i:02d} never bound"
+        hosts.append(node_name)
+        pos = nodeutils.host_position(
+            Node(api.get_node(node_name).raw))
+        if pos is None:
+            coords.append(None)
+        else:
+            coords.append(pos[0])
+            grid = grid or pos[1]
+    stats = topo.ring_stats(coords, grid)
+    # pp x sp decomposition: stage s = workers [s*sp, (s+1)*sp); the
+    # sp ring rotates KV blocks within a stage, the pp boundary sends
+    # activations between same-rank workers of adjacent stages.
+    sp_rings = []
+    for s in range(TOPO_PP):
+        ring = coords[s * TOPO_SP:(s + 1) * TOPO_SP]
+        sp_rings.append(topo.ring_hops(ring, grid))
+    pp_links: list[int | None] = []
+    for s in range(TOPO_PP - 1):
+        hops = [None if (coords[s * TOPO_SP + j] is None
+                         or coords[(s + 1) * TOPO_SP + j] is None
+                         or grid is None)
+                else grid.distance_coords(coords[s * TOPO_SP + j],
+                                          coords[(s + 1) * TOPO_SP + j])
+                for j in range(TOPO_SP)]
+        pp_links.append(max((h for h in hops if h is not None),
+                            default=None)
+                        if all(h is not None for h in hops) else None)
+    step_ms = PL.predicted_step_time_ms(sp_rings, pp_links)
+    fleet.close()
+    lat.sort()
+    return {
+        "hosts": hosts,
+        "coords": [list(c) if c is not None else None for c in coords],
+        "ring_contiguity": stats["contiguity"],
+        "worst_hop": stats["worstHop"],
+        "predicted_step_ms": round(step_ms, 3),
+        "p50_member_schedule_ms": round(statistics.median(lat), 3),
+    }
+
+
+def bench_topology() -> dict:
+    """The contiguous-vs-scattered proof: same gang, same fragmented
+    fleet, three placement modes. Deterministic (seeded occupancy, no
+    churn), so one run per mode is the whole story. The GATED gain is
+    placer-vs-scored — the honest baseline (prioritize still runs,
+    exactly production with TPUSHARE_TOPOLOGY=off); first-fit (no
+    prioritize verb at all) is reported for context only."""
+    placer = _bench_topology_once("placer")
+    scored = _bench_topology_once("scored")
+    first_fit = _bench_topology_once("first-fit")
+    gain = (scored["predicted_step_ms"] / placer["predicted_step_ms"]
+            - 1.0) if placer["predicted_step_ms"] else 0.0
+    ff_gain = (first_fit["predicted_step_ms"]
+               / placer["predicted_step_ms"] - 1.0) \
+        if placer["predicted_step_ms"] else 0.0
+    return {
+        "contiguous": placer,
+        "scattered": scored,
+        "first_fit": first_fit,
+        "predicted_step_gain": round(gain, 4),
+        "predicted_step_gain_vs_first_fit": round(ff_gain, 4),
+    }
+
+
+def main_topology(smoke: bool) -> None:
+    """``--topology``: multi-host pp/sp gang over a 4x4x4 host torus,
+    contiguous (placer) vs scattered (topology-blind) placements priced
+    by the ring-latency model. Prints ONE JSON line; the full run
+    writes BENCH_TOPO_r01.json. ``--gate`` fails the run unless the
+    contiguous placement predicts >= 15% lower step time."""
+    import logging
+    import os
+    import sys
+
+    logging.disable(logging.WARNING)
+    result = bench_topology()
+    gates = {
+        "predicted_step_gain": {
+            "value": result["predicted_step_gain"],
+            "limit": GATE_TOPO_STEP_GAIN,
+            "pass": result["predicted_step_gain"] >= GATE_TOPO_STEP_GAIN},
+        "placer_ring_contiguity": {
+            "value": result["contiguous"]["ring_contiguity"],
+            # The kept-free block is perfectly contiguous; electing
+            # anything less is a placer regression, not weather.
+            "limit": 1.0,
+            "pass": result["contiguous"]["ring_contiguity"] >= 1.0},
+    }
+    doc = {
+        "metric": "topology_predicted_step_gain",
+        "value": result["predicted_step_gain"],
+        "unit": "fraction",
+        "vs_baseline": (round(result["predicted_step_gain"]
+                              / GATE_TOPO_STEP_GAIN, 4)
+                        if GATE_TOPO_STEP_GAIN else None),
+        "smoke": smoke,
+        "hosts": TOPO_HOSTS,
+        "gang": TOPO_GANG,
+        "slice_shape": TOPO_SLICE_SHAPE,
+        "gates": gates,
+        **result,
+    }
+    line = json.dumps(doc)
+    print(line)
+    if not smoke:
+        root = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(root, "BENCH_TOPO_r01.json"), "w",
+                  encoding="utf-8") as f:
+            f.write(line + "\n")
+    if "--gate" in sys.argv and not all(g["pass"]
+                                        for g in gates.values()):
+        sys.exit(1)
 
 
 # ------------------------------------------------------------------------- #
@@ -1133,5 +1362,9 @@ if __name__ == "__main__":
         # The 1k-node scenario is its own mode: the historical 16-node
         # bench keeps its one-line contract untouched.
         main_scale(smoke="--smoke" in _sys.argv)
+    elif "--topology" in _sys.argv:
+        # Contiguous-slice placement on the ICI torus, priced by the
+        # workload-side ring-latency model (docs/topology.md).
+        main_topology(smoke="--smoke" in _sys.argv)
     else:
         main()

@@ -288,6 +288,47 @@ if __name__ == "__main__":
 
 
 SERVING_MD = os.path.join(REPO_ROOT, "docs", "serving.md")
+TOPOLOGY_MD = os.path.join(REPO_ROOT, "docs", "topology.md")
+
+
+def test_topology_doc_covers_the_contract():
+    """docs/topology.md is the topology-placement contract: it must
+    keep naming the node/pod annotation schema, the torus/host-grid
+    model, the election + steering mechanics with their fallback
+    semantics, the ring repair, the latency model, every surface, the
+    gated bench, and a runbook."""
+    with open(TOPOLOGY_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("tpushare.io/slice-shape", "tpushare.io/slice-id",
+                   "tpushare.io/slice-topology",
+                   "tpushare.io/worker-index", "host grid", "torus",
+                   "ring contiguity", "snake", "worker order",
+                   "SlicePlacer", "quorum", "Memoization",
+                   "NodeSummary", "hotpath_budget.json",
+                   "topology-fallback", "TPUSHARE_TOPOLOGY",
+                   "ring repair", "ring-repair", "hop_time_us",
+                   "predicted_step_time_ms", "compute_ms",
+                   "kubectl inspect tpushare topology",
+                   "--example-topology", "topology_compare",
+                   "bench.py --topology", "make bench-topo",
+                   "BENCH_TOPO_r01.json", "15%", "Runbook"):
+        assert needle in doc, needle
+    topo_metrics = [n for n in registered_metric_names()
+                    if "topology" in n or "ring_contiguity" in n]
+    assert len(topo_metrics) >= 2
+    missing = [n for n in topo_metrics if n not in doc]
+    assert not missing, (
+        f"topology metrics absent from docs/topology.md: {missing}")
+
+
+def test_topology_doc_is_linked():
+    """observability.md (the catalogue), the README, and the user
+    guide must keep pointing at the topology contract."""
+    for path in (OBSERVABILITY_MD,
+                 os.path.join(REPO_ROOT, "README.md"),
+                 os.path.join(REPO_ROOT, "docs", "userguide.md")):
+        with open(path, encoding="utf-8") as f:
+            assert "topology.md" in f.read(), path
 
 
 def test_serving_doc_covers_the_contract():

@@ -200,6 +200,43 @@ class TestAdmissionSummaries:
             stack.binder.gang_planner.stop()
             stack.controller.stop()
 
+    def test_select_compact_memo_matches_direct(self):
+        """The compact-selection memo (NodeInfo.select_compact_cached,
+        keyed on summary identity like the admit/score memos) must
+        agree with a direct Topology.select_compact recompute across
+        random fleet states and every k — and must re-select after any
+        ledger mutation republishes the summary."""
+        for seed in (3, 17):
+            api, stack, names, rng = self._random_fleet(seed)
+            cache = stack.controller.cache
+            try:
+                for name in names:
+                    info = cache.get_node_info(name)
+                    s = info.summary()
+                    for k in (1, 2, 3, 4):
+                        fast = info.select_compact_cached(s, k)
+                        direct = info.topology.select_compact(
+                            list(s.free_chips), k)
+                        assert fast == direct, (name, k)
+                        # a hit returns the cached object itself
+                        assert info.select_compact_cached(s, k) is fast
+                # Mutate one node: its memo must re-select.
+                target = next(n for n in names
+                              if len(cache.get_node_info(n)
+                                     .get_free_chips()) >= 1)
+                info = cache.get_node_info(target)
+                before = info.select_compact_cached(info.summary(), 1)
+                pod = api.create_pod(make_pod(f"cm-{seed}", hbm=2))
+                info.allocate(api, pod)
+                s2 = info.summary()
+                after = info.select_compact_cached(s2, 1)
+                assert after == info.topology.select_compact(
+                    list(s2.free_chips), 1)
+                assert before is not after or before == after
+            finally:
+                stack.binder.gang_planner.stop()
+                stack.controller.stop()
+
     def test_summary_invalidated_by_allocate_and_remove(self, api):
         from tpushare.cache.cache import SchedulerCache
 

@@ -340,6 +340,39 @@ class TestSimulator:
         assert len(ring) == 4
         assert sum(1 for p in ring if p.get("via") == "gang commit") == 3
 
+    def test_example_topology_placer_beats_blind(self):
+        """--example-topology: the placer-on replay lands the pp-gang
+        on the only free contiguous block (which crosses the torus
+        wrap) at ring contiguity 1.0; the placer-off replay of the
+        SAME scenario scatters the ring and the ring-latency model
+        prices it measurably slower."""
+        import os
+
+        import simulate
+        import yaml
+
+        scenario = yaml.safe_load(simulate.EXAMPLE_TOPOLOGY)
+        report = self._run(scenario)
+        assert report["topology"], report.get("unschedulable_pods")
+        ring = report["topology"][0]
+        assert ring["gang"] == "pp-ring"
+        assert ring["ringContiguity"] == 1.0
+        assert ring["worstHop"] == 1
+        saved = os.environ.get("TPUSHARE_TOPOLOGY")
+        os.environ["TPUSHARE_TOPOLOGY"] = "off"
+        try:
+            blind = self._run(scenario)
+        finally:
+            if saved is None:
+                os.environ.pop("TPUSHARE_TOPOLOGY", None)
+            else:
+                os.environ["TPUSHARE_TOPOLOGY"] = saved
+        assert blind["topology"], blind.get("unschedulable_pods")
+        blind_ring = blind["topology"][0]
+        assert blind_ring["ringContiguity"] < 1.0
+        assert blind_ring["predictedStepMs"] > \
+            ring["predictedStepMs"] * 1.15
+
     def test_execute_preemptions_places_priority_gang(self):
         """execute_preemptions: the offline dry-run of the round-5
         gang×preemption composition — a priority-5 whole-host gang of 2

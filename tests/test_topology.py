@@ -1,4 +1,5 @@
-"""Topology model tests: coordinates, ICI distance, compact selection."""
+"""Topology model tests: coordinates, ICI distance, compact selection,
+fleet host grids, and the contiguous slice placer (docs/topology.md)."""
 
 import pytest
 
@@ -148,3 +149,691 @@ class TestSliceHostGrid:
         node = Node(make_node("w", slice_id="s", slice_topology="8x8"))
         assert nodeutils.get_worker_index(node) is None
         assert nodeutils.host_position(node) is None
+
+
+class TestCompactTieBreaking:
+    def test_tie_break_is_deterministic_lowest_indices(self):
+        """Every adjacent pair on a 2x2 ties at dispersion 1; the greedy
+        seed order must keep the choice stable (lowest indices win), so
+        repeated prioritize calls and the memoized fast path can never
+        disagree about 'the' compact selection."""
+        t = Topology.from_spec("2x2")
+        assert t.select_compact([0, 1, 2, 3], 2) == [0, 1]
+        assert t.select_compact([3, 2, 1, 0], 2) == [0, 1]
+
+    def test_degenerate_1d_fallback(self):
+        """Hosts with unknown wiring degrade to a flat line: compact
+        selection still works and prefers the tightest run."""
+        t = Topology.flat(4)
+        assert t.select_compact([0, 2, 3], 2) == [2, 3]
+        assert t.select_compact([0, 1, 2, 3], 4) == [0, 1, 2, 3]
+        assert t.select_compact([1], 2) is None
+
+
+class TestSliceShapeAnnotation:
+    def test_parse(self):
+        from tests.conftest import make_pod
+        from tpushare.api.objects import Pod
+        from tpushare.utils import const
+        from tpushare.utils import pod as podutils
+
+        pod = Pod(make_pod("w", chips=4,
+                           annotations={const.ANN_SLICE_SHAPE: "4x4x2"}))
+        assert podutils.get_slice_shape(pod) == (4, 4, 2)
+
+    @pytest.mark.parametrize("bad", ["", "0x2", "2x-1", "axb", "4x"])
+    def test_malformed_is_absent_not_fatal(self, bad):
+        """A typo in the annotation must degrade to topology-blind
+        placement, never break the bind path."""
+        from tests.conftest import make_pod
+        from tpushare.api.objects import Pod
+        from tpushare.utils import const
+        from tpushare.utils import pod as podutils
+
+        ann = {const.ANN_SLICE_SHAPE: bad} if bad else {}
+        pod = Pod(make_pod("w", chips=4, annotations=ann))
+        assert podutils.get_slice_shape(pod) is None
+
+
+def _slice_cache(api, hosts=8, slice_topology="4x4x2", prefix="h",
+                 chips=4, hbm=95):
+    """A warm SchedulerCache over one multi-host v5p slice."""
+    from tests.conftest import make_node
+    from tpushare.cache.cache import SchedulerCache
+
+    for i in range(hosts):
+        api.create_node(make_node(f"{prefix}-{i:02d}", chips=chips,
+                                  hbm_per_chip=hbm, topology="2x2x1",
+                                  tpu_type="v5p", slice_id="pod-a",
+                                  slice_topology=slice_topology,
+                                  worker_index=i))
+    cache = SchedulerCache(api.get_node, api.list_pods)
+    for i in range(hosts):
+        cache.get_node_info(f"{prefix}-{i:02d}")
+    return cache
+
+
+class TestHostGridFleet:
+    def test_build_host_grids_locates_every_host(self, api):
+        from tpushare.topology import fleet
+
+        cache = _slice_cache(api)
+        grids = fleet.build_host_grids(list(cache.node_table().values()))
+        assert set(grids) == {"pod-a"}
+        hg = grids["pod-a"]
+        assert hg.grid.dims == (2, 2, 2)
+        assert hg.host_dims == (2, 2, 1)
+        assert len(hg.hosts) == 8
+        assert hg.hosts[(0, 0, 0)] == "h-00"
+
+    def test_hostgrid_distance_wraps_on_torus(self, api):
+        """A 4x4x4-chip v5p slice of 2x2x1 hosts is a 2x2x4 host grid
+        whose z axis wraps: hosts z=0 and z=3 are ONE hop apart."""
+        from tpushare.topology import fleet
+
+        cache = _slice_cache(api, hosts=16, slice_topology="4x4x4")
+        hg = fleet.build_host_grids(
+            list(cache.node_table().values()))["pod-a"]
+        assert hg.grid.torus
+        assert hg.distance((0, 0, 0), (0, 0, 3)) == 1
+        assert hg.distance((0, 0, 0), (0, 0, 2)) == 2
+        assert hg.distance((0, 0, 0), (1, 1, 3)) == 3
+
+    def test_unlabelled_nodes_are_skipped(self, api):
+        from tests.conftest import make_node
+        from tpushare.cache.cache import SchedulerCache
+        from tpushare.topology import fleet
+
+        api.create_node(make_node("lone", chips=4))
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        cache.get_node_info("lone")
+        assert fleet.build_host_grids(
+            list(cache.node_table().values())) == {}
+
+
+class TestSnakeAndBlocks:
+    def test_snake_order_is_grid_adjacent(self):
+        from tpushare.topology import fleet
+
+        for dims in [(2, 2, 2), (2, 2, 4), (1, 2, 4), (4,)]:
+            walk = fleet.snake_order(dims)
+            n = 1
+            for d in dims:
+                n *= d
+            assert len(walk) == n and len(set(walk)) == n
+            for a, b in zip(walk, walk[1:]):
+                assert sum(abs(x - y) for x, y in zip(a, b)) == 1, (
+                    dims, a, b)
+
+    def test_host_block_divides_chip_shape(self):
+        from tpushare.topology import fleet
+
+        assert fleet.host_block((4, 4, 4), (2, 2, 1)) == (2, 2, 4)
+        assert fleet.host_block((4, 4), (2, 2)) == (2, 2)
+        assert fleet.host_block((3, 4), (2, 2)) is None  # no tiling
+        assert fleet.host_block((4,), (2, 2)) is None    # too few dims
+
+    def test_ring_stats_contiguity(self):
+        from tpushare.topology import fleet
+
+        grid = Topology(dims=(2, 2, 2))
+        perfect = [(0, 0, 0), (0, 0, 1), (0, 1, 1), (0, 1, 0),
+                   (1, 1, 0), (1, 1, 1), (1, 0, 1), (1, 0, 0)]
+        s = fleet.ring_stats(perfect, grid)
+        assert s["contiguity"] == 1.0 and s["worstHop"] == 1
+        scattered = [(0, 0, 0), (1, 1, 1), (0, 0, 1), (1, 1, 0)]
+        s2 = fleet.ring_stats(scattered, grid)
+        assert s2["contiguity"] < 1.0 and s2["worstHop"] == 3
+
+    def test_ring_stats_dcn_hops(self):
+        from tpushare.topology import fleet
+
+        grid = Topology(dims=(2, 2))
+        s = fleet.ring_stats([(0, 0), None, (0, 1)], grid)
+        assert s["dcnHops"] == 2
+        assert s["contiguity"] < 0.5
+
+
+class TestSlicePlacer:
+    def _placer(self, cache):
+        from tpushare.topology.fleet import SlicePlacer
+
+        return SlicePlacer(cache)
+
+    def _gang_pod(self, api, name="w-0", shape="4x4x1", group="ring",
+                  minimum=4):
+        from tests.conftest import make_pod
+        from tpushare.utils import const
+
+        return api.create_pod(make_pod(
+            name, chips=4,
+            annotations={const.ANN_POD_GROUP: group,
+                         const.ANN_POD_GROUP_MIN: str(minimum),
+                         const.ANN_SLICE_SHAPE: shape}))
+
+    def test_elects_contiguous_block_in_ring_order(self, api):
+        cache = _slice_cache(api)
+        placer = self._placer(cache)
+        pod = self._gang_pod(api)
+        p = placer.elect(("default", "ring"), pod)
+        assert p is not None and len(p.hosts) == 4
+        assert p.stats["contiguity"] == 1.0
+        assert p.stats["worstHop"] == 1
+
+    def test_memoized_on_summary_digests(self, api):
+        """Same fleet state -> the SAME placement object; any ledger
+        mutation on a read node invalidates the memo (the PR 7
+        admit/score memo discipline at gang granularity)."""
+        from tests.conftest import make_pod
+
+        cache = _slice_cache(api)
+        placer = self._placer(cache)
+        pod = self._gang_pod(api)
+        p1 = placer.elect(("default", "ring"), pod)
+        assert placer.elect(("default", "ring"), pod) is p1
+        # Mutate one read node's ledger: the memo must re-elect.
+        filler = api.create_pod(make_pod("filler", hbm=16))
+        info = cache.get_node_info(p1.hosts[0])
+        info.allocate(api, filler)
+        p2 = placer.elect(("default", "ring"), pod)
+        assert p2 is not p1
+        assert p1.hosts[0] not in p2.hosts  # no longer whole-free
+
+    def test_no_contiguous_candidate_returns_none(self, api):
+        """Occupy one host of every possible block: election fails —
+        and the gang must then FALL BACK, not reject (covered e2e)."""
+        from tests.conftest import make_pod
+
+        cache = _slice_cache(api)  # 2x2x2 grid, shape needs 2x2x1 block
+        placer = self._placer(cache)
+        # A (2,2,1) block is a 4-host axis plane, in ANY orientation
+        # (the placer tries every axis permutation): 6 planes total.
+        # (0,0,0) and (1,1,1) together intersect all of them.
+        for host in ("h-00", "h-07"):
+            filler = api.create_pod(make_pod(f"f-{host}", hbm=16))
+            cache.get_node_info(host).allocate(api, filler)
+        pod = self._gang_pod(api)
+        assert placer.elect(("default", "ring"), pod) is None
+
+    def test_wrap_block_elected_on_torus(self, api):
+        """Occupancy that leaves only the torus-wrapped block free:
+        the placer must find it (z in {3, 0})."""
+        from tests.conftest import make_pod
+
+        cache = _slice_cache(api, hosts=16, slice_topology="4x4x4")
+        placer = self._placer(cache)
+        for idx in (1, 2, 5, 6, 9, 10, 13, 14):  # kill z∈{1,2} planes
+            filler = api.create_pod(make_pod(f"f-{idx}", hbm=16))
+            cache.get_node_info(f"h-{idx:02d}").allocate(api, filler)
+        pod = self._gang_pod(api, shape="4x4x2", minimum=8)
+        p = placer.elect(("default", "ring"), pod)
+        assert p is not None
+        assert p.stats["contiguity"] == 1.0  # wrap makes it a ring
+        zs = {c[2] for c in p.coords}
+        assert zs == {0, 3}
+
+    def test_cordoned_host_is_not_electable(self, api):
+        from tests.conftest import make_node
+
+        cache = _slice_cache(api)
+        placer = self._placer(cache)
+        # Cordon h-00: every block through (0,0,0) is off the table.
+        node = api.get_node("h-00")
+        node.raw.setdefault("spec", {})["unschedulable"] = True
+        api.update_node(node)
+        cache.get_node_info("h-00")  # fold the fresh doc in
+        pod = self._gang_pod(api)
+        p = placer.elect(("default", "ring"), pod)
+        assert p is not None and "h-00" not in p.hosts
+
+    def test_shape_not_tiling_slice_returns_none(self, api):
+        cache = _slice_cache(api)
+        placer = self._placer(cache)
+        pod = self._gang_pod(api, shape="3x4x1")
+        assert placer.elect(("default", "ring"), pod) is None
+
+
+class TestWorkerOrder:
+    def test_sort_key_is_numeric_not_lexicographic(self):
+        """Unpadded indexed-Job names (w-0..w-11): ring order must be
+        numeric — a lexicographic sort puts w-10 next to w-1 and would
+        make steering, the gauge, and defrag repair disagree about the
+        same gang's ring."""
+        from tpushare.topology import fleet
+
+        names = [f"w-{i}" for i in range(12)]
+        lexicographic = sorted(names)
+        assert lexicographic != names  # the trap exists
+        assert sorted(lexicographic, key=fleet.worker_sort_key) == names
+
+    def test_non_ordinal_names_sort_lexicographically_after(self):
+        from tpushare.topology import fleet
+
+        mixed = ["zeta", "w-2", "alpha", "w-10"]
+        assert sorted(mixed, key=fleet.worker_sort_key) == [
+            "w-2", "w-10", "alpha", "zeta"]
+
+    def test_worker_ordinal_parses_suffixes(self):
+        from tpushare.topology import fleet
+
+        assert fleet.worker_ordinal("stage-12") == 12
+        assert fleet.worker_ordinal("w_3") == 3
+        assert fleet.worker_ordinal("w10") == 10
+        assert fleet.worker_ordinal("noordinal") is None
+
+
+class TestRingLatencyModel:
+    def test_multi_hop_and_dcn_cost_more(self):
+        from tpushare.workload import parallel as PL
+
+        one = PL.hop_time_us(1, 64 << 20)
+        three = PL.hop_time_us(3, 64 << 20)
+        dcn = PL.hop_time_us(None, 64 << 20)
+        assert one < three < dcn
+
+    def test_rotation_gated_by_slowest_hop(self):
+        from tpushare.workload import parallel as PL
+
+        assert PL.ring_rotation_time_us([1, 1, 3, 1], 1 << 20) == \
+            PL.hop_time_us(3, 1 << 20)
+
+    def test_contiguous_step_beats_scattered(self):
+        from tpushare.workload import parallel as PL
+
+        cont = PL.predicted_step_time_ms([[1, 1, 1, 1]] * 4, [1, 1, 1])
+        scat = PL.predicted_step_time_ms([[3, 2, 4, 3]] * 4, [2, 3, 1])
+        assert scat > cont * 1.15
+
+    def test_compute_floor_keeps_model_honest(self):
+        from tpushare.workload import parallel as PL
+
+        assert PL.predicted_step_time_ms([], [], compute_ms=7.5) == 7.5
+
+
+class TestDefragRingRepair:
+    def test_scattered_gang_gets_contiguity_restoring_moves(self, api):
+        import tpushare.utils.pod as podutils
+        from tests.conftest import make_pod
+        from tpushare.defrag.planner import RebalancePlanner
+        from tpushare.utils import const
+
+        cache = _slice_cache(api)
+        ann = {const.ANN_POD_GROUP: "ring",
+               const.ANN_POD_GROUP_MIN: "4",
+               const.ANN_SLICE_SHAPE: "4x4x1"}
+        for i, host in enumerate(["h-00", "h-03", "h-05", "h-06"]):
+            doc = make_pod(f"w-{i}", chips=4, annotations=ann,
+                           node_name=host)
+            pod = api.create_pod(doc)
+            placed = podutils.updated_pod_annotation_spec(
+                pod, [0, 1, 2, 3], 380, 95, assume_time_ns=1)
+            placed.spec["nodeName"] = host
+            api.update_pod(placed)
+            cache.add_or_update_pod(api.get_pod("default", f"w-{i}"))
+        plan = RebalancePlanner(cache).plan([])
+        assert plan is not None
+        assert all("ring-repair" in m.detail for m in plan.moves)
+        assert all("contiguity" in m.detail for m in plan.moves)
+        # Off-slot members move; at least one member stays put.
+        moved = {m.key() for m in plan.moves}
+        assert 0 < len(moved) < 4
+
+    def test_contiguous_gang_is_left_alone(self, api):
+        import tpushare.utils.pod as podutils
+        from tests.conftest import make_pod
+        from tpushare.defrag.planner import RebalancePlanner
+        from tpushare.utils import const
+
+        cache = _slice_cache(api)
+        ann = {const.ANN_POD_GROUP: "ring",
+               const.ANN_POD_GROUP_MIN: "4",
+               const.ANN_SLICE_SHAPE: "4x4x1"}
+        # Worker order w0..w3 on a snake ring over the z=0 plane:
+        # (0,0,0) (0,1,0) (1,1,0) (1,0,0) — every hop is 1.
+        for i, host in enumerate(["h-00", "h-02", "h-06", "h-04"]):
+            doc = make_pod(f"w-{i}", chips=4, annotations=ann,
+                           node_name=host)
+            pod = api.create_pod(doc)
+            placed = podutils.updated_pod_annotation_spec(
+                pod, [0, 1, 2, 3], 380, 95, assume_time_ns=1)
+            placed.spec["nodeName"] = host
+            api.update_pod(placed)
+            cache.add_or_update_pod(api.get_pod("default", f"w-{i}"))
+        assert RebalancePlanner(cache).plan([]) is None
+
+    def test_checkpointing_member_pins_the_whole_repair(self, api):
+        import tpushare.utils.pod as podutils
+        from tests.conftest import make_pod
+        from tpushare.defrag.planner import RebalancePlanner
+        from tpushare.utils import const
+
+        cache = _slice_cache(api)
+        ann = {const.ANN_POD_GROUP: "ring",
+               const.ANN_POD_GROUP_MIN: "4",
+               const.ANN_SLICE_SHAPE: "4x4x1"}
+        for i, host in enumerate(["h-00", "h-03", "h-05", "h-06"]):
+            extra = dict(ann)
+            if i == 2:
+                extra[const.ANN_CKPT_IN_FLIGHT] = "true"
+            doc = make_pod(f"w-{i}", chips=4, annotations=extra,
+                           node_name=host)
+            pod = api.create_pod(doc)
+            placed = podutils.updated_pod_annotation_spec(
+                pod, [0, 1, 2, 3], 380, 95, assume_time_ns=1)
+            placed.spec["nodeName"] = host
+            api.update_pod(placed)
+            cache.add_or_update_pod(api.get_pod("default", f"w-{i}"))
+        assert RebalancePlanner(cache).plan([]) is None
+
+
+class TestGangTopologyE2E:
+    """Full wire-protocol e2e over the miniapiserver (the REAL
+    ApiClient, real HTTP both sides): slice-shape gang members land on
+    the elected contiguous hosts; with no contiguous set the fallback
+    path still binds, with the topology-fallback note recorded."""
+
+    def _stack(self, server):
+        from tpushare.cmd.main import serve_stack
+        from tpushare.k8s.client import ApiClient, ClusterConfig
+
+        client = ApiClient(ClusterConfig(
+            host=f"http://127.0.0.1:{server.port}"))
+        return serve_stack(client)
+
+    def _post(self, http_server, path, doc):
+        import http.client
+        import json as _json
+
+        host, port = http_server.server_address[:2]
+        conn = http.client.HTTPConnection(host, port)
+        try:
+            conn.request("POST", path, _json.dumps(doc).encode(),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, _json.loads(resp.read())
+        finally:
+            conn.close()
+
+    def _schedule_gang(self, server, http_server, names, shape,
+                       members=4, prioritize=True):
+        import time as _time
+
+        from tests.conftest import make_pod
+        from tpushare.utils import const
+
+        ann = {const.ANN_POD_GROUP: "ring",
+               const.ANN_POD_GROUP_MIN: str(members)}
+        if shape:
+            ann[const.ANN_SLICE_SHAPE] = shape
+        for i in range(members):
+            doc = make_pod(f"w-{i}", chips=4, annotations=ann,
+                           uid=f"uid-w{i}")
+            server.seed_pod(doc)
+            pod_raw = server.store.pods[f"default/w-{i}"]
+            status, result = self._post(
+                http_server, "/tpushare-scheduler/filter",
+                {"Pod": pod_raw, "NodeNames": names})
+            assert status == 200, result
+            cands = result["NodeNames"]
+            assert cands, result["FailedNodes"]
+            if prioritize:
+                status, ranked = self._post(
+                    http_server, "/tpushare-scheduler/prioritize",
+                    {"Pod": pod_raw, "NodeNames": cands})
+                assert status == 200, ranked
+                best = max(ranked, key=lambda e: e["Score"])["Host"]
+            else:
+                best = cands[0]
+            self._post(http_server, "/tpushare-scheduler/bind", {
+                "PodName": f"w-{i}", "PodNamespace": "default",
+                "PodUID": f"uid-w{i}", "Node": best})
+        deadline = _time.time() + 15
+        while _time.time() < deadline:
+            bound = [server.store.pods[f"default/w-{i}"]["spec"]
+                     .get("nodeName") for i in range(members)]
+            if all(bound):
+                return bound
+            _time.sleep(0.005)
+        raise AssertionError(f"gang never fully bound: {bound}")
+
+    def test_members_land_on_elected_contiguous_hosts(self):
+        import urllib.request
+
+        from tests.conftest import make_node
+        from tests.miniapiserver import MiniApiServer
+        from tpushare.cmd.main import shutdown_stack
+
+        server = MiniApiServer().start()
+        stack = http_server = None
+        try:
+            names = [f"h-{i:02d}" for i in range(8)]
+            for i, n in enumerate(names):
+                server.seed_node(make_node(
+                    n, chips=4, hbm_per_chip=95, topology="2x2x1",
+                    tpu_type="v5p", slice_id="pod-a",
+                    slice_topology="4x4x2", worker_index=i))
+            stack, http_server = self._stack(server)
+            bound = self._schedule_gang(server, http_server, names,
+                                        shape="4x4x1")
+            # Elected block = one axis plane of the 2x2x2 host grid:
+            # the ring over worker order must be perfectly contiguous.
+            from tpushare.api.objects import Node
+            from tpushare.topology import fleet
+
+            node_docs = [Node(server.store.nodes[n]) for n in bound]
+            stats = fleet.gang_ring_stats(node_docs)
+            assert stats is not None
+            assert stats["contiguity"] == 1.0, (bound, stats)
+            assert stats["worstHop"] == 1
+            # The commit published the gauge.
+            host, port = http_server.server_address[:2]
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics") as r:
+                body = r.read().decode()
+            assert 'tpushare_gang_ring_contiguity{gang="default/ring"}'\
+                in body
+        finally:
+            if stack is not None:
+                shutdown_stack(stack, http_server)
+            server.close()
+
+    def test_fallback_still_binds_with_trace_note(self):
+        import json as _json
+        import urllib.request
+
+        from tests.conftest import make_node
+        from tests.miniapiserver import MiniApiServer
+        from tpushare.cmd.main import shutdown_stack
+        from tpushare.routes import metrics as m
+
+        server = MiniApiServer().start()
+        stack = http_server = None
+        fallbacks_before = m.TOPOLOGY_FALLBACKS._value.get()
+        try:
+            # No slice labels anywhere: no host grid, no contiguous
+            # candidate — election fails, members must place anyway.
+            names = [f"n-{i}" for i in range(4)]
+            for n in names:
+                server.seed_node(make_node(n, chips=4, hbm_per_chip=95,
+                                           topology="2x2x1",
+                                           tpu_type="v5p"))
+            stack, http_server = self._stack(server)
+            bound = self._schedule_gang(server, http_server, names,
+                                        shape="4x4x1")
+            assert len(set(bound)) == 4  # every member bound somewhere
+            # ONE gang-level fallback event = ONE count (the failed
+            # election); per-member steering must not re-count it.
+            assert m.TOPOLOGY_FALLBACKS._value.get() == \
+                fallbacks_before + 1
+            # The decision trace carries the WHY.
+            host, port = http_server.server_address[:2]
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/trace/default/w-0") as r:
+                doc = _json.loads(r.read())
+            assert "topology-fallback" in _json.dumps(doc)
+        finally:
+            if stack is not None:
+                shutdown_stack(stack, http_server)
+            server.close()
+
+
+class TestRingRepairHardening:
+    """Review-round regressions: repairs must be reachable from the
+    executor on an idle fleet, must never target hypothetically-placed
+    pending pods, and two gangs in one plan must not elect one block."""
+
+    def _place_gang(self, api, cache, gang, hosts, prefix="w"):
+        import tpushare.utils.pod as podutils
+        from tests.conftest import make_pod
+        from tpushare.utils import const
+
+        ann = {const.ANN_POD_GROUP: gang,
+               const.ANN_POD_GROUP_MIN: str(len(hosts)),
+               const.ANN_SLICE_SHAPE: "4x4x1"}
+        for i, host in enumerate(hosts):
+            name = f"{prefix}-{i}"
+            doc = make_pod(name, chips=4, annotations=ann,
+                           node_name=host)
+            pod = api.create_pod(doc)
+            placed = podutils.updated_pod_annotation_spec(
+                pod, [0, 1, 2, 3], 380, 95, assume_time_ns=1)
+            placed.spec["nodeName"] = host
+            api.update_pod(placed)
+            cache.add_or_update_pod(api.get_pod("default", name))
+
+    def test_executor_tick_repairs_ring_with_nothing_pending(self, api):
+        """An idle fleet (zero pending pods) is exactly when a
+        fragmented ring is cheapest to repair — the executor's
+        build_plan must reach the planner even with no pending set."""
+        from tpushare.defrag.executor import DefragExecutor
+
+        cache = _slice_cache(api)
+        self._place_gang(api, cache, "ring",
+                         ["h-00", "h-03", "h-05", "h-06"])
+        ex = DefragExecutor(cache, api, pod_lister=api.list_pods,
+                            mode="dry-run", burning_fn=lambda: [])
+        doc = ex.tick()
+        assert doc is not None
+        assert all("ring-repair" in m.get("detail", "")
+                   for m in doc["moves"])
+
+    def test_idle_tick_without_slice_gangs_is_cheap_noop(self, api):
+        """No pending, no slice-shape gang: plan() must answer None
+        without building the what-if (the O(pods) pre-check)."""
+        from tpushare.defrag.planner import RebalancePlanner, WhatIf
+
+        cache = _slice_cache(api)
+        built = []
+        orig = WhatIf.__init__
+
+        def counting(self, infos):
+            built.append(1)
+            orig(self, infos)
+
+        WhatIf.__init__ = counting
+        try:
+            assert RebalancePlanner(cache).plan([]) is None
+        finally:
+            WhatIf.__init__ = orig
+        assert not built
+
+    def test_pending_placements_are_never_repair_victims(self, api):
+        """Pending slice-shape gang pods that FIT are hypothetically
+        placed into the what-if by the unblock phase — the repair pass
+        must not author evictions for pods that are not running."""
+        from tests.conftest import make_pod
+        from tpushare.defrag.planner import RebalancePlanner
+        from tpushare.utils import const
+
+        cache = _slice_cache(api)  # empty fleet: everything fits
+        ann = {const.ANN_POD_GROUP: "ring",
+               const.ANN_POD_GROUP_MIN: "4",
+               const.ANN_SLICE_SHAPE: "4x4x1"}
+        pending = [
+            api.create_pod(make_pod(f"p-{i}", chips=4, annotations=ann))
+            for i in range(4)]
+        assert RebalancePlanner(cache).plan(pending) is None
+
+    def test_two_fragmented_gangs_elect_disjoint_blocks(self, api):
+        """One plan, two fragmented gangs: the first accepted repair is
+        folded into the what-if, so the second election cannot claim
+        the same block (disjoint targets, and no target collides with
+        an unmoved member of either gang)."""
+        from tpushare.defrag.planner import RebalancePlanner
+
+        cache = _slice_cache(api, hosts=16, slice_topology="4x4x4")
+        # 2x2x4 grid. Gang A scattered over mixed z; gang B likewise.
+        self._place_gang(api, cache, "gang-a",
+                         ["h-00", "h-05", "h-10", "h-15"], prefix="a")
+        self._place_gang(api, cache, "gang-b",
+                         ["h-01", "h-04", "h-11", "h-14"], prefix="b")
+        plan = RebalancePlanner(cache, max_moves=8).plan([])
+        assert plan is not None
+        targets = [m.to_node for m in plan.moves]
+        assert len(targets) == len(set(targets)), targets
+        # No repair may land on a host still occupied by an UNMOVED
+        # member of either gang.
+        moved = {m.key().split("/", 1)[1] for m in plan.moves}
+        still = {f"a-{i}": h for i, h in enumerate(
+                     ["h-00", "h-05", "h-10", "h-15"])}
+        still.update({f"b-{i}": h for i, h in enumerate(
+                     ["h-01", "h-04", "h-11", "h-14"])})
+        occupied = {h for name, h in still.items() if name not in moved}
+        assert not (set(targets) & occupied), (targets, occupied)
+
+
+class TestElectedBlockScoringDominance:
+    def test_quota_fairness_cannot_tie_elected_block(self, api):
+        """A +1 tenant-fairness adjust must never lift an off-block
+        host into a tie with the elected block's flat MAX_SCORE."""
+        from tests.conftest import make_pod
+        from tpushare.api.extender import ExtenderArgs
+        from tpushare.api.objects import Pod
+        from tpushare.scheduler.prioritize import MAX_SCORE, Prioritize
+        from tpushare.utils import const
+
+        cache = _slice_cache(api)
+
+        class _Gp:
+            def member_nodes(self, pod):
+                return set()
+
+            def elected_hosts(self, pod):
+                return frozenset({"h-00", "h-01"})
+
+        class _Q:
+            def score_adjust(self, pod):
+                return 1
+
+        prio = Prioritize(cache, gang_planner=_Gp(), quota=_Q())
+        pod = Pod(make_pod("w-0", chips=2, annotations={
+            const.ANN_POD_GROUP: "ring",
+            const.ANN_POD_GROUP_MIN: "2",
+            const.ANN_SLICE_SHAPE: "2x2x2"}))
+        names = [f"h-{i:02d}" for i in range(8)]
+        out = {e.host: e.score
+               for e in prio.handle(ExtenderArgs.from_json(
+                   {"Pod": pod.raw, "NodeNames": names}))}
+        assert out["h-00"] == MAX_SCORE and out["h-01"] == MAX_SCORE
+        assert all(s < MAX_SCORE for h, s in out.items()
+                   if h not in ("h-00", "h-01")), out
+
+
+class TestCLICrossSliceContiguity:
+    def test_cross_slice_member_counts_as_dcn(self):
+        import sys as _sys
+
+        _sys.path.insert(0, "tools")
+        import kubectl_inspect_tpushare as K
+
+        members = [
+            {"name": "w-0", "coords": [0, 0, 0], "slice": "pod-a"},
+            {"name": "w-1", "coords": [0, 0, 1], "slice": "pod-b"},
+        ]
+        contig, worst = K._gang_contiguity(members, [2, 2, 2], False)
+        # Cross-slice: both hops are DCN-weighted, never grid hop 1.
+        assert worst == K._DCN_HOP_WEIGHT
+        assert contig < 0.5
+        same = [dict(m, slice="pod-a") for m in members]
+        contig2, worst2 = K._gang_contiguity(same, [2, 2, 2], False)
+        assert worst2 == 1 and contig2 == 1.0

@@ -140,6 +140,192 @@ def render(doc: dict, details: bool = False) -> str:
     return "\n".join(lines)
 
 
+def _parse_dims(spec: str) -> list[int] | None:
+    """"4x4x2" -> [4, 4, 2]; None on anything malformed. Local math:
+    this CLI is deliberately stdlib-only (no tpushare import), so the
+    tiny grid arithmetic is duplicated from tpushare/topology/."""
+    try:
+        dims = [int(p) for p in spec.lower().split("x")]
+    except ValueError:
+        return None
+    return dims if dims and all(d > 0 for d in dims) else None
+
+
+def _host_grid_dims(node: dict) -> tuple[list[int], bool] | None:
+    """(host grid dims, torus?) of a node's slice, from the inspect
+    doc's sliceTopology/topology/tpuType fields (same rules as
+    tpushare.topology.slice_host_grid)."""
+    s = _parse_dims(node.get("sliceTopology", ""))
+    h = _parse_dims(node.get("topology", ""))
+    if not s or not h:
+        return None
+    h = h + [1] * (len(s) - len(h))
+    if len(h) > len(s) or any(si % hi for si, hi in zip(s, h)):
+        return None
+    dims = [si // hi for si, hi in zip(s, h)]
+    torus = (node.get("tpuType") in ("v4", "v5p")
+             and all(d >= 4 for d in s))
+    return dims, torus
+
+
+def _grid_distance(a: list[int], b: list[int], dims: list[int],
+                   torus: bool) -> int:
+    total = 0
+    for x, y, d in zip(a, b, dims):
+        delta = abs(x - y)
+        if torus:
+            delta = min(delta, d - delta)
+        total += delta
+    return total
+
+
+#: DCN-hop weight for the CLI's contiguity number — keep in sync with
+#: tpushare.topology.fleet.DCN_HOP_WEIGHT.
+_DCN_HOP_WEIGHT = 8
+
+
+def _worker_sort_key(name: str) -> tuple[int, int, str]:
+    """Ring (worker) order: numeric trailing ordinal when present,
+    lexicographic otherwise — keep in sync with
+    tpushare.topology.fleet.worker_sort_key (an unpadded w-10 must not
+    sort next to w-1)."""
+    digits = ""
+    for ch in reversed(name):
+        if ch.isdigit():
+            digits = ch + digits
+        elif digits:
+            break
+        elif ch in "-_.":
+            continue
+        else:
+            break
+    if not digits:
+        return (1, 0, name)
+    return (0, int(digits), name)
+
+
+def _gang_contiguity(members: list[dict],
+                     dims: list[int],
+                     torus: bool) -> tuple[float, int]:
+    """(ring contiguity, worst hop) over members IN ORDER. A member
+    without coords — or on a DIFFERENT slice than the first located
+    member — is a DCN hop on both sides (same rule as
+    tpushare.topology.fleet.gang_ring_stats: only co-slice hosts share
+    ICI; grid math across slices would paint a healthy ring over a
+    datacenter-network crossing)."""
+    anchor = next((m.get("slice") for m in members
+                   if m.get("coords") is not None), None)
+    coords = [m.get("coords") if m.get("slice") == anchor else None
+              for m in members]
+    n = len(coords)
+    if n == 0:
+        return 0.0, 0
+    hops = []
+    for i in range(n):
+        a, b = coords[i], coords[(i + 1) % n]
+        hops.append(_DCN_HOP_WEIGHT if a is None or b is None
+                    else _grid_distance(a, b, dims, torus))
+    total = sum(hops)
+    if total == 0:
+        return 1.0, 0  # degenerate ring: trivially contiguous
+    return round(n / total, 4), max(hops)
+
+
+def render_topology(doc: dict) -> str:
+    """The host-grid view: every multi-host slice rendered as x-layers
+    of y-rows x z-columns, each cell one host — `.` whole-host free,
+    `o` partially used, `#` no free chips, or the letter of the gang
+    resident there — plus a per-gang ring-contiguity legend. This is
+    where an operator SEES whether a gang's ring is contiguous or
+    scattered (docs/topology.md)."""
+    slices: dict[str, list[dict]] = {}
+    for node in doc.get("nodes", []):
+        if node.get("hostCoords") is not None and node.get("sliceId"):
+            slices.setdefault(node["sliceId"], []).append(node)
+    if not slices:
+        return ("no multi-host slice geometry: no node carries "
+                "slice-id + slice-topology + worker-index annotations")
+    out: list[str] = []
+    gang_letters: dict[str, str] = {}
+    gang_members: dict[str, list[dict]] = {}
+    letters = "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    for sid in sorted(slices):
+        nodes = slices[sid]
+        geo = _host_grid_dims(nodes[0])
+        if geo is None:
+            out.append(f"slice {sid}: malformed slice/host topology")
+            continue
+        dims, torus = geo
+        dims3 = ([1] * (3 - len(dims)) + dims)[-3:] if len(dims) < 3 \
+            else dims
+        by_coords: dict[tuple, dict] = {}
+        for node in nodes:
+            c = tuple(node["hostCoords"])
+            c3 = (0,) * (3 - len(c)) + c if len(c) < 3 else c
+            by_coords[c3] = node
+        out.append(f"slice {sid}: host grid "
+                   f"{'x'.join(str(d) for d in dims)}"
+                   f"{' (torus)' if torus else ''}")
+        for node in nodes:
+            for chip in node.get("chips", []):
+                for p in chip.get("pods", []):
+                    gang = p.get("gang")
+                    if not gang:
+                        continue
+                    if gang not in gang_letters:
+                        gang_letters[gang] = letters[
+                            len(gang_letters) % len(letters)]
+                    bucket = gang_members.setdefault(gang, [])
+                    if not any(m["name"] == p["name"] for m in bucket):
+                        bucket.append({
+                            "name": p["name"], "node": node["name"],
+                            "coords": node.get("hostCoords"),
+                            "slice": sid,
+                            "dims": dims, "torus": torus})
+        for x in range(dims3[0]):
+            if dims3[0] > 1:
+                out.append(f"  layer x={x}")
+            for y in range(dims3[1]):
+                row = []
+                for z in range(dims3[2]):
+                    node = by_coords.get((x, y, z))
+                    if node is None:
+                        row.append(" ")
+                        continue
+                    cell = "."
+                    free = sum(1 for c in node.get("chips", [])
+                               if c["usedHBM"] == 0 and not c["pods"])
+                    if free == 0:
+                        cell = "#"
+                    elif free < len(node.get("chips", [])):
+                        cell = "o"
+                    for chip in node.get("chips", []):
+                        for p in chip.get("pods", []):
+                            if p.get("gang"):
+                                cell = gang_letters[p["gang"]]
+                    row.append(cell)
+                out.append("  " + " ".join(row))
+    out.append("")
+    out.append("cells: . free host   o partially used   # full   "
+               "letter = gang member")
+    if gang_members:
+        out.append("")
+        out.append("gangs (ring over worker order):")
+        for gang in sorted(gang_members):
+            members = sorted(gang_members[gang],
+                             key=lambda m: _worker_sort_key(m["name"]))
+            # Grid geometry of the first LOCATED member's slice (the
+            # ring's anchor); off-anchor members count as DCN hops.
+            located = next((m for m in members
+                            if m.get("coords") is not None), members[0])
+            contig, worst = _gang_contiguity(
+                members, located["dims"], located["torus"])
+            out.append(f"  {gang_letters[gang]} = {gang}: "
+                       f"{len(members)} member(s), ring contiguity "
+                       f"{contig}, worst hop {worst}")
+    return "\n".join(out)
+
+
 def fetch_quota(endpoint: str) -> dict | None:
     """The per-tenant quota snapshot from ``/debug/quota``; None when
     the extender runs without a quota manager wired or with debug
@@ -692,7 +878,10 @@ def main(argv: list[str] | None = None) -> int:
                              "'hotspots' for the continuous profiler's "
                              "per-verb top frames + cost splits; or the "
                              "literal 'serving' for the decode fleet's "
-                             "per-tenant queue/shed/TTFT table")
+                             "per-tenant queue/shed/TTFT table; or the "
+                             "literal 'topology' for the host-grid "
+                             "slice-occupancy map with per-gang ring "
+                             "contiguity")
     parser.add_argument("pod", nargs="?", metavar="[ns/]pod",
                         help="with 'explain': the pod whose placement "
                              "decision to explain (namespace defaults "
@@ -737,6 +926,19 @@ def main(argv: list[str] | None = None) -> int:
                   "(DEBUG_ROUTES=0)", file=sys.stderr)
             return 1
         print(render_slo(doc))
+        return 0
+    if args.node == "topology":
+        if args.pod:
+            print(f"unexpected argument {args.pod!r} after 'topology'",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = fetch(args.endpoint, None)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        print(render_topology(doc))
         return 0
     if args.node == "defrag":
         if args.pod:

@@ -205,6 +205,41 @@ serving:
 """
 
 
+EXAMPLE_TOPOLOGY = """\
+# Topology-aware gang placement demo (docs/topology.md): a 16-host v5p
+# slice (4x4x4 chips of 2x2x1 hosts = a 2x2x4 host torus) is
+# fragmented by four pinned pre-load pods, then an 8-worker pp-gang
+# requesting a 4x4x2 sub-slice (a 2x2x2 host block) arrives. With the
+# placer ON the gang lands on the only free contiguous block — which
+# exists solely thanks to the torus WRAP (z in {3, 0}) — at ring
+# contiguity 1.0. `topology_compare: true` replays the identical
+# scenario with TPUSHARE_TOPOLOGY=off: the blind placement scatters
+# the ring, and the report renders both placements' coordinates and
+# ring-latency-model step times side by side.
+fleet:
+  - count: 16
+    prefix: v5p
+    chips: 4
+    hbm_per_chip: 95
+    tpu_type: v5p
+    topology: 2x2x1
+    slice_id: pod-a
+    slice_topology: 4x4x4
+topology_compare: true
+workload:
+  - {name: preload-1, hbm: 16, node: v5p-01}
+  - {name: preload-2, hbm: 16, node: v5p-02}
+  - {name: preload-5, hbm: 16, node: v5p-05}
+  - {name: preload-6, hbm: 16, node: v5p-06}
+  - count: 8
+    name: stage
+    chips: 4
+    group: pp-ring
+    group_min: 8
+    slice_shape: 4x4x2
+"""
+
+
 def load_scenario(path: str) -> dict:
     with open(path) as f:
         text = f.read()
@@ -222,6 +257,7 @@ def _expand_fleet(scenario: dict) -> list[dict]:
     for group in scenario.get("fleet", []):
         count = int(group.get("count", 1))
         prefix = group.get("prefix", "tpu")
+        slice_topology = group.get("slice_topology", "")
         for i in range(count):
             docs.append(make_node(
                 f"{prefix}-{i:02d}" if count > 1 else prefix,
@@ -231,6 +267,12 @@ def _expand_fleet(scenario: dict) -> list[dict]:
                 topology=group.get("topology", "2x2x1"),
                 tpu_type=group.get("tpu_type", "v5e"),
                 slice_id=group.get("slice_id", ""),
+                # Multi-host slice geometry: the slice's chip dims plus
+                # this host's worker index locate it on the host grid
+                # (tpushare.io/slice-topology / worker-index) — what
+                # the slice placer and the topology report read.
+                slice_topology=slice_topology,
+                worker_index=i if slice_topology else None,
                 unschedulable=bool(group.get("unschedulable", False)),
                 taints=group.get("taints"),
             ))
@@ -252,6 +294,15 @@ def _expand_workload(scenario: dict) -> list[dict]:
             ann[const.ANN_POD_GROUP] = str(group["group"])
             ann[const.ANN_POD_GROUP_MIN] = str(
                 group.get("group_min", count))
+        if group.get("slice_shape"):
+            # Requested ICI sub-slice (chip dims): arms the gang
+            # planner's contiguous-block election (docs/topology.md).
+            ann[const.ANN_SLICE_SHAPE] = str(group["slice_shape"])
+        # `node: <name>` pins the group onto one node (the replay
+        # plays the owner pre-loading a fleet — e.g. fragmenting
+        # specific hosts before a gang arrives); scheduling still runs
+        # the real wire with a one-node candidate list.
+        pin = str(group.get("node", "")) or None
         for i in range(count):
             doc = make_pod(f"{base}-{i}" if count > 1 else base,
                            hbm=int(group.get("hbm", 0)),
@@ -262,7 +313,7 @@ def _expand_workload(scenario: dict) -> list[dict]:
                            priority=group.get("priority"))
             if group.get("tolerations"):
                 doc["spec"]["tolerations"] = list(group["tolerations"])
-            specs.append(doc)
+            specs.append((doc, pin))
     return specs
 
 
@@ -333,12 +384,14 @@ def simulate(scenario: dict) -> dict:
     execute = bool(scenario.get("execute_preemptions"))
     all_nodes = [Node(d) for d in node_docs]
     try:
-        for spec in _expand_workload(scenario):
+        for spec, pin in _expand_workload(scenario):
             pod = api.create_pod(spec)
             # kube-scheduler's upstream NodeUnschedulable+TaintToleration
             # pass — cordoned/untolerated nodes never reach the extender.
             candidates = [n.name for n in all_nodes
                           if nodeutils.is_schedulable(n, pod)]
+            if pin is not None:
+                candidates = [n for n in candidates if n == pin]
             t0 = time.perf_counter()
             verdict = _schedule_one(client, pod, candidates)
             latencies.append((time.perf_counter() - t0) * 1e3)
@@ -739,6 +792,60 @@ def _execute_preemption(api, client: _Client, controller, pod,
                      "evicted": evicted}
 
 
+def _gang_topology(inspect_doc) -> list[dict]:
+    """Ring geometry of every placed gang with located hosts: members
+    in worker (pod-name) order, their host-grid coordinates, the ring
+    contiguity/worst-hop over the slice grid, and the ring-latency
+    model's predicted step time — the report's proof that a placement
+    is (or is not) ICI-contiguous (docs/topology.md)."""
+    from tpushare.topology import fleet as topo
+    from tpushare.topology import topology as T
+    from tpushare.workload import parallel as PL
+
+    gangs: dict[str, dict[str, dict]] = {}
+    for n in inspect_doc.get("nodes", []):
+        for c in n.get("chips", []):
+            for p in c.get("pods", []):
+                gang = p.get("gang")
+                if gang:
+                    gangs.setdefault(gang, {})[p["name"]] = n
+    out = []
+    for gang, members in sorted(gangs.items()):
+        # Worker (ring) order: numeric-ordinal names, the same key the
+        # gang planner's steering used.
+        ordered = sorted(members, key=topo.worker_sort_key)
+        grid = None
+        coords: list[tuple[int, ...] | None] = []
+        for name in ordered:
+            n = members[name]
+            hc = n.get("hostCoords")
+            if hc is None:
+                coords.append(None)
+                continue
+            if grid is None:
+                grid = T.slice_host_grid(n.get("sliceTopology", ""),
+                                         n.get("topology", ""),
+                                         n.get("tpuType", ""))
+            coords.append(tuple(hc))
+        if grid is None:
+            continue  # no located member: no ring geometry to report
+        stats = topo.ring_stats(coords, grid)
+        step_ms = PL.predicted_step_time_ms(
+            [topo.ring_hops(coords, grid)], [])
+        out.append({
+            "gang": gang,
+            "members": ordered,
+            "nodes": [members[m]["name"] for m in ordered],
+            "coords": [list(c) if c is not None else None
+                       for c in coords],
+            "ringContiguity": stats["contiguity"],
+            "worstHop": stats["worstHop"],
+            "dcnHops": stats["dcnHops"],
+            "predictedStepMs": round(step_ms, 3),
+        })
+    return out
+
+
 def _report(inspect_doc, placements, held, unschedulable,
             latencies, executed_preemptions=(), tenants=(),
             slo_doc=None, defrag_report=None, serving_report=None):
@@ -782,12 +889,26 @@ def _report(inspect_doc, placements, held, unschedulable,
         "held_pods": held,
         "unschedulable_pods": unschedulable,
         "gangs": inspect_doc.get("gangs", []),
+        **({"topology": topo_section}
+           if (topo_section := _gang_topology(inspect_doc)) else {}),
         "preemptions_executed": list(executed_preemptions),
         "tenants": list(tenants),
         "slo": slo_doc or {},
         **({"defrag": defrag_report} if defrag_report else {}),
         **({"serving": serving_report} if serving_report else {}),
     }
+
+
+def _print_gang_rings(sections: list, indent: str = "  ") -> None:
+    for t in sections:
+        print(f"{indent}{t['gang']}: contiguity {t['ringContiguity']}, "
+              f"worst hop {t['worstHop']}, predicted step "
+              f"{t['predictedStepMs']} ms")
+        for member, node, coord in zip(t["members"], t["nodes"],
+                                       t["coords"]):
+            where = ("off-grid" if coord is None
+                     else "(" + ",".join(str(c) for c in coord) + ")")
+            print(f"{indent}  {member} -> {node} {where}")
 
 
 def _print_human(report: dict) -> None:
@@ -821,6 +942,16 @@ def _print_human(report: dict) -> None:
             for node, victims in (u.get("would_preempt") or {}).items():
                 print(f"    would fit on {node} by evicting "
                       f"{len(victims)} pod(s)")
+    if report.get("topology"):
+        print("\ntopology (gang rings, worker order):")
+        _print_gang_rings(report["topology"], indent="  ")
+        if report.get("topology_blind") is not None:
+            print("  -- same scenario, placer OFF "
+                  "(TPUSHARE_TOPOLOGY=off) --")
+            if report["topology_blind"]:
+                _print_gang_rings(report["topology_blind"], indent="  ")
+            else:
+                print("    (no located gang placement)")
     if report.get("preemptions_executed"):
         print("\npreemptions executed:")
         for p in report["preemptions_executed"]:
@@ -1167,6 +1298,12 @@ def main() -> None:
                          "(surge -> shed the flooder -> scale-out "
                          "binds a decode pod -> queues drain) and "
                          "exit")
+    ap.add_argument("--example-topology", action="store_true",
+                    help="print a topology-aware gang placement demo "
+                         "scenario (fragmented host torus; the same "
+                         "pp-gang placed with the slice placer on and "
+                         "off in one run, both rings priced by the "
+                         "ring-latency model) and exit")
     ap.add_argument("--drain", metavar="NODE",
                     help="with --defrag: ask whether NODE can be "
                          "drained — only its residents are re-packed "
@@ -1190,6 +1327,9 @@ def main() -> None:
         return
     if args.example_serving:
         print(EXAMPLE_SERVING, end="")
+        return
+    if args.example_topology:
+        print(EXAMPLE_TOPOLOGY, end="")
         return
     if not args.scenario and not args.defrag:
         ap.error("scenario file required (or --example / --defrag)")
@@ -1215,7 +1355,24 @@ def main() -> None:
         else:
             _print_defrag(report)
         return
-    report = simulate(load_scenario(args.scenario))
+    scenario = load_scenario(args.scenario)
+    report = simulate(scenario)
+    if scenario.get("topology_compare"):
+        # The same scenario replayed with the slice placer DISABLED
+        # (TPUSHARE_TOPOLOGY=off, exactly the production kill switch):
+        # the report then carries BOTH placements' coordinates and
+        # predicted step times, so the placer's win is readable from
+        # one run of the tool (docs/topology.md).
+        saved = os.environ.get("TPUSHARE_TOPOLOGY")
+        os.environ["TPUSHARE_TOPOLOGY"] = "off"
+        try:
+            blind = simulate(scenario)
+        finally:
+            if saved is None:
+                os.environ.pop("TPUSHARE_TOPOLOGY", None)
+            else:
+                os.environ["TPUSHARE_TOPOLOGY"] = saved
+        report["topology_blind"] = blind.get("topology", [])
     if args.as_json:
         print(json.dumps(report))
     else:

@@ -74,6 +74,10 @@ FLEET_ENUM_CALLS = frozenset({
 #: injected lister seams and the scheduler's candidate list).
 FLEET_LOOP_CALLS = FLEET_ENUM_CALLS | frozenset({
     "candidate_names", "_node_lister", "pod_lister", "_pod_lister",
+    # The one-lock whole-fleet ledger snapshot: point lookups into it
+    # are O(1) (and excluded below), but LOOPING over it is a fleet
+    # scan like any other.
+    "node_table",
 })
 
 #: ``self.<attr>`` collections that hold the whole fleet: looping (or

@@ -98,6 +98,12 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
     # submit, the serving loop ticks, and the scrape/debug handlers
     # snapshot — the queue and tenant ledger are hit from all three.
     "Router": ("_replicas", "_queue", "_requests", "_tenants"),
+    # The slice placer's per-gang election memo (tpushare/topology/
+    # fleet.py): written from bind-path threads (gang quorum pre-check)
+    # while prioritize threads read elections for scoring — the same
+    # cross-thread memo shape as the verb memos, but dict-mutation
+    # based, so it gets the lock-guarded treatment.
+    "SlicePlacer": ("_memo",),
 }
 
 #: Method calls that mutate a dict/set/list in place.
@@ -311,7 +317,7 @@ def raw_lock(tree: ast.AST, src: str, path: str) -> list[Violation]:
 _TELEMETRY_PATHS = ("k8s/events.py", "routes/metrics.py")
 _TELEMETRY_DIRS = ("tpushare/trace/", "tpushare/slo/",
                    "tpushare/defrag/", "tpushare/profiling/",
-                   "tpushare/router/")
+                   "tpushare/router/", "tpushare/topology/")
 
 #: Call shapes that count as incrementing a drop/error counter
 #: (bare ``safe_inc(...)``, ``metrics.safe_inc(...)``, ``x.inc()``).

@@ -162,6 +162,15 @@ class NodeInfo:
                               tuple[NodeSummary, bool, str]] = {}
         self.score_memo: dict[tuple[int, int, str],
                               tuple[NodeSummary, int]] = {}
+        #: k -> (summary-at-compute-time, compact selection over that
+        #: summary's free chips). Same identity-validated discipline as
+        #: the admit/score memos: Topology.select_compact is
+        #: O(k * free^2) greedy per call, and prioritize re-runs it per
+        #: candidate per request at fleet scale — in steady state each
+        #: node re-selects only when its own ledger changed.
+        self.compact_memo: dict[int,
+                                tuple[NodeSummary,
+                                      list[int] | None]] = {}
         caps = nodeutils.get_chip_capacities(node)
         # Guarded: the chip table itself only mutates at construction,
         # but registering it keeps `make test-race` watching for any
@@ -310,6 +319,22 @@ class NodeInfo:
             )
             self._summary = s
             return s
+
+    def select_compact_cached(self, s: NodeSummary,
+                              k: int) -> list[int] | None:
+        """``topology.select_compact`` over ``s.free_chips``, memoized
+        per chip count against the summary's identity (any ledger
+        mutation republishes the summary and so invalidates every
+        entry). Callers must treat the result as read-only — it is the
+        cached object itself, handed out to every hit."""
+        ent = self.compact_memo.get(k)
+        if ent is None or ent[0] is not s:
+            chosen = self.topology.select_compact(list(s.free_chips), k)
+            memo = self.compact_memo
+            if len(memo) >= MEMO_CAP:
+                memo.clear()
+            ent = memo[k] = (s, chosen)
+        return ent[1]
 
     def get_free_chips(self) -> list[int]:
         """Chips with no resident pods at all (candidates for whole-chip

@@ -30,6 +30,11 @@ Environment (reference cmd/main.go:23,92-98):
   whole-free chips) or ``spread`` (emptiest placement wins — fewer
   co-tenants per chip for latency-sensitive inference fleets). Gang
   consolidation and ICI/slice affinity apply under both.
+* ``TPUSHARE_TOPOLOGY`` — ``on`` (default) arms the slice placer:
+  gangs annotated ``tpushare.io/slice-shape`` get a contiguous host
+  block elected on their slice's ICI torus and members are steered
+  onto it (docs/topology.md). ``off`` disables election + steering
+  (placement falls back to topology-blind, as before this feature).
 * ``TPUSHARE_QUOTA_NAMESPACE`` — namespace the ``tpushare-quotas``
   ConfigMap (per-tenant quota table, docs/quota.md) is trusted from;
   default ``kube-system``.
@@ -123,6 +128,17 @@ def build_stack(client, is_leader=None) -> Stack:
     scoring = os.environ.get("TPUSHARE_SCORING", "binpack")
     controller = Controller(client, is_leader=is_leader,
                             default_scoring=scoring)
+    # Topology-aware gang placement (docs/topology.md): the slice
+    # placer elects contiguous host blocks for gangs carrying
+    # tpushare.io/slice-shape. On by default — it costs nothing until
+    # such a gang arrives (per-gang, memoized; never on the single-pod
+    # fast path). TPUSHARE_TOPOLOGY=off disables election + steering
+    # fleet-wide (the runbook's kill switch).
+    placer = None
+    if os.environ.get("TPUSHARE_TOPOLOGY", "on").lower() not in (
+            "off", "0", "false", "no"):
+        from tpushare.topology.fleet import SlicePlacer
+        placer = SlicePlacer(controller.cache)
     # Quorum pre-checks enumerate nodes from the informer store — no
     # apiserver LIST on the bind path. The controller's quota ledger
     # (charged by the cache, configured from the tpushare-quotas
@@ -131,7 +147,8 @@ def build_stack(client, is_leader=None) -> Stack:
     # can never disagree on a tenant's standing.
     gang = GangPlanner(controller.cache, client,
                        node_lister=controller.hub.nodes.list,
-                       is_leader=is_leader, quota=controller.quota)
+                       is_leader=is_leader, quota=controller.quota,
+                       placer=placer)
     gang.start()  # housekeeping tick: gang expiry + bind retries
     # Demand entries prune against the informer's pod view so an HA
     # peer's bind (or a user's delete) retires the autoscaler signal

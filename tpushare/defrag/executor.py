@@ -236,9 +236,13 @@ class DefragExecutor:
         return plan.to_json()
 
     def build_plan(self) -> Plan | None:
-        """Author (and publish) a plan for the current pending set."""
+        """Author (and publish) a plan for the current pending set.
+        Runs even with NOTHING pending: an idle fleet is exactly when a
+        fragmented slice-shape gang's ring is cheapest to repair, and
+        the planner's own no-work pre-check keeps the empty-pending
+        tick O(pods), not O(fleet-clone)."""
         pending = self.pending_pods()
-        plan = self.planner.plan(pending) if pending else None
+        plan = self.planner.plan(pending)
         if plan is not None:
             with self._lock:
                 self._last_plan = plan

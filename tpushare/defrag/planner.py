@@ -44,6 +44,7 @@ from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
 from tpushare.cache.nodeinfo import AllocationError, NodeInfo
 from tpushare.quota.manager import QuotaManager
+from tpushare.topology.topology import Topology
 from tpushare.utils import const
 from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
@@ -195,6 +196,19 @@ class WhatIf:
         return name
 
 
+class _WhatIfTable:
+    """Adapter giving :class:`tpushare.topology.fleet.SlicePlacer` the
+    ``node_table()`` face of a cache over a what-if's detached ledgers,
+    so ring-repair elections replay the REAL election code against the
+    planner's hypothetical world."""
+
+    def __init__(self, nodes: dict[str, NodeInfo]) -> None:
+        self._nodes = nodes
+
+    def node_table(self) -> dict[str, NodeInfo]:
+        return dict(self._nodes)
+
+
 class RebalancePlanner:
     def __init__(self, cache: SchedulerCache,
                  quota: QuotaManager | None = None,
@@ -236,12 +250,30 @@ class RebalancePlanner:
 
     def plan(self, pending: list[Pod]) -> Plan | None:
         """Author a bounded move set that unblocks as much of ``pending``
-        as it can; None when no legal move helps (including when nothing
-        is pending — defrag never moves pods for aesthetics alone)."""
+        as it can, then spends any leftover budget repairing fragmented
+        rings (:meth:`_ring_repairs`); None when no legal move helps.
+        Defrag never moves pods for aesthetics alone — a ring repair is
+        not aesthetics: a slice-shape gang running its collectives over
+        multi-hop ICI pays the fragmentation on every training step, so
+        a contiguity-restoring move scores above any pure packing move
+        (which this planner simply never authors)."""
         infos = self.cache.sharing_node_infos()
-        if not infos or not pending:
+        if not infos:
+            return None
+        if not pending and not self._has_fragmented_slice_gang(infos):
+            # Nothing to unblock and no ring worth repairing: keep the
+            # (default-on, every-interval) idle tick O(pods + ring
+            # math over live node documents), not O(fleet-clone) —
+            # a healthy contiguous gang must not cost a WhatIf per
+            # tick forever.
             return None
         whatif = WhatIf(infos)
+        #: The REAL residents. The unblock loop below hypothetically
+        #: places pending pods into the what-if (so later pending pods
+        #: plan against the post-move world) — the repair pass must
+        #: never mistake those placements for bound gangs and author
+        #: evictions for pods that are not actually running.
+        residents = frozenset(whatif.located)
         moves: list[Move] = []
         unblocks: list[str] = []
         order = sorted(
@@ -270,11 +302,182 @@ class RebalancePlanner:
             moves.extend(new_moves)
             whatif.place(pod)
             unblocks.append(f"{pod.namespace}/{pod.name}")
+        moves.extend(self._ring_repairs(whatif, residents,
+                                        self.max_moves - len(moves)))
         if not moves:
             return None
         plan = Plan(moves, unblocks)
         self._record(plan)
         return plan
+
+    # -- ring repair (docs/topology.md) ---------------------------------- #
+
+    @staticmethod
+    def _has_fragmented_slice_gang(infos: list[NodeInfo]) -> bool:
+        """Any RESIDENT slice-shape gang whose worker-order ring is
+        below perfect contiguity? Computed from live node documents
+        only (gang_ring_stats needs positions, not ledgers) — the
+        cheap gate that lets the idle (nothing-pending) tick skip the
+        what-if clone entirely."""
+        from tpushare.topology import fleet as topo
+
+        gangs: dict[tuple[str, str], dict[str, Any]] = {}
+        for info in infos:
+            for chip in info.chips.values():
+                for pod in chip.snapshot_pods():
+                    if (not pod.annotations.get(const.ANN_POD_GROUP)
+                            or podutils.get_slice_shape(pod) is None
+                            or podutils.is_complete_pod(pod)):
+                        continue
+                    key = (pod.namespace,
+                           pod.annotations[const.ANN_POD_GROUP])
+                    gangs.setdefault(key, {})[pod.name] = info.node
+        for members in gangs.values():
+            ordered = sorted(members, key=topo.worker_sort_key)
+            stats = topo.gang_ring_stats(
+                [members[name] for name in ordered])
+            if stats is not None and stats["contiguity"] < 0.999:
+                return True
+        return False
+
+    def _ring_repairs(self, whatif: WhatIf, residents: frozenset[str],
+                      budget: int) -> list[Move]:
+        """Moves that restore a fragmented slice-shape gang's ring
+        contiguity: members of a committed gang whose worker-order ring
+        pays multi-hop ICI (or DCN) are relocated onto a freshly
+        elected contiguous block. Whole-gang eligibility applies
+        (every member must be movable — the eviction restarts the
+        group through the gang reaper and the owner re-gangs it
+        atomically, with the placer now finding the repaired block),
+        but only the off-slot members actually move. Runs on leftover
+        budget after pending-pod moves: unblocking stuck demand still
+        outranks speeding up running jobs."""
+        if budget <= 0:
+            return []
+        from tpushare.topology import fleet as topo
+
+        gangs: dict[tuple[str, str], list[tuple[str, Pod]]] = {}
+        for uid, (node, pod) in whatif.located.items():
+            if uid not in residents:
+                continue  # hypothetically-placed pending pod, not real
+            group = pod.annotations.get(const.ANN_POD_GROUP, "")
+            if not group or podutils.get_slice_shape(pod) is None:
+                continue
+            gangs.setdefault((pod.namespace, group), []).append((node,
+                                                                 pod))
+        out: list[Move] = []
+        for key, members in sorted(gangs.items()):
+            if len(out) >= budget:
+                break
+            # Worker (ring) order: numeric-ordinal pod-name order —
+            # the SAME key the gang planner's steering used, or an
+            # unpadded w-10 would sort next to w-1 and a perfectly
+            # placed ring would be "repaired" into a fragmented one.
+            members.sort(key=lambda m: topo.worker_sort_key(m[1].name))
+            infos = [whatif.nodes.get(n) for n, _ in members]
+            if any(i is None for i in infos):
+                continue
+            cur = topo.gang_ring_stats([i.node for i in infos
+                                        if i is not None])
+            if cur is None or cur["contiguity"] >= 0.999:
+                continue
+            if any(not self.movable(p)[0] for _, p in members):
+                continue
+            # Elect against a what-if with the gang REMOVED: the block
+            # the gang itself fragments is a legal destination.
+            trial = whatif.clone()
+            for _, p in members:
+                trial.remove(p.uid)
+            placer = topo.SlicePlacer(_WhatIfTable(trial.nodes))
+            placement = placer.elect(key, self._as_request(members[0][1]))
+            if placement is None or len(placement.hosts) < len(members):
+                continue
+            # Assign ring slots EXACTLY like bind-time steering will
+            # when the re-gang lands (worker ordinal when valid, next
+            # free slot otherwise), and judge the improvement by the
+            # MEMBERS' predicted post-move ring — not the full block's
+            # stats: a mismatch there authors an eviction whose
+            # steered outcome measures no better, and the next tick
+            # would author it again, forever.
+            slots = self._assign_slots(members, len(placement.hosts))
+            grid = Topology(dims=placement.grid_dims,
+                            torus=placement.torus)
+            new_contig = topo.ring_stats(
+                [placement.coords[s] for s in slots], grid)["contiguity"]
+            if new_contig <= cur["contiguity"]:
+                continue
+            gang_moves: list[Move] = []
+            for slot, (node, p) in zip(slots, members):
+                target = placement.hosts[slot]
+                if target == node:
+                    continue
+                move = Move(p, node, target)
+                move.detail = (f"ring-repair: contiguity "
+                               f"{cur['contiguity']} -> {new_contig}")
+                gang_moves.append(move)
+            if not gang_moves or len(out) + len(gang_moves) > budget:
+                continue
+            out.extend(gang_moves)
+            # Fold the repair into the LIVE what-if: a second
+            # fragmented gang in this same plan must see the block as
+            # taken, or both would elect it and one re-gang lands
+            # nowhere better than it started.
+            by_uid = {p.uid: (node, p) for node, p in members}
+            for move in gang_moves:
+                node, pod = by_uid[move.uid]
+                whatif.remove(move.uid)
+                self._apply_repair(whatif, move.to_node, pod)
+        return out
+
+    @staticmethod
+    def _assign_slots(members: list[tuple[str, Pod]],
+                      n_hosts: int) -> list[int]:
+        """Ring slots the gang planner's steering will hand these
+        members (in the given worker order): each member takes its
+        worker ordinal when it is a valid, unclaimed slot; otherwise
+        the first free slot in ring order."""
+        from tpushare.topology import fleet as topo
+
+        used: set[int] = set()
+        slots: list[int] = []
+        for _node, pod in members:
+            ordinal = topo.worker_ordinal(pod.name)
+            if (ordinal is not None and ordinal < n_hosts
+                    and ordinal not in used):
+                slot = ordinal
+            else:
+                slot = next(i for i in range(n_hosts) if i not in used)
+            used.add(slot)
+            slots.append(slot)
+        return slots
+
+    def _apply_repair(self, whatif: WhatIf, target: str,
+                      victim: Pod) -> None:
+        """Re-place one repaired member on its elected host inside the
+        what-if (the pinned-destination variant of ``WhatIf.place``).
+        Best-effort: the elected hosts were verified free by the
+        election, so a pick failure (a racing hypothetical placement)
+        just leaves the member out of the model — over-reserving the
+        block is the safe direction."""
+        info = whatif.nodes.get(target)
+        if info is None:
+            return
+        req = self._as_request(victim)
+        try:
+            chips = info.pick_chips(req)
+        # vet: ignore[swallowed-telemetry-error] - control flow: what-if modeling only; the real bind re-verifies
+        except AllocationError:
+            return
+        if podutils.get_chips_from_pod_resource(req) > 0:
+            hbm_pod = sum(info.chips[c].total_hbm for c in chips)
+        else:
+            hbm_pod = podutils.get_hbm_from_pod_resource(req)
+        placed = podutils.updated_pod_annotation_spec(
+            req, chips, hbm_pod, info.chips[chips[0]].total_hbm,
+            assume_time_ns=0)
+        placed.spec["nodeName"] = target
+        info.add_or_update_pod(placed)
+        whatif.located[victim.uid] = (target, placed)
 
     def _make_room(self, whatif: WhatIf, pod: Pod, budget: int
                    ) -> tuple[list[Move], WhatIf] | None:

@@ -67,6 +67,15 @@ class _Group:
         self.minimum = minimum
         self.deadline = time.monotonic() + ttl
         self.committed = False
+        #: Elected contiguous host block (topology.fleet.Placement) for
+        #: slice-shape gangs; None = no placer, no shape, or no
+        #: contiguous candidate existed at election time (members then
+        #: place unconstrained, each with a topology-fallback note).
+        self.placement = None
+        #: uid -> elected host claimed for that member. Guarded by the
+        #: group lock; a claim is released if the reservation fails so
+        #: a sibling can take the host.
+        self.claimed: dict[str, str] = {}
         #: TTL expiry detached this group and its rollback is running
         #: (or done). The group stays IN the table until the rollback's
         #: apiserver traffic finishes, so a racing re-reservation of a
@@ -96,9 +105,18 @@ class _Group:
 class GangPlanner:
     def __init__(self, cache, client, ttl: float = 120.0,
                  housekeeping_interval: float = 5.0, node_lister=None,
-                 is_leader=None, quota=None):
+                 is_leader=None, quota=None, placer=None):
         self.cache = cache
         self.client = client
+        #: Optional :class:`tpushare.topology.fleet.SlicePlacer`. When
+        #: wired, a gang carrying ``tpushare.io/slice-shape`` gets a
+        #: contiguous host block elected at its first member's quorum
+        #: pre-check; later members are steered onto the block at
+        #: reserve time, and prioritize's gang branch reads the same
+        #: election (``elected_hosts``) so the scheduler's own node
+        #: choice already points at the block. Election failure falls
+        #: back to unconstrained placement — never to rejection.
+        self.placer = placer
         #: Optional QuotaManager. The group's quota charge is atomic
         #: with the quorum lifecycle FOR FREE: each reservation is
         #: priced through ``cache.add_or_update_pod`` (which charges the
@@ -281,6 +299,17 @@ class GangPlanner:
                     f"gang {group.name}: quorum {group.minimum} can never "
                     f"assemble under its tenant's quota ({reason}); "
                     "rejecting without reserving")
+        # Topology pre-check (slice-shape gangs): elect the contiguous
+        # host block HERE, while the group holds nothing — the same
+        # moment the doomed-gang check runs. A successful election of
+        # >= needed hosts also proves capacity (every elected host fits
+        # a member), so the per-node walk below is skipped. A failed
+        # election is NOT infeasibility: the gang falls back to
+        # topology-blind placement (docs/topology.md fallback
+        # semantics) and the walk decides feasibility as before.
+        placement = self._elect_placement(pod, group)
+        if placement is not None and len(placement.hosts) >= needed:
+            return True, ""
         try:
             nodes = self._node_lister()
         except ApiError:
@@ -335,6 +364,162 @@ class GangPlanner:
         with group.lock:
             return {node for _, node in group.reservations.values()}
 
+    # ------------------------------------------------------------------ #
+    # Topology-aware placement (docs/topology.md)
+    # ------------------------------------------------------------------ #
+
+    def _elect_placement(self, pod: Pod, group: _Group):
+        """Run (or re-read, memoized) the slice placer's election for
+        ``pod``'s group and stash it on the group. Returns the
+        placement, or None — with the election failure traced and
+        counted exactly once per election attempt, because silence here
+        would make a fleet that quietly lost its topology labels look
+        identical to one that never had them."""
+        if self.placer is None:
+            return None
+        placement = self.placer.elect((pod.namespace, group.name), pod)
+        with group.lock:
+            group.placement = placement
+        if placement is not None:
+            trace.note("topologyElected",
+                       {"slice": placement.slice_id,
+                        "hosts": list(placement.hosts),
+                        "contiguity": placement.stats["contiguity"]})
+        elif podutils.get_slice_shape(pod) is not None:
+            trace.note("topology-fallback",
+                       "no contiguous host block for slice shape "
+                       f"{pod.annotations.get(const.ANN_SLICE_SHAPE)!r}; "
+                       "placing unconstrained")
+            from tpushare.routes import metrics
+            metrics.safe_inc(metrics.TOPOLOGY_FALLBACKS)
+        return placement
+
+    @staticmethod
+    def _ring_slot(pod_name: str) -> int | None:
+        """The member's ring slot: its worker ordinal (ONE definition —
+        topology.fleet.worker_ordinal — shared with every observer of
+        the ring, so the order steering builds is the order the gauge,
+        defrag repair, and reports measure)."""
+        from tpushare.topology import fleet
+
+        return fleet.worker_ordinal(pod_name)
+
+    def _steer(self, group: _Group, pod: Pod, node_name: str) -> str:
+        """Steer a slice-shape member onto its group's elected block —
+        onto its RING SLOT when the pod name carries a worker ordinal
+        (``w-3`` → ``placement.hosts[3]``): the elected hosts are in
+        snake ring order, so worker i next to worker i+1 on the grid is
+        what makes every collective hop one ICI link. Ordinal taken or
+        name non-ordinal → first unclaimed host in ring order. Falls
+        back to the scheduler's choice (with a ``topology-fallback``
+        trace note, and a counted fallback when a block EXISTED but
+        was exhausted/unusable — a failed election was already counted
+        once, by ``_elect_placement``) when steering cannot land the
+        member — a topology miss must degrade placement quality, never
+        block the gang."""
+        if podutils.get_slice_shape(pod) is None:
+            return node_name
+        with group.lock:
+            placement = group.placement
+            if placement is None:
+                # Election already failed (traced + counted ONCE by
+                # _elect_placement); note the per-member consequence
+                # for this member's own trace, but do not re-count —
+                # one gang-level fallback event is one count.
+                trace.note("topology-fallback",
+                           "no elected block for this group; placing "
+                           f"on {node_name}")
+                return node_name
+            already = group.claimed.get(pod.uid)
+            if already is not None:
+                return already  # idempotent retry of this member
+            taken = set(group.claimed.values())
+            candidates = [h for h in placement.hosts
+                          if h not in taken]
+            slot = self._ring_slot(pod.name)
+            if slot is not None and slot < len(placement.hosts):
+                slot_host = placement.hosts[slot]
+                if slot_host in candidates:
+                    candidates.remove(slot_host)
+                    candidates.insert(0, slot_host)
+        for host in candidates:
+            # peek is enough: the allocate below re-verifies against
+            # the live ledger, and a stale yes only costs one retry.
+            info = (self.cache.peek_node_info(host)
+                    or self.cache.get_node_info(host))
+            if info is None or not info.assume(pod)[0]:
+                continue
+            with group.lock:
+                if host in set(group.claimed.values()):
+                    continue  # a sibling claimed it while we checked
+                group.claimed[pod.uid] = host
+            trace.note("topologySteered",
+                       {"from": node_name, "to": host})
+            return host
+        trace.note("topology-fallback",
+                   f"elected block unavailable for {pod.key()}; "
+                   f"placing on {node_name}")
+        from tpushare.routes import metrics
+        metrics.safe_inc(metrics.TOPOLOGY_FALLBACKS)
+        return node_name
+
+    def elected_hosts(self, pod: Pod) -> frozenset[str]:
+        """The elected contiguous hosts for ``pod``'s group (feeds the
+        prioritizer's contiguity term). For a slice-shape pod whose
+        group does not exist yet (prioritize runs before the first
+        bind), the election runs eagerly — memoized, so the bind-path
+        election is a re-read, not a second fleet scan."""
+        if self.placer is None or podutils.get_slice_shape(pod) is None:
+            return frozenset()
+        group_name, _ = podutils.get_pod_group(pod)
+        key = (pod.namespace, group_name)
+        with self._table_lock:
+            group = self._groups.get(key)
+        if group is not None:
+            with group.lock:
+                placement = group.placement
+            if placement is not None:
+                return placement.host_set()
+            return frozenset()
+        placement = self.placer.elect(key, pod)
+        return placement.host_set() if placement is not None \
+            else frozenset()
+
+    def _note_ring_contiguity(self, key: tuple[str, str],
+                              group: _Group,
+                              members: list[tuple[Pod, str]]) -> None:
+        """Publish the COMMITTED gang's actual ring contiguity (members
+        in worker order — fleet.worker_sort_key, the SAME numeric-
+        ordinal order steering placed them in) as the
+        tpushare_gang_ring_contiguity gauge and a trace note. The gauge
+        is also rebuilt per scrape from the live ledger
+        (metrics.observe_topology), so departed gangs drop their label
+        series instead of freezing. Purely observational: failures are
+        logged, never raised into the bind path."""
+        try:
+            from tpushare.routes import metrics
+            from tpushare.topology import fleet
+
+            ordered = sorted(members,
+                             key=lambda m: fleet.worker_sort_key(
+                                 m[0].name))
+            nodes = []
+            for _pod, node_name in ordered:
+                info = (self.cache.peek_node_info(node_name)
+                        or self.cache.get_node_info(node_name))
+                if info is None:
+                    return
+                nodes.append(info.node)
+            stats = fleet.gang_ring_stats(nodes)
+            if stats is None:
+                return
+            metrics.GANG_RING_CONTIGUITY.labels(
+                gang=f"{key[0]}/{group.name}").set(stats["contiguity"])
+            trace.note("ringContiguity", stats["contiguity"])
+        except Exception:  # noqa: BLE001 - telemetry must not bind
+            log.debug("ring-contiguity note failed for gang %s/%s",
+                      key[0], group.name, exc_info=True)
+
     def bind_member(self, pod: Pod, node_name: str) -> None:
         """Reserve-or-commit one gang member; raises GangPending below
         quorum and AllocationError/ApiError on real failures.
@@ -355,6 +540,14 @@ class GangPlanner:
             self._reserve_member(key, group, pod, node_name)
             newly_committed = self._note_quorum(key, group)
 
+        if newly_committed:
+            # The committed placement's ring contiguity — the number
+            # the whole topology subsystem exists to maximize — plus
+            # memo release: a committed gang's election can never be
+            # re-read (the group is forgotten once fully bound).
+            self._note_ring_contiguity(key, group, newly_committed)
+            if self.placer is not None:
+                self.placer.forget(key)
         for member_pod, member_node in newly_committed:
             events.record(
                 self.client, member_pod, events.REASON_GANG_COMMITTED,
@@ -401,6 +594,12 @@ class GangPlanner:
         finally:
             with group.lock:
                 group.reserving.discard(pod.uid)
+                if pod.uid not in group.reservations:
+                    # Reservation failed: release the member's elected-
+                    # host claim so a sibling (or this member's retry)
+                    # can take the host instead of leaving a hole in
+                    # the block until the TTL.
+                    group.claimed.pop(pod.uid, None)
 
     def _reserve_member_unlocked(self, key: tuple[str, str],
                                  group: _Group, pod: Pod,
@@ -437,6 +636,11 @@ class GangPlanner:
                     raise AllocationError(reason)
                 # A sibling reserved while we ran the pre-check: the
                 # group is live after all — fall through and allocate.
+        # Topology steering: a slice-shape member lands on its group's
+        # elected contiguous block when one is held (election ran in the
+        # first member's quorum pre-check; prioritize usually already
+        # pointed the scheduler here, making this a claim, not a move).
+        node_name = self._steer(group, pod, node_name)
         info = self.cache.get_node_info(node_name)
         if info is None:
             raise AllocationError(f"unknown node {node_name}")
@@ -670,6 +874,10 @@ class GangPlanner:
             with self._table_lock:
                 if self._groups.get(key) is group:
                     del self._groups[key]
+            if self.placer is not None:
+                # Next incarnation of this gang must re-elect against
+                # the post-rollback fleet, not re-read a stale block.
+                self.placer.forget(key)
             rolled += 1
         return rolled
 

@@ -108,6 +108,27 @@ GANGS_REAPED = Counter(
     "something keeps evicting gang members)",
     registry=REGISTRY,
 )
+GANG_RING_CONTIGUITY = Gauge(
+    "tpushare_gang_ring_contiguity",
+    "Ring contiguity of the gang's COMMITTED placement (members in "
+    "worker order over their slice's host grid): 1.0 = every ring hop "
+    "is one ICI link; lower means multi-hop ICI or DCN crossings on "
+    "the job's collective path. Set at gang commit; a low value on a "
+    "slice-shape gang means the placer fell back — see "
+    "tpushare_topology_fallbacks_total and docs/topology.md",
+    ["gang"], registry=REGISTRY,
+)
+TOPOLOGY_FALLBACKS = Counter(
+    "tpushare_topology_fallbacks_total",
+    "Slice-shape gang placements that fell back to topology-blind "
+    "placement: no contiguous host block existed at election, or the "
+    "elected block could no longer host a member at reserve time. "
+    "Each fallback is a gang that will run its collectives over "
+    "multi-hop ICI or DCN — sustained growth means the fleet is too "
+    "fragmented for its gang shapes (defrag repairs rings; "
+    "docs/topology.md runbook)",
+    registry=REGISTRY,
+)
 GANGS_PENDING = Gauge(
     "tpushare_gangs_pending",
     "Gangs holding reservations below quorum (stuck gangs -> alert)",
@@ -584,6 +605,42 @@ def observe_cache(cache) -> None:
                 OVERRUN_PODS.labels(node=info.name).set(overrunning)
 
 
+def observe_topology(cache) -> None:
+    """Rebuild the per-gang ring-contiguity gauge from the live ledger
+    (slice-shape gangs with assigned, non-terminated members, in
+    worker order). Rebuilt from scratch each scrape — the repo's
+    per-entity gauge convention — so a finished gang's label series
+    disappears instead of freezing at its last value. The commit-time
+    set in the gang planner gives instant visibility; this keeps the
+    series honest afterwards."""
+    from tpushare.topology import fleet
+    from tpushare.utils import const
+    from tpushare.utils import pod as podutils
+
+    with _SCRAPE_LOCK:
+        GANG_RING_CONTIGUITY.clear()
+        gangs: dict = {}
+        for info in cache.get_node_infos():
+            seen: set = set()
+            for chip in info.chips.values():
+                for p in chip.snapshot_pods():
+                    if p.uid in seen or podutils.is_complete_pod(p):
+                        continue
+                    seen.add(p.uid)
+                    group = p.annotations.get(const.ANN_POD_GROUP)
+                    if not group or podutils.get_slice_shape(p) is None:
+                        continue
+                    key = f"{p.namespace}/{group}"
+                    gangs.setdefault(key, {})[p.name] = info.node
+        for key, members in gangs.items():
+            ordered = sorted(members, key=fleet.worker_sort_key)
+            stats = fleet.gang_ring_stats(
+                [members[name] for name in ordered])
+            if stats is not None:
+                GANG_RING_CONTIGUITY.labels(gang=key).set(
+                    stats["contiguity"])
+
+
 def observe_quota(quota) -> None:
     """Refresh per-tenant quota gauges from the tenant ledger. Rebuilt
     from scratch each scrape (like the node gauges) so a tenant whose
@@ -794,6 +851,7 @@ def scrape(cache, gang_planner=None, leader=None, demand=None,
     try:
         with _SCRAPE_LOCK:
             observe_cache(cache)
+            observe_topology(cache)
             observe_slo()
             observe_profiling()
             observe_process()

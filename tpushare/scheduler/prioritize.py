@@ -39,7 +39,7 @@ from typing import Any
 from tpushare import trace
 from tpushare.api.extender import ExtenderArgs, HostPriority
 from tpushare.api.objects import Pod
-from tpushare.cache.nodeinfo import MEMO_CAP, NodeInfo
+from tpushare.cache.nodeinfo import MEMO_CAP, NodeInfo, NodeSummary
 from tpushare.cache.cache import SchedulerCache
 from tpushare.utils import const
 from tpushare.utils import node as nodeutils
@@ -160,19 +160,30 @@ class Prioritize:
     def _score_chips(self, info: NodeInfo, req: int,
                      member_slices: dict | None,
                      policy: str,
-                     free: list[int] | None = None) -> int:
-        if free is None:
-            free = info.get_free_chips()
-        if len(free) < req or info.chip_count == 0:
+                     elected: frozenset[str] | None = None,
+                     s: NodeSummary | None = None) -> int:
+        # The compact selection is memoized against the admission
+        # summary's identity (NodeInfo.select_compact_cached): the
+        # greedy O(k * free^2) search re-runs only when this node's own
+        # ledger changed, keeping prioritize at 1k candidates inside
+        # the per-verb frame budget (docs/perf.md). ``s`` lets the
+        # fast path hand down the summary it already read — no
+        # re-read, no throwaway free-list copies per candidate.
+        if s is None:
+            s = info._summary
+            if s is None:
+                s = info.summary()
+        free_n = len(s.free_chips)
+        if free_n < req or info.chip_count == 0:
             return 0
-        leftover = len(free) - req
+        leftover = free_n - req
         # binpack: exact pack -> 8, cracking a pristine host -> low.
         # spread: inverted — the emptiest host wins.
         fit = leftover / info.chip_count
         if policy == "binpack":
             fit = 1.0 - fit
         score = round((MAX_SCORE - 2) * fit)
-        chosen = info.topology.select_compact(free, req)
+        chosen = info.select_compact_cached(s, req)
         if chosen and len(chosen) > 1:
             pairs = len(chosen) * (len(chosen) - 1) / 2
             mean_dist = info.topology.dispersion(chosen) / pairs
@@ -182,35 +193,63 @@ class Prioritize:
                 score += 1
         elif chosen:
             score += 2  # single chip is trivially compact
+        if elected:
+            # Contiguity term for slice-shape gang members: the gang
+            # planner's SlicePlacer elected a contiguous host block on
+            # the slice's ICI torus (docs/topology.md). Every elected
+            # host scores MAX_SCORE flat — the gang will occupy the
+            # WHOLE block (bind-time steering assigns the exact ring
+            # slot), so fit discrimination within it is meaningless,
+            # and a flat top is the only way an off-block host can
+            # never tie it (a capped fit+bonus sum can, e.g. an
+            # exact-pack adjacent host vs a whole-free block host for
+            # a sub-host member).
+            if info.name in elected:
+                return MAX_SCORE
+            # Off-block hosts keep the slice-affinity ordering among
+            # themselves (the fallback ordering) capped strictly
+            # below the block.
+            return max(0, min(MAX_SCORE - 1, self._affinity(
+                score, info, member_slices)))
         if member_slices:
-            # Cap the fit+compactness component below MAX_SCORE so the
-            # slice bonus has headroom — an exact whole-host pack must
-            # still score higher on the member's slice than off it (an
-            # uncapped 10+2 would clamp back to a tie). Only when slice
-            # affinity is in play: for ordinary pods the compactness
-            # bonus must keep discriminating at the top of the scale.
-            score = min(score, MAX_SCORE - 2)
-            # Slice affinity: hosts of one multi-host slice share ICI;
-            # hosts of different slices only share DCN. Steering the
-            # gang's next worker onto a slice that already hosts a
-            # member keeps the job's collectives off the datacenter
-            # network — and WITHIN the slice, onto a host ICI-adjacent
-            # to a member: one hop on the host grid beats the far
-            # corner of the torus (every extra hop is contended
-            # bandwidth on the job's all-reduce path).
-            sid = nodeutils.get_slice_id(info.node)
-            if sid and sid in member_slices:
-                bonus = 2
-                member_coords = member_slices[sid]
-                pos = nodeutils.host_position(info.node)
-                if member_coords and pos is not None:
-                    coords, grid = pos
-                    d = min(grid.distance_coords(coords, mc)
-                            for mc in member_coords)
-                    # Adjacent (or same host) beats same-slice-far.
-                    bonus = 2 if d <= 1 else 1
-                score += bonus
+            score = self._affinity(score, info, member_slices)
         return max(0, min(MAX_SCORE, score))
+
+    @staticmethod
+    def _affinity(score: int, info: NodeInfo,
+                  member_slices: dict | None) -> int:
+        """The slice-affinity adjustment, shared by the plain gang path
+        and the elected-block fallback ordering. Caps the
+        fit+compactness component below MAX_SCORE so the slice bonus
+        has headroom — an exact whole-host pack must still score higher
+        on the member's slice than off it (an uncapped 10+2 would clamp
+        back to a tie). Only applied when slice affinity is in play:
+        for ordinary pods the compactness bonus must keep
+        discriminating at the top of the scale."""
+        if not member_slices:
+            return score
+        score = min(score, MAX_SCORE - 2)
+        # Slice affinity: hosts of one multi-host slice share ICI;
+        # hosts of different slices only share DCN. Steering the
+        # gang's next worker onto a slice that already hosts a
+        # member keeps the job's collectives off the datacenter
+        # network — and WITHIN the slice, onto a host ICI-adjacent
+        # to a member: one hop on the host grid beats the far
+        # corner of the torus (every extra hop is contended
+        # bandwidth on the job's all-reduce path).
+        sid = nodeutils.get_slice_id(info.node)
+        if sid and sid in member_slices:
+            bonus = 2
+            member_coords = member_slices[sid]
+            pos = nodeutils.host_position(info.node)
+            if member_coords and pos is not None:
+                coords, grid = pos
+                d = min(grid.distance_coords(coords, mc)
+                        for mc in member_coords)
+                # Adjacent (or same host) beats same-slice-far.
+                bonus = 2 if d <= 1 else 1
+            score += bonus
+        return score
 
     # ------------------------------------------------------------------ #
 
@@ -241,18 +280,31 @@ class Prioritize:
         req_hbm = podutils.get_hbm_from_pod_resource(pod)
         return self._score_one(node_name, req_chips, req_hbm, gang_nodes,
                                self._member_slices(gang_nodes),
-                               policy=self._policy_for(pod))
+                               policy=self._policy_for(pod),
+                               elected=self._elected_for(pod, req_chips))
+
+    def _elected_for(self, pod: Pod, req_chips: int) -> frozenset[str]:
+        """The gang planner's elected contiguous hosts for a
+        slice-shape chip-gang member (empty otherwise). Never touched
+        on the lone-pod fast path; the planner's answer is memoized
+        per gang, so this is a dict read in steady state."""
+        if (self.gang_planner is None or req_chips <= 0
+                or not podutils.is_gang_pod(pod)
+                or podutils.get_slice_shape(pod) is None):
+            return frozenset()
+        return self.gang_planner.elected_hosts(pod)
 
     def _score_one(self, node_name: str, req_chips: int, req_hbm: int,
                    gang_nodes: set[str],
                    member_slices: dict | None,
-                   policy: str) -> int:
+                   policy: str,
+                   elected: frozenset[str] | None = None) -> int:
         info = self.cache.get_node_info(node_name)
         if info is None:
             return 0
         if req_chips > 0:
             return self._score_chips(info, req_chips, member_slices,
-                                     policy=policy)
+                                     policy=policy, elected=elected)
         if req_hbm <= 0:
             return 0
         return self._score_hbm(info, req_hbm, gang_nodes, policy=policy)
@@ -271,20 +323,27 @@ class Prioritize:
         req_hbm = podutils.get_hbm_from_pod_resource(pod)
         gang_nodes: set[str] = set()
         member_slices: dict = {}
+        elected: frozenset[str] = frozenset()
         if self.gang_planner is not None and podutils.is_gang_pod(pod):
             gang_nodes = self.gang_planner.member_nodes(pod)
             if req_chips > 0 and gang_nodes:
                 # Whole-host workers of a multi-host job: prefer hosts
                 # on a slice already holding a member (ICI over DCN).
                 member_slices = self._member_slices(gang_nodes)
+            # Slice-shape gangs: the planner's elected contiguous
+            # block (memoized per gang — a dict read in steady state)
+            # outranks every off-block host, so the scheduler's own
+            # choice already lands on the ring (docs/topology.md).
+            elected = self._elected_for(pod, req_chips)
 
         policy = self._policy_for(pod)
-        if gang_nodes or member_slices:
-            # Gang member: the consolidation / slice-affinity bonuses
-            # are per-node facts the summary cannot carry — full path.
+        if gang_nodes or member_slices or elected:
+            # Gang member: the consolidation / slice-affinity /
+            # contiguity bonuses are per-node facts the summary cannot
+            # carry — full path.
             out = [HostPriority(host=n, score=self._score_one(
                        n, req_chips, req_hbm, gang_nodes, member_slices,
-                       policy=policy))
+                       policy=policy, elected=elected))
                    for n in names]
         else:
             # Fast path: score from the admission summaries (lock-free
@@ -309,8 +368,7 @@ class Prioritize:
                 if ent is None or ent[0] is not s:
                     if req_chips > 0:
                         score = self._score_chips(
-                            info, req_chips, None, policy=policy,
-                            free=list(s.free_chips))
+                            info, req_chips, None, policy=policy, s=s)
                     elif req_hbm <= 0:
                         score = 0
                     else:
@@ -323,7 +381,12 @@ class Prioritize:
                         memo.clear()
                     ent = memo[shape] = (s, score)
                 out.append(HostPriority(host=n, score=ent[1]))
-        if self.quota is not None:
+        if self.quota is not None and not elected:
+            # Elected-block members are exempt from the fairness nudge:
+            # a +1 on an off-block host would clamp into a tie with the
+            # block's flat MAX_SCORE, and tenant standing has no
+            # bearing on WHICH host a gang member lands on — its
+            # cross-POD ordering already happened at filter/admit.
             adjust = self.quota.score_adjust(pod)
             if adjust:
                 # Only FEASIBLE nodes move: a zero score means "cannot

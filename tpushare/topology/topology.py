@@ -133,9 +133,14 @@ class Topology:
             return sorted(free)
         best: list[int] | None = None
         best_cost = None
-        for seed in free:
+        # Seed/pool iteration over the SORTED free set: equally-compact
+        # selections tie-break toward the lowest chip indices no matter
+        # what order the caller's free list arrived in — the memoized
+        # fast path and a direct recompute must never disagree.
+        free_sorted = sorted(free)
+        for seed in free_sorted:
             chosen = [seed]
-            pool = [c for c in free if c != seed]
+            pool = [c for c in free_sorted if c != seed]
             while len(chosen) < k:
                 nxt = min(pool, key=lambda c: sum(self.distance(c, x) for x in chosen))
                 chosen.append(nxt)
@@ -165,6 +170,9 @@ def slice_host_grid(slice_topo: str, host_topo: str,
     try:
         s = parse_topology(slice_topo)
         h = parse_topology(host_topo)
+    # Control flow, not telemetry: malformed specs mean "no grid",
+    # which every caller handles as the degenerate case.
+    # vet: ignore[swallowed-telemetry-error] - control flow: malformed topology spec returns the documented None
     except ValueError:
         return None
     h = h + (1,) * (len(s) - len(h))

@@ -158,6 +158,16 @@ ANN_POD_GROUP = "tpushare.io/pod-group"
 #: is bound (all-or-nothing admission).
 ANN_POD_GROUP_MIN = "tpushare.io/pod-group-min"
 
+#: Requested ICI slice shape for a gang, in CHIP dims (e.g. "4x4x4" on
+#: v5p — the sub-slice the job's mesh spans). The gang planner's
+#: SlicePlacer converts it to a host block per multi-host slice (chip
+#: dims divided elementwise by the slice's host topology) and elects a
+#: contiguous, torus-aware set of hosts for the group; members are
+#: steered onto the elected hosts at bind time, falling back to
+#: unconstrained placement (with a recorded ``topology-fallback`` trace
+#: note) when no contiguous candidate exists. See docs/topology.md.
+ANN_SLICE_SHAPE = "tpushare.io/slice-shape"
+
 #: Set to "false" to disable the controller's gang reaper for this group:
 #: by default, when an ASSIGNED member of a gang dies mid-run (eviction,
 #: preemption, node failure) and the group drops below its minimum, the

@@ -166,6 +166,23 @@ def get_pod_group(pod: Pod) -> tuple[str, int]:
     return group, max(minimum, 0)
 
 
+def get_slice_shape(pod: Pod) -> tuple[int, ...] | None:
+    """The gang's requested ICI slice shape (``tpushare.io/slice-shape``,
+    chip dims like "4x4x4"), or None when absent or malformed. Malformed
+    values are treated as absent — a typo must degrade to topology-blind
+    placement, never break the bind path (the admission webhook is where
+    loud rejection belongs)."""
+    spec = pod.annotations.get(const.ANN_SLICE_SHAPE, "")
+    if not spec:
+        return None
+    from tpushare.topology.topology import parse_topology
+
+    try:
+        return parse_topology(str(spec))
+    except ValueError:
+        return None
+
+
 def effective_scoring(pod: Pod, default: str | None = None) -> str:
     """The pod's effective scoring policy: its ``tpushare.io/scoring``
     annotation when valid, else ``default`` (or the fleet default from

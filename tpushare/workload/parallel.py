@@ -375,6 +375,112 @@ def make_ring_attn_fn(mesh: Mesh, use_flash: bool | None = None,
     return attn
 
 
+# --------------------------------------------------------------------------
+# Ring-latency model: placement coordinates -> predicted step time
+# --------------------------------------------------------------------------
+#
+# The scheduler side elects WHERE a gang's workers sit on the host
+# torus (tpushare/topology/fleet.py); this model prices WHAT that
+# placement costs the collectives above, in milliseconds — so a
+# contiguity score becomes a predicted step time the bench can gate on
+# (contiguous must beat scattered in ms, not just in a score).
+#
+# The physics it encodes, deliberately first-order:
+#
+# * A ring collective (the ``ppermute`` rotation in ring attention, the
+#   stage-to-stage sends of the 1F1B pipeline) advances at the pace of
+#   its SLOWEST logical hop: every device must receive its block before
+#   the next rotation, so per-rotation time is max over hops, and total
+#   collective time is rotations x that max.
+# * A logical hop between ring neighbors ``d`` grid hops apart rides
+#   ``d`` physical ICI links — and in a ring where EVERY neighbor pair
+#   is ~d hops apart, each physical link carries ~d logical streams, so
+#   the effective per-stream bandwidth is link/d and the latency term
+#   is d per-hop latencies. This is exactly why contiguity (d == 1
+#   everywhere) is the optimum.
+# * A hop whose endpoints share no slice (or whose position is unknown)
+#   leaves the ICI domain entirely: DCN latency + NIC bandwidth.
+
+#: Per-direction ICI link bandwidth, GiB/s (v5p-class; the model's
+#: RATIOS — ICI vs DCN, 1-hop vs d-hop — are what the bench gates on,
+#: not the absolute numbers).
+ICI_LINK_GIBPS = 90.0
+#: Single ICI hop latency, µs.
+ICI_HOP_LATENCY_US = 1.0
+#: Host NIC / datacenter-network bandwidth, GiB/s.
+DCN_GIBPS = 12.5
+#: DCN crossing latency, µs.
+DCN_LATENCY_US = 50.0
+
+
+def hop_time_us(hops: int | None, payload_bytes: float) -> float:
+    """Time for one logical ring hop carrying ``payload_bytes``.
+    ``hops`` is the grid distance between the ring neighbors; ``None``
+    means the hop leaves the slice (DCN). Zero hops (two workers on
+    one host) ride the host's own ICI as one hop."""
+    gib = payload_bytes / (1024.0 ** 3)
+    if hops is None:
+        return DCN_LATENCY_US + gib / DCN_GIBPS * 1e6
+    d = max(int(hops), 1)
+    return d * ICI_HOP_LATENCY_US + gib / (ICI_LINK_GIBPS / d) * 1e6
+
+
+def ring_rotation_time_us(hop_list: list[int | None],
+                          payload_bytes: float) -> float:
+    """One rotation of a ring collective over neighbors ``hop_list``
+    grid-hops apart: all transfers run concurrently, the slowest gates
+    the rotation."""
+    if not hop_list:
+        return 0.0
+    return max(hop_time_us(h, payload_bytes) for h in hop_list)
+
+
+def ring_collective_time_us(hop_list: list[int | None],
+                            payload_bytes: float,
+                            rotations: int | None = None) -> float:
+    """A full ring pass (default n-1 rotations, the ppermute count of
+    ring attention / a ring all-reduce's reduce-scatter phase)."""
+    n = len(hop_list)
+    if n == 0:
+        return 0.0
+    if rotations is None:
+        rotations = n - 1
+    return rotations * ring_rotation_time_us(hop_list, payload_bytes)
+
+
+def predicted_step_time_ms(sp_rings: list[list[int | None]],
+                           pp_links: list[int | None],
+                           *,
+                           layers: int = 32,
+                           microbatches: int = 8,
+                           kv_block_bytes: float = 64 * 1024 * 1024,
+                           act_bytes: float = 32 * 1024 * 1024,
+                           compute_ms: float = 20.0) -> float:
+    """Predicted training-step time of a pp x sp mesh placed at given
+    grid distances.
+
+    ``sp_rings``: per pipeline stage, the hop list of its sequence-
+    parallel ring (ring attention rotates KV blocks ``sp - 1`` times
+    per layer; stages run concurrently, so the slowest stage's ring
+    gates the step). ``pp_links``: hop distance of each stage->stage
+    boundary; 1F1B crosses each boundary twice per microbatch
+    (forward activation + backward gradient). ``compute_ms`` is the
+    placement-invariant MXU time — it is what keeps the model honest:
+    a scattered placement cannot look infinitely worse than it is,
+    because compute does not move.
+    """
+    sp_us = 0.0
+    if sp_rings:
+        sp_us = layers * max(
+            ring_collective_time_us(ring, kv_block_bytes)
+            for ring in sp_rings)
+    pp_us = 0.0
+    if pp_links:
+        pp_us = 2 * microbatches * max(
+            hop_time_us(h, act_bytes) for h in pp_links)
+    return compute_ms + (sp_us + pp_us) / 1000.0
+
+
 def global_positions(mesh: Mesh, batch: int, seq: int) -> jax.Array:
     """[B, L] absolute positions, sharded like the tokens, so each sp
     shard applies rotary with its global offset."""
