@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-topo bench-workload bench-router all
+.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-wire bench-topo bench-workload bench-router all
 
 # CPU test suite (virtual 8-device mesh; kernels in interpreter mode).
 test:
@@ -25,7 +25,7 @@ lint:
 # fails on any lock-order cycle (potential deadlock) or any mutation of
 # a registered guarded container while its lock is unheld.
 test-race:
-	TPUSHARE_RACE_DETECT=1 python -m pytest tests/test_soak.py tests/test_scale.py tests/test_vet.py tests/test_trace.py tests/test_profiling.py -q
+	TPUSHARE_RACE_DETECT=1 python -m pytest tests/test_soak.py tests/test_scale.py tests/test_vet.py tests/test_trace.py tests/test_profiling.py tests/test_http_server.py -q
 
 # On-chip Pallas kernel regression — REQUIRES real TPU hardware.
 # Interpreter-mode tests cannot catch (8,128)-tiling / MXU lowering
@@ -55,6 +55,14 @@ bench:
 # docs/perf.md hot-path budget.
 bench-scale:
 	python bench.py --scale --gate
+
+# The concurrent-client wire scenario (docs/perf.md, wire section):
+# subprocess clients (their own GIL — the honest wire clock), gated on
+# wire p99 <= handler p99 + 1.5 ms, throughput scaling with client
+# parallelism (core-honest limit), and the depth-1 batch bypass.
+# Writes BENCH_WIRE_r01.json.
+bench-wire:
+	python bench.py --wire --gate
 
 # Topology-aware gang placement: the contiguous-vs-scattered proof on
 # a 4x4x4 host torus, priced by the ring-latency model and gated

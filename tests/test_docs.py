@@ -216,7 +216,16 @@ def test_perf_doc_covers_the_contract():
                    "BENCH_SCALE.json", "BENCH_SCALE.collapsed",
                    "Server-Timing", "percentageOfNodesToScore",
                    "attribution", "coverage", "Runbook",
-                   "gc.freeze", "Justified", "Target"):
+                   "gc.freeze", "Justified", "Target",
+                   # The wire-path section (PR 11): pool model,
+                   # fast-path JSON, micro-batching, the wire gate,
+                   # and its runbook must stay documented.
+                   "TPUSHARE_HTTP_WORKERS", "TPUSHARE_HTTP_TIMEOUT_S",
+                   "TPUSHARE_BATCH_WINDOW_MS", "TPUSHARE_BATCH_MAX",
+                   "TPUSHARE_BATCH=off", "queue;dur=", "/debug/http",
+                   "back-pressure", "--wire-client", "bench-wire",
+                   "handler p99 + 1.5 ms", "depth 1",
+                   "Wire runbook"):
         assert needle in doc, needle
     # every per-verb/profiler/process metric the code registers is in
     # the observability catalogue (the blanket gate covers that); the

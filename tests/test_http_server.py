@@ -1,0 +1,458 @@
+"""The wire path: bounded worker pool, keep-alive, micro-batching.
+
+PR 11's contract (docs/perf.md, wire section): the extender serves
+concurrent connections from a BOUNDED pool with back-pressure, survives
+hostile framing (oversized/truncated bodies, stalled clients) without
+wedging a worker, coalesces concurrent read verbs through the
+micro-batch gate — bypassed at depth 1 — and the wire fast paths
+(routes/wire.py) are byte-compatible with the general JSON machinery.
+Runs under ``make test-race`` so the lock-order/guarded-mutation
+detector watches the pool and the gate.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tpushare.api.extender import (ExtenderArgs, ExtenderFilterResult,
+                                   HostPriority,
+                                   host_priority_list_to_json)
+from tpushare.cache.cache import SchedulerCache
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.routes import wire
+from tpushare.routes.batch import VerbBatcher
+from tpushare.routes.server import ExtenderHTTPServer, serve_forever
+from tpushare.scheduler.bind import Bind
+from tpushare.scheduler.inspect import Inspect
+from tpushare.scheduler.predicate import Predicate
+from tpushare.scheduler.prioritize import Prioritize
+
+
+@pytest.fixture
+def server(api, v5e_node):
+    cache = SchedulerCache(api.get_node, api.list_pods)
+    srv = ExtenderHTTPServer(
+        ("127.0.0.1", 0), Predicate(cache), Bind(cache, api),
+        Inspect(cache, api.list_nodes),
+        prioritize=Prioritize(cache),
+        # Short socket timeout so the slow-client tests run in
+        # milliseconds, not the production 30 s.
+        socket_timeout_s=0.4, http_workers=4)
+    serve_forever(srv)
+    yield api, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _post(base, path, doc):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _filter_doc(name="p"):
+    return {"Pod": make_pod(name, hbm=8), "NodeNames": ["v5e-node-0"]}
+
+
+def _raw_request(path, body: bytes, extra_headers="") -> bytes:
+    return (f"POST {path} HTTP/1.1\r\nHost: t\r\n"
+            f"Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n{extra_headers}"
+            f"\r\n").encode() + body
+
+
+def _recv_until_bodies(sock, n_responses, timeout=10.0) -> bytes:
+    """Read until ``n_responses`` complete HTTP responses arrived."""
+    sock.settimeout(timeout)
+    buf = b""
+    deadline = time.time() + timeout
+    while buf.count(b"HTTP/1.1 ") < n_responses or not _complete(
+            buf, n_responses):
+        if time.time() > deadline:
+            raise AssertionError(f"timed out with {buf!r}")
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+def _complete(buf: bytes, n: int) -> bool:
+    """All ``n`` responses fully received (Content-Length honored)?"""
+    rest, seen = buf, 0
+    while seen < n:
+        head, sep, rest = rest.partition(b"\r\n\r\n")
+        if not sep:
+            return False
+        length = 0
+        for line in head.split(b"\r\n"):
+            if line.lower().startswith(b"content-length:"):
+                length = int(line.split(b":")[1])
+        if len(rest) < length:
+            return False
+        rest = rest[length:]
+        seen += 1
+    return True
+
+
+class TestWorkerPool:
+    def test_pipelined_keepalive_requests(self, server):
+        """Two requests written back-to-back on one connection before
+        reading anything: both answered, in order, on that same
+        connection — and the reuse counter sees the second one."""
+        api, srv, base = server
+        host, port = srv.server_address[:2]
+        body = json.dumps(_filter_doc()).encode()
+        with socket.create_connection((host, port)) as s:
+            s.sendall(_raw_request("/tpushare-scheduler/filter", body)
+                      + _raw_request("/tpushare-scheduler/filter", body))
+            buf = _recv_until_bodies(s, 2)
+        assert buf.count(b"HTTP/1.1 200") == 2
+        assert buf.count(b'"NodeNames":["v5e-node-0"]') == 2
+        assert srv.keepalive_reuses_total >= 1
+
+    def test_oversized_body_400_worker_survives(self, server):
+        api, srv, base = server
+        host, port = srv.server_address[:2]
+        with socket.create_connection((host, port)) as s:
+            # Declare a body far past the limit; send none of it. The
+            # server must refuse WITHOUT trying to drain it.
+            s.sendall(_raw_request("/tpushare-scheduler/filter", b"")
+                      .replace(b"Content-Length: 0",
+                               b"Content-Length: 99999999999"))
+            buf = _recv_until_bodies(s, 1)
+        assert b"HTTP/1.1 400" in buf and b"too large" in buf
+        # The worker that answered is free again: a sane request works.
+        status, doc = _post(base, "/tpushare-scheduler/filter",
+                            _filter_doc())
+        assert status == 200 and doc["NodeNames"] == ["v5e-node-0"]
+
+    def test_truncated_body_times_out_400_no_wedge(self, server):
+        """A client that promises 1000 bytes and stalls after 10 hits
+        the socket timeout: 400 (best effort), connection closed, and
+        the worker serves the next caller."""
+        api, srv, base = server
+        host, port = srv.server_address[:2]
+        t0 = time.perf_counter()
+        with socket.create_connection((host, port)) as s:
+            req = _raw_request("/tpushare-scheduler/filter", b"x" * 1000)
+            s.sendall(req[:len(req) - 990])  # headers + 10 body bytes
+            s.settimeout(5)
+            buf = b""
+            try:
+                while True:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+            except socket.timeout:
+                pass
+        waited = time.perf_counter() - t0
+        # Bounded by the 0.4 s socket timeout, not a 30 s default and
+        # certainly not forever.
+        assert waited < 3.0
+        assert b"400" in buf or buf == b""
+        status, doc = _post(base, "/tpushare-scheduler/filter",
+                            _filter_doc())
+        assert status == 200 and doc["NodeNames"] == ["v5e-node-0"]
+
+    def test_concurrent_connections_correct_results(self, server):
+        """16 threads x 8 keep-alive requests each through a 4-worker
+        pool: every response correct, nothing dropped, pool stats
+        consistent. (Runs under make test-race.)"""
+        api, srv, base = server
+        results: list[tuple[int, list]] = []
+        lock = threading.Lock()
+
+        def worker(i):
+            import http.client
+            conn = http.client.HTTPConnection(*srv.server_address[:2])
+            for j in range(8):
+                body = json.dumps(_filter_doc(f"c{i}-{j}")).encode()
+                conn.request("POST", "/tpushare-scheduler/filter", body,
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                doc = json.loads(resp.read())
+                with lock:
+                    results.append((resp.status, doc["NodeNames"]))
+            conn.close()
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(results) == 16 * 8
+        assert all(s == 200 and names == ["v5e-node-0"]
+                   for s, names in results)
+        stats = srv.http_stats()
+        assert stats["requestsTotal"] >= 16 * 8
+        assert stats["keepaliveReusesTotal"] >= 16 * 7
+        assert stats["workers"] == 4
+
+    def test_debug_http_surface(self, server):
+        api, srv, base = server
+        _post(base, "/tpushare-scheduler/filter", _filter_doc())
+        with urllib.request.urlopen(f"{base}/debug/http") as r:
+            doc = json.loads(r.read())
+        assert doc["workers"] == 4
+        assert doc["requestsTotal"] >= 1
+        assert "filterGate" in doc and "wireMemos" in doc
+
+    def test_http_metrics_exported(self, server):
+        api, srv, base = server
+        _post(base, "/tpushare-scheduler/filter", _filter_doc())
+        with urllib.request.urlopen(f"{base}/metrics") as r:
+            body = r.read()
+        for needle in (b"tpushare_http_pool_workers 4.0",
+                       b"tpushare_http_requests_total",
+                       b"tpushare_http_keepalive_reuses_total",
+                       b"tpushare_http_batch_size_bucket",
+                       b"tpushare_verb_queue_wait_seconds_total"):
+            assert needle in body, needle
+
+
+class TestVerbBatcher:
+    def test_depth_one_bypasses(self):
+        calls = []
+
+        def run(items):
+            calls.append([it.args for it in items])
+            return [it.args * 2 for it in items]
+
+        g = VerbBatcher(run)
+        result, queue_s = g.submit(21)
+        assert result == 42 and queue_s == 0.0
+        assert calls == [[21]]
+        assert g.stats()["batchedRequests"] == 0
+
+    def test_concurrent_submitters_coalesce(self):
+        """A slow drain accumulates followers; the next drain takes
+        them as ONE batch (shared snapshot), and every submitter gets
+        its own result with a nonzero queue wait."""
+        release = threading.Event()
+        batches = []
+
+        def run(items):
+            if len(batches) == 0:
+                batches.append([it.args for it in items])
+                release.wait(5)  # hold the gate so followers pile up
+            else:
+                batches.append([it.args for it in items])
+            return [it.args * 10 for it in items]
+
+        g = VerbBatcher(run, window_s=0.0)
+        out = {}
+
+        def submit(x):
+            out[x] = g.submit(x)
+
+        threads = [threading.Thread(target=submit, args=(i,))
+                   for i in range(5)]
+        threads[0].start()
+        time.sleep(0.05)      # t0 is mid-drain before the rest arrive
+        for t in threads[1:]:
+            t.start()
+        time.sleep(0.05)
+        release.set()
+        for t in threads:
+            t.join(5)
+        assert out[0] == (0, 0.0)
+        assert {x: r for x, (r, _) in out.items()} == {
+            i: i * 10 for i in range(5)}
+        # Followers coalesced into one batch and paid a visible wait.
+        assert sorted(len(b) for b in batches) == [1, 4]
+        assert all(q > 0 for x, (_, q) in out.items() if x != 0)
+        assert g.stats()["batchedRequests"] == 4
+
+    def test_executor_exception_fans_out_no_wedge(self):
+        def run(items):
+            raise RuntimeError("boom")
+
+        g = VerbBatcher(run)
+        with pytest.raises(RuntimeError):
+            g.submit(1)
+        # Gate is released: the next submit fails the same way rather
+        # than deadlocking behind a stuck drainer flag.
+        with pytest.raises(RuntimeError):
+            g.submit(2)
+
+    def test_disabled_gate_is_passthrough(self):
+        g = VerbBatcher(lambda items: [it.args for it in items],
+                        enabled=False)
+        assert g.submit(7) == (7, 0.0)
+        assert g.stats()["drains"] == 0
+
+
+class TestWireFastPaths:
+    def _roundtrip(self, doc):
+        raw = json.dumps(doc).encode()
+        fast = wire.parse_extender_args(raw)
+        slow = ExtenderArgs.from_json(json.loads(raw))
+        assert fast.pod.raw == slow.pod.raw
+        assert fast.node_names == slow.node_names
+        assert (fast.nodes is None) == (slow.nodes is None)
+
+    def test_parse_matches_general_parser(self):
+        pod = make_pod("p", hbm=8)
+        self._roundtrip({"Pod": pod, "NodeNames": ["a", "b"]})
+        self._roundtrip({"Pod": pod, "NodeNames": []})
+        # Adversarial: the key hiding inside an annotation string.
+        tricky = make_pod("t", hbm=8)
+        tricky["metadata"]["annotations"] = {
+            "note": 'contains "NodeNames" and , and {"Pod": bytes'}
+        self._roundtrip({"Pod": tricky, "NodeNames": ["a"]})
+        # NodeNames-first layout falls back to the general parser.
+        raw = ('{"NodeNames": ["a"], "Pod": '
+               + json.dumps(make_pod("q", hbm=4)) + "}").encode()
+        fast = wire.parse_extender_args(raw)
+        assert fast.node_names == ["a"] and fast.pod.name == "q"
+
+    def test_parse_memo_reuses_pod_across_requests(self):
+        wire.reset()
+        pod = make_pod("memo", hbm=8)
+        raw1 = json.dumps({"Pod": pod, "NodeNames": ["a"]}).encode()
+        raw2 = json.dumps({"Pod": pod, "NodeNames": ["b", "c"]}).encode()
+        a = wire.parse_extender_args(raw1)
+        b = wire.parse_extender_args(raw2)
+        # Same bytes -> the SAME parsed Pod object (the whole point);
+        # the candidate list still parses per request.
+        assert a.pod is b.pod
+        assert b.node_names == ["b", "c"]
+        assert wire.memo_stats()["podMemo"] == 1
+
+    def test_parse_rejects_non_object(self):
+        for raw in (b"null", b"[]", b'"x"', b"42"):
+            with pytest.raises(ValueError):
+                wire.parse_extender_args(raw)
+
+    def test_encode_filter_result_byte_compatible(self):
+        cases = [
+            ExtenderFilterResult(node_names=["a", "b"], failed_nodes={}),
+            ExtenderFilterResult(node_names=[],
+                                 failed_nodes={"n1": "no chip",
+                                               "n2": 'quote " comma ,'},
+                                 error="bad"),
+            ExtenderFilterResult(node_names=None, failed_nodes={}),
+            ExtenderFilterResult(node_names=["üñíçödé", "b"],
+                                 failed_nodes={}),
+        ]
+        for res in cases:
+            fast = wire.encode_filter_result(res)
+            slow = json.dumps(res.to_json(),
+                              separators=(",", ":")).encode()
+            assert json.loads(fast) == json.loads(slow), res
+
+    def test_encode_host_priorities_byte_compatible(self):
+        entries = [HostPriority(host="a", score=10),
+                   HostPriority(host='we"ird', score=0),
+                   HostPriority(host="c", score=7)]
+        fast = wire.encode_host_priorities(entries)
+        slow = json.dumps(host_priority_list_to_json(entries),
+                          separators=(",", ":")).encode()
+        assert json.loads(fast) == json.loads(slow)
+        assert wire.encode_host_priorities([]) == b"[]"
+
+
+class TestBatchedVerbSemantics:
+    def test_snapshot_injected_filter_equals_direct(self, api, v5e_node):
+        """handle() over one shared snapshot (the batch executor's
+        contract) returns exactly what per-request handle returns."""
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        pred = Predicate(cache)
+        args = [ExtenderArgs.from_json(
+                    {"Pod": make_pod(f"p{i}", hbm=8),
+                     "NodeNames": ["v5e-node-0", "ghost"]})
+                for i in range(4)]
+        table, nominated = pred.snapshot()
+        batched = [pred.handle(a, table=table, nominated=nominated)
+                   for a in args]
+        direct = [pred.handle(a) for a in args]
+        for b, d in zip(batched, direct):
+            assert b.node_names == d.node_names
+            assert b.failed_nodes == d.failed_nodes
+
+    def test_snapshot_injected_prioritize_equals_direct(self, api,
+                                                        v5e_node):
+        cache = SchedulerCache(api.get_node, api.list_pods)
+        prio = Prioritize(cache)
+        args = [ExtenderArgs.from_json(
+                    {"Pod": make_pod(f"p{i}", hbm=8),
+                     "NodeNames": ["v5e-node-0"]})
+                for i in range(3)]
+        table = prio.snapshot()
+        batched = [prio.handle(a, table=table) for a in args]
+        direct = [prio.handle(a) for a in args]
+        assert [[e.to_json() for e in b] for b in batched] == \
+               [[e.to_json() for e in d] for d in direct]
+
+    def test_server_batch_executor_equals_direct(self, server):
+        """The SHIPPING batch path — the server's executors over
+        WorkItems — produces the same bodies as per-request runs
+        against a fresh snapshot (the class above pins the same
+        contract at the verb layer)."""
+        from tpushare.routes.batch import WorkItem
+
+        api, srv, base = server
+        args = [wire.parse_extender_args(json.dumps(
+                    {"Pod": make_pod(f"sb{i}", hbm=8),
+                     "NodeNames": ["v5e-node-0", "ghost"]}).encode())
+                for i in range(3)]
+        batched = srv._filter_batch([WorkItem(a) for a in args])
+        table, nominated = srv.predicate.snapshot()
+        direct = [srv._run_filter(a, 0.0, table, nominated)
+                  for a in args]
+        assert [json.loads(b) for b, _ in batched] == \
+               [json.loads(b) for b, _ in direct]
+        pb = srv._prioritize_batch([WorkItem(a) for a in args])
+        ptable = srv.prioritize.snapshot()
+        pd = [srv._run_prioritize(a, 0.0, ptable) for a in args]
+        assert [json.loads(b) for b, _ in pb] == \
+               [json.loads(b) for b, _ in pd]
+
+    def test_poison_request_fails_alone_in_batch(self, server):
+        """A request that blows up inside the verb fails ITSELF (its
+        item's result is the exception, re-raised as that request's
+        500); batchmates coalesced with it still get real results."""
+        from tpushare.routes.batch import WorkItem
+
+        api, srv, base = server
+        good = wire.parse_extender_args(json.dumps(
+            {"Pod": make_pod("ok", hbm=8),
+             "NodeNames": ["v5e-node-0"]}).encode())
+        poison = ExtenderArgs(pod=None, node_names=["v5e-node-0"])
+        out = srv._filter_batch(
+            [WorkItem(good), WorkItem(poison), WorkItem(good)])
+        assert isinstance(out[1], Exception)
+        assert not isinstance(out[0], Exception)
+        assert not isinstance(out[2], Exception)
+        assert json.loads(out[0][0])["NodeNames"] == ["v5e-node-0"]
+
+    def test_queue_wait_lands_in_cost_ledger(self, server):
+        """A batched request's gate wait reaches the verb cost ledger
+        as the queue split (and the Server-Timing queue component is
+        present on every verb response)."""
+        from tpushare import profiling
+
+        api, srv, base = server
+        req = urllib.request.Request(
+            f"{base}/tpushare-scheduler/filter",
+            data=json.dumps(_filter_doc()).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            timing = resp.getheader("Server-Timing")
+            resp.read()
+        assert "handler;dur=" in timing and "queue;dur=" in timing
+        row = profiling.ledger().snapshot().get("filter")
+        assert row is not None and "queueWaitSeconds" in row

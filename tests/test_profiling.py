@@ -300,9 +300,13 @@ class TestWire:
             assert st == 200 and res["NodeNames"] == ["prof-n0"]
             # every verb reports its handler duration (the scale
             # bench's gated clock; production splits slow-extender
-            # from slow-network with it)
+            # from slow-network with it) plus the micro-batch gate's
+            # queue wait (zero on this lone, depth-1 request)
             assert timing and timing.startswith("handler;dur="), timing
-            assert float(timing.split("dur=")[1]) > 0
+            parts = dict(p.strip().split(";dur=")
+                         for p in timing.split(","))
+            assert float(parts["handler"]) > 0
+            assert float(parts["queue"]) == 0.0
             st, bound, timing = _post(
                 f"{base}/tpushare-scheduler/bind",
                 {"PodName": "prof-pod", "PodNamespace": "default",

@@ -38,6 +38,11 @@ Environment (reference cmd/main.go:23,92-98):
 * ``TPUSHARE_QUOTA_NAMESPACE`` — namespace the ``tpushare-quotas``
   ConfigMap (per-tenant quota table, docs/quota.md) is trusted from;
   default ``kube-system``.
+* ``TPUSHARE_HTTP_WORKERS`` / ``TPUSHARE_HTTP_TIMEOUT_S`` — the wire
+  path's bounded worker pool (default 8) and per-connection socket
+  timeout (default 30 s); ``TPUSHARE_BATCH`` / ``TPUSHARE_BATCH_MAX``
+  / ``TPUSHARE_BATCH_WINDOW_MS`` tune the read-verb micro-batch gate
+  (docs/perf.md, wire section).
 * ``TPUSHARE_SLO_NAMESPACE`` — namespace the ``tpushare-slos``
   ConfigMap (SLO objectives: error budgets + burn-rate alerting,
   docs/slo.md) is trusted from; default ``kube-system``. Absent

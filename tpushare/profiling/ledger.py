@@ -29,7 +29,10 @@ class VerbCostLedger:
 
     def __init__(self) -> None:
         self._lock = locks.TracingRLock("profiling/ledger")
-        #: verb -> [decisions, wall_s, cpu_s, lock_wait_s, api_s]
+        #: verb -> [decisions, wall_s, cpu_s, lock_wait_s, api_s,
+        #: queue_s] — queue_s is the HTTP micro-batch gate's wait
+        #: BEFORE the span opened (routes/batch.py), kept separate
+        #: because the span wall clock never contains it.
         self._verbs: dict[str, list[float]] = locks.guarded_dict(
             self._lock, "VerbCostLedger._verbs")
 
@@ -38,12 +41,13 @@ class VerbCostLedger:
         with self._lock:
             row = self._verbs.get(verb)
             if row is None:
-                row = self._verbs[verb] = [0.0, 0.0, 0.0, 0.0, 0.0]
+                row = self._verbs[verb] = [0.0, 0.0, 0.0, 0.0, 0.0, 0.0]
             row[0] += 1
             row[1] += span.seconds
             row[2] += span.cpu_s
             row[3] += span.lock_wait_s
             row[4] += span.api_s
+            row[5] += getattr(span, "queue_s", 0.0)
 
     def snapshot(self) -> dict[str, dict[str, float]]:
         """verb -> cost splits, JSON-shaped (seconds, monotonic)."""
@@ -56,6 +60,7 @@ class VerbCostLedger:
                 "cpuSeconds": round(row[2], 6),
                 "lockWaitSeconds": round(row[3], 6),
                 "apiSeconds": round(row[4], 6),
+                "queueWaitSeconds": round(row[5], 6),
             }
             for verb, row in rows.items()
         }

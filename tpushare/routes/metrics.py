@@ -15,7 +15,7 @@ from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, gene
 
 REGISTRY = CollectorRegistry()
 
-# Scrapes run on ThreadingHTTPServer threads; the clear()+repopulate in
+# Scrapes run on HTTP pool-worker threads; the clear()+repopulate in
 # observe_cache must not interleave with another scrape's render() or
 # that scrape would see missing/partial node series.
 _SCRAPE_LOCK = locks.TracingRLock("metrics/scrape")
@@ -481,6 +481,16 @@ VERB_API = Gauge(
     "decision spans (instrumented in tpushare/k8s/client.py)",
     ["verb"], registry=REGISTRY,
 )
+VERB_QUEUE_WAIT = Gauge(
+    "tpushare_verb_queue_wait_seconds_total",
+    "Cumulative wait in the HTTP layer's micro-batch gate BEFORE each "
+    "verb span opened (routes/batch.py; also per-request as the "
+    "queue;dur= Server-Timing component). Kept separate from the wall "
+    "split — the verb's own clock never contains it. A rising share "
+    "means batching is trading latency for throughput; check "
+    "tpushare_http_batch_size and the window knobs (docs/perf.md)",
+    ["verb"], registry=REGISTRY,
+)
 VERB_SELF_CPU = Gauge(
     "tpushare_verb_self_cpu_seconds_total",
     "Per-frame self-CPU attribution per (verb, frame_bucket): the "
@@ -509,6 +519,59 @@ PROFILER_OVERHEAD = Gauge(
     "impact to <= 5% (docs/perf.md)",
     registry=REGISTRY,
 )
+
+# -- HTTP wire path (docs/perf.md wire section) ---------------------------- #
+# The webhook server's own plumbing: the bounded worker pool, the
+# accept queue (the back-pressure point), keep-alive connection reuse,
+# and the micro-batch gate's coalescing. Monotonic sources are
+# GIL-bumped ints on the server object; gauges are set at scrape time
+# (the workqueue-retries pattern).
+
+HTTP_POOL_WORKERS = Gauge(
+    "tpushare_http_pool_workers",
+    "Size of the HTTP worker pool (TPUSHARE_HTTP_WORKERS). Each "
+    "worker owns one connection at a time for its keep-alive "
+    "lifetime, so this is also the concurrent-connection bound",
+    registry=REGISTRY,
+)
+HTTP_ACCEPT_QUEUE_DEPTH = Gauge(
+    "tpushare_http_accept_queue_depth",
+    "Accepted connections waiting for a pool worker at scrape time. "
+    "Persistently nonzero means the pool is saturated — the accept "
+    "loop is back-pressuring; raise TPUSHARE_HTTP_WORKERS or find the "
+    "slow verb (docs/perf.md runbook)",
+    registry=REGISTRY,
+)
+HTTP_CONNECTIONS = Gauge(
+    "tpushare_http_connections_total",
+    "TCP connections accepted since process start (monotonic; set at "
+    "scrape time from the server's counter)",
+    registry=REGISTRY,
+)
+HTTP_REQUESTS = Gauge(
+    "tpushare_http_requests_total",
+    "HTTP requests served since process start (monotonic; set at "
+    "scrape time). requests/connections is the keep-alive reuse "
+    "factor a healthy scheduler transport keeps high",
+    registry=REGISTRY,
+)
+HTTP_KEEPALIVE_REUSES = Gauge(
+    "tpushare_http_keepalive_reuses_total",
+    "Requests served on an already-used keep-alive connection "
+    "(monotonic; set at scrape time). Near zero under steady load "
+    "means the caller reconnects per webhook call — it is paying a "
+    "TCP (and TLS) handshake per placement",
+    registry=REGISTRY,
+)
+HTTP_BATCH_SIZE = Histogram(
+    "tpushare_http_batch_size",
+    "Requests per micro-batch drain of the read verbs, INCLUDING the "
+    "depth-1 direct path (routes/batch.py). Mass above 1 is the "
+    "snapshot/probe sharing actually happening under concurrent "
+    "clients; all-1s just means the callers never overlap",
+    registry=REGISTRY, buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32),
+)
+
 
 # -- Process self-metrics -------------------------------------------------- #
 # The scheduler exports fleet state everywhere above; these are about
@@ -748,7 +811,8 @@ def observe_profiling() -> None:
 
     with _SCRAPE_LOCK:
         for gauge in (VERB_DECISIONS, VERB_WALL, VERB_CPU,
-                      VERB_LOCK_WAIT, VERB_API, VERB_SELF_CPU):
+                      VERB_LOCK_WAIT, VERB_API, VERB_QUEUE_WAIT,
+                      VERB_SELF_CPU):
             gauge.clear()
         ledger_rows = profiling.ledger().snapshot()
         for verb, row in ledger_rows.items():
@@ -757,6 +821,8 @@ def observe_profiling() -> None:
             VERB_CPU.labels(verb=verb).set(row["cpuSeconds"])
             VERB_LOCK_WAIT.labels(verb=verb).set(row["lockWaitSeconds"])
             VERB_API.labels(verb=verb).set(row["apiSeconds"])
+            VERB_QUEUE_WAIT.labels(verb=verb).set(
+                row.get("queueWaitSeconds", 0.0))
         # Verb frame buckets: the decision probe's exact frame-share
         # distribution scaled by the ledger's exact CPU totals (the
         # sampler cannot see sub-GIL-slice verbs — see
@@ -837,8 +903,21 @@ def observe_process() -> None:
                 stats.get("collections", 0))
 
 
+def observe_http(http_server) -> None:
+    """Refresh the tpushare_http_* series from the server's GIL-bumped
+    counters and live queue depth (docs/perf.md wire section)."""
+    with _SCRAPE_LOCK:
+        stats = http_server.http_stats()
+        HTTP_POOL_WORKERS.set(stats["workers"])
+        HTTP_ACCEPT_QUEUE_DEPTH.set(stats["acceptQueueDepth"])
+        HTTP_CONNECTIONS.set(stats["connectionsTotal"])
+        HTTP_REQUESTS.set(stats["requestsTotal"])
+        HTTP_KEEPALIVE_REUSES.set(stats["keepaliveReusesTotal"])
+
+
 def scrape(cache, gang_planner=None, leader=None, demand=None,
-           workqueue=None, quota=None, defrag=None, router=None) -> bytes:
+           workqueue=None, quota=None, defrag=None, router=None,
+           http_server=None) -> bytes:
     """Atomic observe+render for the /metrics handler, timed and
     error-counted (a scrape that raises is a sample Prometheus never
     saw — that loss must itself be countable)."""
@@ -855,6 +934,8 @@ def scrape(cache, gang_planner=None, leader=None, demand=None,
             observe_slo()
             observe_profiling()
             observe_process()
+            if http_server is not None:
+                observe_http(http_server)
             if quota is not None:
                 observe_quota(quota)
             if router is not None:

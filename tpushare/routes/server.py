@@ -29,6 +29,9 @@ Routes:
 * ``GET  /debug/router``    — serving front door: per-tenant queue
   depth / shed counts / TTFT percentiles, replica slot occupancy, the
   scale-out signal (docs/serving.md)
+* ``GET  /debug/http``      — the wire path itself: worker-pool
+  occupancy, accept-queue depth, keep-alive reuse, micro-batch gate
+  stats, wire-memo fill (docs/perf.md wire section)
 * ``GET  /debug/profile/continuous`` — the always-on profiler's rolling
   window as verb-rooted collapsed stacks (speedscope/flamegraph input;
   ``?window=`` narrows; docs/perf.md)
@@ -46,42 +49,66 @@ A malformed body is rejected with HTTP 400 *and the handler returns* —
 the reference kept executing after writing the 400 (``checkBody``,
 routes.go:32-37, SURVEY.md §2 C10 quirk).
 
-Built on ``ThreadingHTTPServer``: each request gets a thread, and the
-ledger's locks provide the concurrency control (the reference similarly
-relied on Go's ``net/http`` goroutine-per-request).
+Wire concurrency model (docs/perf.md, the wire-path section): a
+BOUNDED worker pool drains the accept loop — the reference rode Go's
+goroutine-per-request ``net/http``; the earlier Python port's
+``ThreadingHTTPServer`` spawned an unbounded thread per connection.
+Each pooled worker owns one connection at a time for its keep-alive
+lifetime (``TPUSHARE_HTTP_WORKERS`` sizes the pool; a full hand-off
+queue blocks the accept loop — back-pressure instead of thread
+spawn). The read verbs additionally pass a micro-batch gate
+(routes/batch.py): N simultaneous filter/prioritize requests share one
+ledger snapshot and one admission-probe pass, bypassed entirely at
+queue depth 1. Request parse and response encode take the repeat-shape
+fast paths in routes/wire.py.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import queue
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import tpushare
 from tpushare import slo, trace
 from tpushare.api.extender import (ExtenderArgs, ExtenderBindingArgs,
-                                   ExtenderPreemptionArgs,
-                                   host_priority_list_to_json)
-from tpushare.routes import metrics, pprof
+                                   ExtenderPreemptionArgs)
+from tpushare.routes import metrics, pprof, wire
+from tpushare.routes.batch import VerbBatcher, WorkItem
 from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
 
 DEFAULT_PREFIX = "/tpushare-scheduler"
 
+#: Pool workers draining the accept queue (TPUSHARE_HTTP_WORKERS
+#: overrides). Each worker holds one keep-alive connection at a time,
+#: so this is also the concurrent-connection bound.
+DEFAULT_HTTP_WORKERS = 8
+#: Accepted-but-unassigned connections held before the accept loop
+#: itself blocks (the back-pressure point).
+ACCEPT_QUEUE_DEPTH = 128
+#: Per-connection socket timeout: bounds a slow client's partial body
+#: AND an idle keep-alive connection's hold on a pool worker.
+DEFAULT_SOCKET_TIMEOUT_S = 30.0
+#: Largest accepted request body. A 1k-candidate filter payload is
+#: tens of KiB; anything near this bound is not a scheduler.
+MAX_BODY_BYTES = 8 * 1024 * 1024
 
-def _server_timing(handler_ms: float) -> dict:
+
+def _server_timing(handler_ms: float, queue_ms: float = 0.0) -> dict:
     """RFC-8941 ``Server-Timing`` header for the scheduling verbs: the
-    HANDLER's own duration, excluding request framing and the caller's
-    side of the wire. Production callers can log it next to their
-    observed RTT to split 'slow extender' from 'slow network'; the
-    scale bench gates on it for exactly that reason (at 1k nodes the
-    in-process harness client shares the GIL with the extender's
-    background threads, so its wire clock charges the extender for
-    harness scheduling noise — docs/perf.md)."""
-    return {"Server-Timing": f"handler;dur={handler_ms:.3f}"}
+    HANDLER's own duration (excluding request framing and the caller's
+    side of the wire) plus the micro-batch gate's queue wait, so
+    batching can never silently hide latency it added. Production
+    callers can log both next to their observed RTT to split 'slow
+    extender' from 'queued behind a batch' from 'slow network'; the
+    scale bench gates on them for exactly that reason (docs/perf.md)."""
+    return {"Server-Timing":
+            f"handler;dur={handler_ms:.3f}, queue;dur={queue_ms:.3f}"}
 
 
 def _traced_pod(pod) -> bool:
@@ -92,15 +119,24 @@ def _traced_pod(pod) -> bool:
             or podutils.is_tpu_chip_pod(pod))
 
 
-class ExtenderHTTPServer(ThreadingHTTPServer):
-    daemon_threads = True
+class ExtenderHTTPServer(HTTPServer):
     allow_reuse_address = True
+    #: Kernel accept backlog behind the bounded hand-off queue: when
+    #: the pool saturates, connections wait HERE (and then in SYN
+    #: queues) instead of as unbounded handler threads.
+    request_queue_size = ACCEPT_QUEUE_DEPTH
 
     def __init__(self, addr, predicate, binder, inspect,
                  prefix: str = DEFAULT_PREFIX, prioritize=None,
                  preempt=None, admission=None, leader=None,
                  gang_planner=None, debug_routes: bool = True,
-                 workqueue=None, quota=None, defrag=None, router=None):
+                 workqueue=None, quota=None, defrag=None, router=None,
+                 http_workers: int | None = None,
+                 socket_timeout_s: float | None = None,
+                 max_body_bytes: int = MAX_BODY_BYTES,
+                 batch_window_s: float | None = None,
+                 batch_max: int | None = None,
+                 batching: bool = True):
         self.predicate = predicate
         self.binder = binder
         self.inspect = inspect
@@ -138,7 +174,212 @@ class ExtenderHTTPServer(ThreadingHTTPServer):
         #: like the rest: dropping it must 404, not freeze the fleet
         #: TTFT series.
         self.router = router
+        import os
+        self.http_workers = (http_workers if http_workers is not None
+                             else int(os.environ.get(
+                                 "TPUSHARE_HTTP_WORKERS",
+                                 str(DEFAULT_HTTP_WORKERS))))
+        self.http_workers = max(1, self.http_workers)
+        self.socket_timeout_s = (
+            socket_timeout_s if socket_timeout_s is not None
+            else float(os.environ.get("TPUSHARE_HTTP_TIMEOUT_S",
+                                      str(DEFAULT_SOCKET_TIMEOUT_S))))
+        self.max_body_bytes = max_body_bytes
+        window_s = (batch_window_s if batch_window_s is not None
+                    else float(os.environ.get(
+                        "TPUSHARE_BATCH_WINDOW_MS", "0.5")) / 1e3)
+        batch_n = (batch_max if batch_max is not None
+                   else int(os.environ.get("TPUSHARE_BATCH_MAX", "16")))
+        #: Micro-batch gates for the read verbs: coalesced requests
+        #: share one ledger snapshot + probe pass (routes/batch.py).
+        #: ``batching=False`` (or TPUSHARE_BATCH=off) keeps the gate
+        #: object but makes submit a pass-through — the bench's
+        #: un-batched comparison arm.
+        enabled = (batching and os.environ.get(
+            "TPUSHARE_BATCH", "on").lower() not in ("off", "0", "false"))
+        self.filter_gate = VerbBatcher(self._filter_batch,
+                                       max_batch=batch_n,
+                                       window_s=window_s,
+                                       enabled=enabled)
+        self.prioritize_gate = VerbBatcher(self._prioritize_batch,
+                                           max_batch=batch_n,
+                                           window_s=window_s,
+                                           enabled=enabled)
+        # Wire-level stats (GIL-bumped ints, the DropCounter pattern;
+        # exported via /debug/http and the tpushare_http_* series).
+        self.connections_total = 0
+        self.requests_total = 0
+        self.keepalive_reuses_total = 0
+        self._conn_queue: queue.Queue = queue.Queue(
+            maxsize=ACCEPT_QUEUE_DEPTH)
+        self._closing = False
+        self._http_threads: list[threading.Thread] = []
         super().__init__(addr, _Handler)
+        for i in range(self.http_workers):
+            t = threading.Thread(target=self._http_worker,
+                                 name=f"tpushare-http-worker-{i}",
+                                 daemon=True)
+            t.start()
+            self._http_threads.append(t)
+
+    # -- the bounded worker pool ------------------------------------------ #
+
+    def process_request(self, request, client_address):
+        """Accept-loop side of the hand-off: enqueue the accepted
+        connection for a pool worker. A full queue BLOCKS the accept
+        loop — back-pressure the kernel backlog absorbs — instead of
+        spawning an unbounded thread per connection."""
+        self.connections_total += 1
+        self._conn_queue.put((request, client_address))
+
+    def _http_worker(self) -> None:
+        """One pool worker: serve connections (each for its whole
+        keep-alive lifetime) until the shutdown sentinel — or the
+        closing flag, which a worker busy at shutdown time (when the
+        sentinel may not have fit in a full queue) notices on its next
+        idle tick."""
+        while True:
+            try:
+                item = self._conn_queue.get(timeout=1.0)
+            except queue.Empty:
+                if self._closing:
+                    return
+                continue
+            if item is None:
+                return
+            request, client_address = item
+            try:
+                self.finish_request(request, client_address)
+            except Exception:
+                self.handle_error(request, client_address)
+            finally:
+                self.shutdown_request(request)
+
+    def handle_error(self, request, client_address):
+        """Client disconnects and stalls are routine wire weather, not
+        stack traces on stderr (the stdlib default)."""
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, TimeoutError)):
+            log.debug("client %s went away: %r", client_address, exc)
+            return
+        log.exception("error handling request from %s", client_address)
+
+    def shutdown(self):
+        """Stop the accept loop, then release the pool workers. Workers
+        mid-connection finish their current keep-alive session first
+        (they are daemons, so a wedged client cannot block exit).
+        Sentinels are best-effort — a queue still full of backlogged
+        connections drops them, and the ``_closing`` flag retires those
+        workers on their next idle tick instead."""
+        self._closing = True
+        super().shutdown()
+        for _ in self._http_threads:
+            try:
+                self._conn_queue.put_nowait(None)
+            except queue.Full:
+                break
+
+    def _note_request(self, reused: bool) -> None:
+        self.requests_total += 1
+        if reused:
+            self.keepalive_reuses_total += 1
+
+    def http_stats(self) -> dict:
+        """The wire-path picture for /debug/http and the
+        tpushare_http_* metrics (docs/observability.md)."""
+        return {
+            "workers": self.http_workers,
+            "acceptQueueDepth": self._conn_queue.qsize(),
+            "acceptQueueCapacity": ACCEPT_QUEUE_DEPTH,
+            "socketTimeoutS": self.socket_timeout_s,
+            "connectionsTotal": self.connections_total,
+            "requestsTotal": self.requests_total,
+            "keepaliveReusesTotal": self.keepalive_reuses_total,
+            "filterGate": self.filter_gate.stats(),
+            "prioritizeGate": self.prioritize_gate.stats(),
+            "wireMemos": wire.memo_stats(),
+        }
+
+    # -- batched verb execution ------------------------------------------- #
+    # The gates run these on whichever thread drains the batch; the
+    # trace phase (and with it the SLO/journey story and the profiler's
+    # verb attribution) is opened HERE, per item, not in the HTTP
+    # handler — the handler thread may be parked in the gate while a
+    # batch leader does its work.
+
+    def _filter_batch(self, items: list[WorkItem]):
+        table, nominated = self.predicate.snapshot()
+        out = []
+        for it in items:
+            # Per-item isolation: a poison request (parses as JSON but
+            # blows up in the verb) must 500 ITSELF, not the innocent
+            # requests that happened to coalesce with it — the
+            # exception is returned as that item's result and re-raised
+            # on the item's own handler thread.
+            try:
+                out.append(self._run_filter(it.args, it.queue_s,
+                                            table, nominated))
+            except Exception as e:  # noqa: BLE001 - re-raised per item
+                out.append(e)
+        return out
+
+    def _run_filter(self, args, queue_s, table, nominated):
+        t0 = time.perf_counter()
+        with metrics.FILTER_LATENCY.time(), \
+                trace.phase("filter", args.pod.namespace,
+                            args.pod.name, args.pod.uid,
+                            enabled=_traced_pod(args.pod)) as dec:
+            if queue_s:
+                trace.note_queue_wait(queue_s)
+            result = self.predicate.handle(args, table=table,
+                                           nominated=nominated)
+        handler_ms = (time.perf_counter() - t0) * 1e3
+        if dec is not None:
+            # The per-verb half of the SLO story: one filter
+            # observation for the filter-latency objective ...
+            slo.observe_filter(time.perf_counter() - t0)
+            passed = (result.node_names
+                      if result.node_names is not None
+                      else [n.name for n in (result.nodes or [])])
+            if not passed:
+                # Rejected on every offered node: this attempt is over
+                # — a complete story for the recorder (the
+                # autoscaler-demand case the reference could never
+                # explain).
+                trace.complete(
+                    dec, "unschedulable",
+                    error="rejected on every candidate node")
+            # ... and the journey half: link this attempt's trace-id
+            # (opening the journey if the informer has not — first
+            # filter wins the race, per docs/slo.md).
+            slo.note_decision(args.pod.namespace, args.pod.name,
+                              args.pod.uid, dec, pod=args.pod)
+        return wire.encode_filter_result(result), handler_ms
+
+    def _prioritize_batch(self, items: list[WorkItem]):
+        table = self.prioritize.snapshot()
+        out = []
+        for it in items:
+            try:  # per-item isolation, as in _filter_batch
+                out.append(self._run_prioritize(it.args, it.queue_s,
+                                                table))
+            except Exception as e:  # noqa: BLE001 - re-raised per item
+                out.append(e)
+        return out
+
+    def _run_prioritize(self, args, queue_s, table):
+        t0 = time.perf_counter()
+        with metrics.PRIORITIZE_LATENCY.time(), \
+                trace.phase("prioritize", args.pod.namespace,
+                            args.pod.name, args.pod.uid,
+                            enabled=_traced_pod(args.pod)):
+            if queue_s:
+                trace.note_queue_wait(queue_s)
+            entries = self.prioritize.handle(args, table=table)
+        handler_ms = (time.perf_counter() - t0) * 1e3
+        # HostPriorityList is a bare JSON array on the wire.
+        return wire.encode_host_priorities(entries), handler_ms
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -156,6 +397,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     _date_cache: tuple[float, str] = (0.0, "")
 
+    def setup(self) -> None:
+        # Socket timeout BEFORE the stream wrappers: bounds a slow
+        # client's partial body, a stalled TLS handshake, AND an idle
+        # keep-alive connection's hold on its pool worker.
+        self.timeout = self.server.socket_timeout_s
+        super().setup()
+        #: Requests already served on THIS connection (keep-alive
+        #: reuse accounting).
+        self._served = 0
+
     def version_string(self) -> str:
         # Constant: the default concatenates server_version/sys_version
         # per response.
@@ -164,11 +415,13 @@ class _Handler(BaseHTTPRequestHandler):
     def date_time_string(self, timestamp=None) -> str:
         """The stdlib formats an RFC-2822 date string PER RESPONSE; at
         webhook rates that formatting shows up in the latency histogram.
-        Second-granularity cache (the Date header has 1s resolution)."""
+        Second-granularity cache (the Date header has 1s resolution).
+        Uses the module's ``time`` import — a previous revision paid a
+        per-response ``import`` statement here, ON the hot path (sys.
+        modules hit or not, that is a dict lookup + lock per call)."""
         if timestamp is not None:
             return super().date_time_string(timestamp)
-        import time as _time
-        now = _time.time()
+        now = time.time()
         stamp, value = _Handler._date_cache
         if now - stamp >= 1.0 or not value:
             value = super().date_time_string(now)
@@ -180,11 +433,19 @@ class _Handler(BaseHTTPRequestHandler):
         if log.isEnabledFor(logging.DEBUG):
             log.debug("%s %s", self.address_string(), fmt % args)
 
-    def _send_json(self, doc: dict, status: int = 200,
+    def _send_json(self, doc: dict | list, status: int = 200,
                    extra_headers: dict | None = None) -> None:
         # Compact separators: a 1k-candidate filter/prioritize response
         # is kilobytes of ", " otherwise — bytes both sides re-parse.
-        body = json.dumps(doc, separators=(",", ":")).encode()
+        self._send_bytes(
+            json.dumps(doc, separators=(",", ":")).encode(),
+            status, extra_headers)
+
+    def _send_bytes(self, body: bytes, status: int = 200,
+                    extra_headers: dict | None = None) -> None:
+        """One buffered flush for a pre-encoded JSON body (the wire
+        fast paths hand bytes straight through — no str build, no
+        second encode copy)."""
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -201,6 +462,46 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(text)
 
+    def _read_body(self) -> bytes | None:
+        """Read the request body; None (after a 400) when it cannot be
+        had. Oversized declarations are refused BEFORE reading (a
+        multi-GiB body would pin a pool worker for its transfer time),
+        and a slow client that stalls mid-body hits the connection's
+        socket timeout — 400 and the connection closes, the worker
+        moves on instead of wedging. Both poison the framing, so the
+        connection never carries another request."""
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except ValueError:
+            self.close_connection = True
+            self._send_json({"Error": "malformed Content-Length"}, 400)
+            return None
+        if length > self.server.max_body_bytes:
+            self.close_connection = True
+            self._send_json(
+                {"Error": f"request body too large ({length} bytes; "
+                          f"limit {self.server.max_body_bytes})"}, 400)
+            return None
+        if length <= 0:
+            self._send_json({"Error": "empty request body"}, 400)
+            return None
+        try:
+            raw = self.rfile.read(length)
+        except TimeoutError:
+            self.close_connection = True
+            try:
+                self._send_json(
+                    {"Error": "timed out reading request body"}, 400)
+            except (OSError, ValueError):
+                pass  # the stalled client is likely unreachable too
+            return None
+        if len(raw) < length:
+            # Client closed before delivering the promised bytes.
+            self.close_connection = True
+            self._send_json({"Error": "truncated request body"}, 400)
+            return None
+        return raw
+
     def _read_json(self) -> dict | None:
         """Parse the request body; None (after a 400) when malformed.
 
@@ -208,12 +509,10 @@ class _Handler(BaseHTTPRequestHandler):
         (including the literal ``null``, which json.loads parses to
         None without raising — returning it bare would skip the 400 and
         silently drop the connection) is a 400, not a handler crash."""
+        raw = self._read_body()
+        if raw is None:
+            return None
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            raw = self.rfile.read(length) if length else b""
-            if not raw:
-                self._send_json({"Error": "empty request body"}, 400)
-                return None
             doc = json.loads(raw)
         except (ValueError, json.JSONDecodeError) as e:
             self._send_json({"Error": f"malformed request body: {e}"}, 400)
@@ -224,6 +523,18 @@ class _Handler(BaseHTTPRequestHandler):
                           f"{type(doc).__name__}"}, 400)
             return None
         return doc
+
+    def _read_args(self) -> ExtenderArgs | None:
+        """Filter/prioritize body via the repeat-shape parse fast path
+        (routes/wire.py); None (after the 400) when malformed."""
+        raw = self._read_body()
+        if raw is None:
+            return None
+        try:
+            return wire.parse_extender_args(raw)
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json({"Error": f"malformed request body: {e}"}, 400)
+            return None
 
     def _serve_sampler(self, sampler, *, default_seconds: str,
                        default_hz: str,
@@ -275,6 +586,8 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (stdlib casing)
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         prefix = self.server.prefix
+        self.server._note_request(self._served > 0)
+        self._served += 1
         try:
             if path == "/version":
                 self._send_json({"version": tpushare.__version__})
@@ -294,7 +607,8 @@ class _Handler(BaseHTTPRequestHandler):
                                    workqueue=self.server.workqueue,
                                    quota=self.server.quota,
                                    defrag=self.server.defrag,
-                                   router=self.server.router),
+                                   router=self.server.router,
+                                   http_server=self.server),
                     ctype="text/plain; version=0.0.4")
             elif path.startswith("/debug/") and not self.server.debug_routes:
                 self._send_json({"Error": "debug routes disabled"}, 404)
@@ -326,6 +640,11 @@ class _Handler(BaseHTTPRequestHandler):
                                     404)
                 else:
                     self._send_json(self.server.router.snapshot())
+            elif path == "/debug/http":
+                # The wire-path picture: pool occupancy, accept-queue
+                # depth, keep-alive reuse, the micro-batch gates, and
+                # the wire-memo fill (docs/observability.md).
+                self._send_json(self.server.http_stats())
             elif path.startswith("/debug/trace/"):
                 rest = path[len("/debug/trace/"):]
                 ns, sep, pod_name = rest.partition("/")
@@ -423,61 +742,40 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):  # noqa: N802
         path = self.path.split("?", 1)[0].rstrip("/")
         prefix = self.server.prefix
+        self.server._note_request(self._served > 0)
+        self._served += 1
         try:
             if path == f"{prefix}/filter":
-                doc = self._read_json()
-                if doc is None:
+                args = self._read_args()
+                if args is None:
                     return
                 metrics.FILTER_REQUESTS.inc()
-                args = ExtenderArgs.from_json(doc)
-                t0 = time.perf_counter()
-                with metrics.FILTER_LATENCY.time(), \
-                        trace.phase("filter", args.pod.namespace,
-                                    args.pod.name, args.pod.uid,
-                                    enabled=_traced_pod(args.pod)) as dec:
-                    result = self.server.predicate.handle(args)
-                handler_ms = (time.perf_counter() - t0) * 1e3
-                if dec is not None:
-                    # The per-verb half of the SLO story: one filter
-                    # observation for the filter-latency objective ...
-                    slo.observe_filter(time.perf_counter() - t0)
-                    passed = (result.node_names
-                              if result.node_names is not None
-                              else [n.name for n in (result.nodes or [])])
-                    if not passed:
-                        # Rejected on every offered node: this attempt
-                        # is over — a complete story for the recorder
-                        # (the autoscaler-demand case the reference
-                        # could never explain).
-                        trace.complete(
-                            dec, "unschedulable",
-                            error="rejected on every candidate node")
-                    # ... and the journey half: link this attempt's
-                    # trace-id (opening the journey if the informer has
-                    # not — first filter wins the race, per docs/slo.md).
-                    slo.note_decision(args.pod.namespace, args.pod.name,
-                                      args.pod.uid, dec, pod=args.pod)
-                self._send_json(result.to_json(),
-                                extra_headers=_server_timing(handler_ms))
+                # Through the micro-batch gate: concurrent requests
+                # coalesce onto one snapshot + probe pass; a lone
+                # request takes the direct path (routes/batch.py). The
+                # verb itself — trace phase, SLO story, encode — runs
+                # in the server's _run_filter on whichever thread
+                # drains the batch.
+                res, queue_s = self.server.filter_gate.submit(args)
+                if isinstance(res, Exception):
+                    raise res  # this item's own failure: 500 below
+                body, handler_ms = res
+                self._send_bytes(body, extra_headers=_server_timing(
+                    handler_ms, queue_s * 1e3))
             elif path == f"{prefix}/prioritize":
-                doc = self._read_json()
-                if doc is None:
+                args = self._read_args()
+                if args is None:
                     return
                 if self.server.prioritize is None:
                     self._send_json({"Error": "prioritize not configured"},
                                     404)
                     return
-                args = ExtenderArgs.from_json(doc)
-                t0 = time.perf_counter()
-                with metrics.PRIORITIZE_LATENCY.time(), \
-                        trace.phase("prioritize", args.pod.namespace,
-                                    args.pod.name, args.pod.uid,
-                                    enabled=_traced_pod(args.pod)):
-                    entries = self.server.prioritize.handle(args)
-                handler_ms = (time.perf_counter() - t0) * 1e3
-                # HostPriorityList is a bare JSON array on the wire.
-                self._send_json(host_priority_list_to_json(entries),
-                                extra_headers=_server_timing(handler_ms))
+                res, queue_s = self.server.prioritize_gate.submit(args)
+                if isinstance(res, Exception):
+                    raise res  # this item's own failure: 500 below
+                body, handler_ms = res
+                self._send_bytes(body, extra_headers=_server_timing(
+                    handler_ms, queue_s * 1e3))
             elif path == f"{prefix}/preempt":
                 doc = self._read_json()
                 if doc is None:

@@ -23,7 +23,7 @@ from tpushare import trace
 from tpushare.api.extender import ExtenderArgs, ExtenderFilterResult
 from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
-from tpushare.cache.nodeinfo import MEMO_CAP, NodeSummary
+from tpushare.cache.nodeinfo import MEMO_CAP, NodeInfo, NodeSummary
 from tpushare.quota.manager import QuotaManager
 from tpushare.utils import locks
 from tpushare.utils import node as nodeutils
@@ -251,7 +251,20 @@ class Predicate:
                                  nominated=self.cache.nominated_on(node_name))
         return ok, reason
 
-    def handle(self, args: ExtenderArgs) -> ExtenderFilterResult:
+    def snapshot(self) -> tuple[dict[str, "NodeInfo"], set[str]]:
+        """The per-request ledger view :meth:`handle` reads: the
+        one-lock node table plus the nominated-demand trigger set.
+        Exposed so the HTTP layer's micro-batch executor
+        (routes/server.py) can take it ONCE and feed N coalesced
+        requests through ``handle(table=, nominated=)`` — the
+        per-shape admission memos then collapse the probe work across
+        same-shape pods (docs/perf.md)."""
+        return self.cache.node_table(), self.cache.nominated_node_names()
+
+    def handle(self, args: ExtenderArgs,
+               table: "dict[str, NodeInfo] | None" = None,
+               nominated: "set[str] | None" = None,
+               ) -> ExtenderFilterResult:
         """Loop candidates, partition into schedulable / failed (reference
         predicate.go:15-39).
 
@@ -263,7 +276,11 @@ class Predicate:
         filter flamegraph (docs/perf.md). Nodes with earmarked
         preemption demand — and names the table has never seen — take
         the full :meth:`filter_node` path, so semantics are unchanged
-        where they matter."""
+        where they matter.
+
+        ``table``/``nominated`` inject a snapshot already taken (the
+        micro-batch executor's path, via :meth:`snapshot`); when
+        omitted the verb takes its own, as before."""
         pod = args.pod
         if not (podutils.is_tpu_sharing_pod(pod) or podutils.is_tpu_chip_pod(pod)):
             # Not ours: pass everything through untouched.
@@ -282,8 +299,10 @@ class Predicate:
         req_chips = podutils.get_chips_from_pod_resource(pod)
         req_hbm = podutils.get_hbm_from_pod_resource(pod)
         shape = (req_chips, req_hbm)
-        nominated = self.cache.nominated_node_names()
-        table = self.cache.node_table()
+        if nominated is None:
+            nominated = self.cache.nominated_node_names()
+        if table is None:
+            table = self.cache.node_table()
         passed_names: list[str] = []
         passed_nodes: list = []
         failed: dict[str, str] = {}

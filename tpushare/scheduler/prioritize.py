@@ -309,7 +309,17 @@ class Prioritize:
             return 0
         return self._score_hbm(info, req_hbm, gang_nodes, policy=policy)
 
-    def handle(self, args: ExtenderArgs) -> list[HostPriority]:
+    def snapshot(self) -> dict[str, NodeInfo]:
+        """The per-request ledger view :meth:`handle`'s fast path
+        reads, exposed so the HTTP micro-batch executor
+        (routes/server.py) can take ONE snapshot and serve N coalesced
+        requests through ``handle(table=)`` — the per-shape score
+        memos then collapse the scoring work across same-shape pods."""
+        return self.cache.node_table()
+
+    def handle(self, args: ExtenderArgs,
+               table: dict[str, NodeInfo] | None = None,
+               ) -> list[HostPriority]:
         pod = args.pod
         names = args.candidate_names()
         if not (podutils.is_tpu_sharing_pod(pod)
@@ -350,8 +360,10 @@ class Prioritize:
             # tuple reads), memoized PER NODE per request shape against
             # the summary object's identity — in steady state each
             # node's score recomputes only when its own ledger changed
-            # (docs/perf.md).
-            table = self.cache.node_table()
+            # (docs/perf.md). A batch-injected table (snapshot()) is
+            # shared across the coalesced requests.
+            if table is None:
+                table = self.cache.node_table()
             shape = (req_chips, req_hbm, policy)
             out = []
             for n in names:

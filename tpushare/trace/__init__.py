@@ -32,8 +32,8 @@ __all__ = [
     "DEFAULT_CAPACITY", "Decision", "DropCounter", "FlightRecorder",
     "Span", "add_phase_hook", "complete", "current", "current_trace_id",
     "flight", "get_trace", "new_trace_id", "note", "note_api_call",
-    "phase", "recorder", "remove_phase_hook", "reset",
-    "set_phase_probe", "span",
+    "note_queue_wait", "phase", "recorder", "remove_phase_hook",
+    "reset", "set_phase_probe", "span",
 ]
 
 _recorder = FlightRecorder()
@@ -63,6 +63,10 @@ def note(key: str, value: Any) -> None:
 
 def note_api_call(seconds: float, method: str = "", path: str = "") -> None:
     _recorder.note_api_call(seconds, method=method, path=path)
+
+
+def note_queue_wait(seconds: float) -> None:
+    _recorder.note_queue_wait(seconds)
 
 
 def current() -> Decision | None:

@@ -123,7 +123,7 @@ class Span:
 
     __slots__ = ("phase", "depth", "start_offset_s", "seconds",
                  "lock_wait_s", "api_s", "api_calls", "attrs", "_t0",
-                 "cpu_s", "_cpu0")
+                 "cpu_s", "_cpu0", "queue_s")
 
     def __init__(self, phase: str, depth: int, start_offset_s: float) -> None:
         self.phase = phase
@@ -137,6 +137,11 @@ class Span:
         self.cpu_s = 0.0
         self.lock_wait_s = 0.0
         self.api_s = 0.0
+        #: Wait in the HTTP layer's micro-batch gate BEFORE this span
+        #: opened (routes/batch.py) — reported separately because it is
+        #: queueing the batcher ADDED, not time inside the verb (the
+        #: span wall clock never contains it).
+        self.queue_s = 0.0
         self.api_calls = 0
         self.attrs: dict[str, Any] = {}
 
@@ -153,6 +158,7 @@ class Span:
             "cpuSeconds": round(self.cpu_s, 6),
             "lockWaitSeconds": round(self.lock_wait_s, 6),
             "apiSeconds": round(self.api_s, 6),
+            "queueSeconds": round(self.queue_s, 6),
             "apiCalls": self.api_calls,
         }
         if self.attrs:
@@ -423,6 +429,18 @@ class FlightRecorder:
         worst = sp.attrs.get("worstLockSite")
         if worst is None or waited_s > worst[1]:
             sp.attrs["worstLockSite"] = (site, waited_s)
+
+    def note_queue_wait(self, seconds: float) -> None:
+        """HTTP batch-gate sink: record the wait this request spent in
+        the micro-batch window before its verb span opened (the
+        ``queue;dur=`` Server-Timing component and the cost ledger's
+        queue split — docs/perf.md)."""
+        dec = self.current()
+        if dec is None:
+            return
+        sp = dec.innermost()
+        if sp is not None:
+            sp.queue_s += max(seconds, 0.0)
 
     def note_api_call(self, seconds: float, method: str = "",
                       path: str = "") -> None:
