@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-wire bench-topo bench-workload bench-router all
+.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-wire bench-topo bench-workload bench-router bench-diff all
 
 # CPU test suite (virtual 8-device mesh; kernels in interpreter mode).
 test:
@@ -79,5 +79,15 @@ bench-workload:
 # Serving front-door traffic replay (deterministic, CPU-only).
 bench-router:
 	python bench_router.py --gate
+
+# Drift check: re-run the scale + wire smokes and diff their gated
+# stats against the committed full-run contracts (>10% unfavorable
+# drift exits nonzero). Smoke scenarios are smaller than the committed
+# runs, so treat failures as a prompt to re-run the full bench.
+bench-diff:
+	python bench.py --scale --smoke > /tmp/tpushare-bench-scale.json
+	python bench.py --wire --smoke > /tmp/tpushare-bench-wire.json
+	python tools/bench_diff.py BENCH_SCALE.json /tmp/tpushare-bench-scale.json
+	python tools/bench_diff.py BENCH_WIRE_r01.json /tmp/tpushare-bench-wire.json
 
 all: native test

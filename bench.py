@@ -984,22 +984,6 @@ def _overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
     pod = fleet.api.create_pod(make_pod("overhead-probe", hbm=24))
     was_running = profiling.running()
 
-    def batch() -> float:
-        lat = []
-        for _ in range(per_batch):
-            cands = _scale_candidates(rng, fleet.names)
-            _, res, h_f = fleet.client.post_timed(
-                "/tpushare-scheduler/filter",
-                {"Pod": pod.raw, "NodeNames": cands})
-            passing = res["NodeNames"]
-            h_p = 0.0
-            if passing:
-                _, _, h_p = fleet.client.post_timed(
-                    "/tpushare-scheduler/prioritize",
-                    {"Pod": pod.raw, "NodeNames": passing})
-            lat.append((h_f or 0.0) + (h_p or 0.0))
-        return stats.quantile(lat, 0.99)
-
     p99s: dict[bool, list[float]] = {True: [], False: []}
     for _ in range(batches):
         for armed in (False, True):
@@ -1007,11 +991,38 @@ def _overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
                 profiling.start()
             else:
                 profiling.stop()
-            p99s[armed].append(batch())
+            p99s[armed].append(_probe_batch(fleet, rng, pod, per_batch))
     if was_running:
         profiling.start()
     else:
         profiling.stop()
+    return _probe_verdict(p99s)
+
+
+def _probe_batch(fleet: "_Fleet", rng, pod, per_batch: int) -> float:
+    """One batch of the mutation-free filter→prioritize sequence;
+    returns its handler-clock p99 (ms)."""
+    from tpushare.utils import stats
+
+    lat = []
+    for _ in range(per_batch):
+        cands = _scale_candidates(rng, fleet.names)
+        _, res, h_f = fleet.client.post_timed(
+            "/tpushare-scheduler/filter",
+            {"Pod": pod.raw, "NodeNames": cands})
+        passing = res["NodeNames"]
+        h_p = 0.0
+        if passing:
+            _, _, h_p = fleet.client.post_timed(
+                "/tpushare-scheduler/prioritize",
+                {"Pod": pod.raw, "NodeNames": passing})
+        lat.append((h_f or 0.0) + (h_p or 0.0))
+    return stats.quantile(lat, 0.99)
+
+
+def _probe_verdict(p99s: dict[bool, list[float]]) -> dict:
+    """min-of-batch-p99s armed-vs-disarmed delta, gated at
+    max(SCALE_GATE_OVERHEAD relative, the absolute floor)."""
     p99_off = min(p99s[False])
     p99_on = min(p99s[True])
     delta_ms = max(p99_on - p99_off, 0.0)
@@ -1027,6 +1038,48 @@ def _overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
         "floor_ms": SCALE_GATE_OVERHEAD_FLOOR_MS,
         "pass": delta_ms <= allowance_ms,
     }
+
+
+def _timeline_overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
+                             per_batch: int = 500) -> dict:
+    """The retrospective recorder's overhead gate: the same interleaved
+    mutation-free batches as :func:`_overhead_probe`, but toggling the
+    timeline recorder (sampler thread + the hot-path ``note_verb`` /
+    exemplar intake, short-circuited by ``TPUSHARE_TIMELINE=off``)
+    instead of the profiler. Same MIN-of-batch-p99s estimator and the
+    same relative-plus-floor allowance: the recorder's promise is that
+    per-verb history costs the gated handlers nothing measurable."""
+    import os
+
+    from tpushare import obs
+    from tpushare.k8s.builders import make_pod
+
+    pod = fleet.api.create_pod(make_pod("timeline-probe", hbm=24))
+    prior = os.environ.get("TPUSHARE_TIMELINE")
+    was_running = obs.timeline().running()
+
+    p99s: dict[bool, list[float]] = {True: [], False: []}
+    try:
+        for _ in range(batches):
+            for armed in (False, True):
+                if armed:
+                    os.environ.pop("TPUSHARE_TIMELINE", None)
+                    obs.start()
+                else:
+                    os.environ["TPUSHARE_TIMELINE"] = "off"
+                    obs.stop()
+                p99s[armed].append(_probe_batch(fleet, rng, pod,
+                                                per_batch))
+    finally:
+        if prior is None:
+            os.environ.pop("TPUSHARE_TIMELINE", None)
+        else:
+            os.environ["TPUSHARE_TIMELINE"] = prior
+        if was_running:
+            obs.start()
+        else:
+            obs.stop()
+    return _probe_verdict(p99s)
 
 
 # ------------------------------------------------------------------------- #
@@ -1441,6 +1494,7 @@ def bench_scale(nodes: int = SCALE_NODES,
         for verb, d in sched_verbs.items()}
     collapsed = profiling.profiler().collapsed(window_s=3600)
     overhead = _overhead_probe(fleet, rng)
+    timeline_overhead = _timeline_overhead_probe(fleet, rng)
 
     # -- the honest wire clock (subprocess clients; docs/perf.md) ----- #
     # LAST, after the overhead probe: the concurrency section's client
@@ -1496,6 +1550,7 @@ def bench_scale(nodes: int = SCALE_NODES,
         "top_frames_per_verb": top_frames,
         "verb_costs": hotspots["verbCosts"],
         "overhead_gate": overhead,
+        "timeline_overhead_gate": timeline_overhead,
         # The honest wire story: a SEPARATE-process client's clock
         # (no GIL sharing with the extender), gated against its own
         # handler readings, plus the 1-vs-8-client throughput proof.
@@ -1533,6 +1588,9 @@ def main_scale(smoke: bool) -> None:
             "pass": (result["attribution_coverage"]
                      >= SCALE_GATE_ATTRIBUTION)},
         "profiler_overhead": result["overhead_gate"],
+        # Retrospective recorder: armed-vs-disarmed handler p99 on the
+        # same interleaved batches (docs/observability.md).
+        "timeline_overhead": result["timeline_overhead_gate"],
         # Wire clock: subprocess client's wire p99 <= its handler p99
         # + 1.5 ms (docs/perf.md wire section).
         "wire_p99_vs_handler": result["wire_gate"],

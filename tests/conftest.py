@@ -61,6 +61,18 @@ def _fresh_slo():
     slo.reset()
 
 
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    """The retrospective layer (timeline rings, markers, anomaly
+    counters, exemplars) is module singletons too; reset stops the
+    sampler thread a build_stack may have armed and drops all history
+    so one test's markers/cursors never leak into the next."""
+    yield
+    from tpushare import obs
+
+    obs.reset()
+
+
 @pytest.fixture
 def api():
     return FakeApiServer()

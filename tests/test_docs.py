@@ -266,6 +266,48 @@ def test_slo_doc_is_linked():
             assert "slo.md" in f.read(), path
 
 
+def test_observability_doc_covers_retrospective():
+    """§6 (the retrospective timeline) is the newest layer's contract:
+    the recorder model with its tier math, the kill switch, the full
+    marker taxonomy (AST-extracted, so adding a kind without
+    documenting it fails), the anomaly watchers with their Event, the
+    exemplar join, the runbook chain, and every surface."""
+    with open(OBSERVABILITY_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("/debug/timeline", "TPUSHARE_TIMELINE",
+                   "kubectl inspect tpushare timeline",
+                   "tier0", "tier1", "min, avg, max",
+                   "cursor", "[timeline <cursor>]",
+                   "fire-and-forget", "z-score", "TPUShareAnomaly",
+                   "exemplar", 'trace_id="', "/debug/trace?id=",
+                   "tpushare_build_info", "tpushare_uptime_seconds",
+                   "tpushare_anomaly_fired_total",
+                   "tpushare_timeline_dropped_total",
+                   "tpushare_timeline_series",
+                   "bench_diff", "Runbook"):
+        assert needle in doc, needle
+    # Every marker kind the recorder accepts is documented: extract
+    # the MARKER_KINDS frozenset literal from the source (stdlib-only,
+    # same reason as registered_metric_names).
+    timeline_py = os.path.join(REPO_ROOT, "tpushare", "obs",
+                               "timeline.py")
+    with open(timeline_py, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=timeline_py)
+    kinds: list[str] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(getattr(t, "id", "") == "MARKER_KINDS"
+                        for t in node.targets)):
+            for c in ast.walk(node.value):
+                if isinstance(c, ast.Constant) and isinstance(c.value,
+                                                              str):
+                    kinds.append(c.value)
+    assert len(kinds) >= 8, "MARKER_KINDS literal not found"
+    missing = [k for k in kinds if f"`{k}`" not in doc]
+    assert not missing, (
+        f"marker kinds absent from docs/observability.md: {missing}")
+
+
 if __name__ == "__main__":
     # CI's lint job runs this file as a plain script (no pytest, no
     # project install — tests/conftest.py would drag jax in); the same
@@ -276,6 +318,7 @@ if __name__ == "__main__":
     for check in (test_metrics_py_parses_some_metrics,
                   test_every_registered_metric_is_documented,
                   test_observability_doc_covers_the_surfaces,
+                  test_observability_doc_covers_retrospective,
                   test_quota_doc_covers_the_contract,
                   test_quota_doc_is_linked,
                   test_slo_doc_covers_the_contract,

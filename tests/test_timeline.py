@@ -1,0 +1,373 @@
+"""Retrospective observability: the ISSUE-13 acceptance contract
+(tpushare/obs, docs/observability.md §6).
+
+Covers: tier0→tier1 rollover preserving (min, avg, max) under an
+injected clock, every hard bound (tier0 ring, series cap with
+coldest-first eviction, marker ring) counting its drops, the
+fire-and-forget contract at each emission site (a seeded timeline
+fault must never reach the leader/SLO/quota/router control flow),
+/debug/timeline over the real stack with query filters and the
+TPUSHARE_TIMELINE kill switch — and the full e2e story: quota
+pressure burns the pod-e2e budget, the TPUShareSLOBurn Event carries
+``[timeline <cursor>]``, the cursor resolves to the slo-burn marker on
+/debug/timeline next to the verb series, the scrape's bucket exemplars
+resolve to flight-recorder decisions, and the kubectl-inspect timeline
+rendering shows the same cursor.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.conftest import make_node, make_pod
+from tpushare import obs, slo, trace
+from tpushare.api.objects import ConfigMap
+from tpushare.k8s import events
+from tpushare.k8s.leader import LeaderElector
+from tpushare.obs.timeline import (MAX_MARKERS, MAX_SERIES, TIER0_POINTS,
+                                   TIER1_BUCKET_S, TimelineRecorder)
+from tpushare.slo import config as slo_config
+
+
+@pytest.fixture(autouse=True)
+def fresh_retrospective():
+    """The obs/slo/trace layers are module singletons; start each test
+    from a clean slate (conftest's _fresh_obs already resets obs on
+    teardown; slo/trace resets mirror test_slo.py's fixture)."""
+    obs.reset()
+    slo.reset()
+    trace.reset()
+    yield
+    slo.reset()
+    trace.reset()
+
+
+# ------------------------------------------------------------------------ #
+# Tier math under an injected clock
+# ------------------------------------------------------------------------ #
+
+
+class TestTierRollover:
+    def test_bucket_boundary_flush_preserves_min_avg_max(self):
+        clock = [1000.0 * TIER1_BUCKET_S]  # exactly on a boundary
+        rec = TimelineRecorder(now_fn=lambda: clock[0])
+        for value in (5.0, 1.0, 3.0):
+            rec.record("hbm", value)
+            clock[0] += 2.0
+        # crossing the 30s boundary flushes the open bucket to tier1
+        clock[0] = 1000.0 * TIER1_BUCKET_S + TIER1_BUCKET_S + 1.0
+        rec.record("hbm", 9.0)
+
+        doc = rec.snapshot()
+        series = doc["series"]["hbm"]
+        assert len(series["tier0"]) == 4
+        assert series["last"] == 9.0
+        ((bucket_ts, lo, avg, hi),) = series["tier1"]
+        assert bucket_ts == 1000.0 * TIER1_BUCKET_S
+        assert (lo, hi) == (1.0, 5.0)
+        assert avg == pytest.approx(3.0)
+
+    def test_window_cut_keeps_covering_tier1_bucket(self):
+        clock = [0.0]
+        rec = TimelineRecorder(now_fn=lambda: clock[0])
+        rec.record("x", 1.0, ts=10.0)
+        rec.record("x", 2.0, ts=40.0)   # flushes the [0, 30) bucket
+        clock[0] = 50.0
+        doc = rec.snapshot(window_s=45.0)  # cut at t=5: bucket 0 ends
+        series = doc["series"]["x"]        # at 30 > 5, so it survives
+        assert [v for _ts, v in series["tier0"]] == [1.0, 2.0]
+        assert len(series["tier1"]) == 1
+        doc = rec.snapshot(window_s=15.0)  # cut at t=35: bucket 0 gone
+        assert doc["series"]["x"]["tier0"] == [(40.0, 2.0)]
+        assert doc["series"]["x"]["tier1"] == []
+
+
+# ------------------------------------------------------------------------ #
+# Hard bounds: every ring counts what it loses
+# ------------------------------------------------------------------------ #
+
+
+class TestBounds:
+    def test_tier0_ring_overflow_counts_drops(self):
+        clock = [0.0]
+        rec = TimelineRecorder(now_fn=lambda: clock[0])
+        for i in range(TIER0_POINTS + 5):
+            rec.record("x", float(i))
+            clock[0] += 0.01
+        assert rec.drops.value == 5
+        assert len(rec.snapshot()["series"]["x"]["tier0"]) == TIER0_POINTS
+
+    def test_max_series_evicts_coldest_first(self):
+        rec = TimelineRecorder(now_fn=lambda: 0.0)
+        for i in range(MAX_SERIES):
+            rec.record(f"s{i:03d}", 1.0, ts=float(i + 1))
+        assert rec.series_count() == MAX_SERIES
+        assert rec.drops.value == 0
+        rec.record("newcomer", 2.0, ts=1000.0)
+        doc = rec.snapshot()
+        assert rec.series_count() == MAX_SERIES
+        assert "s000" not in doc["series"]  # coldest written_at evicted
+        assert "s001" in doc["series"]
+        assert "newcomer" in doc["series"]
+        # the evicted series' 1 tier0 point + the series slot itself
+        assert rec.drops.value == 2
+
+    def test_marker_ring_bounded(self):
+        rec = TimelineRecorder(now_fn=lambda: 0.0)
+        for i in range(MAX_MARKERS + 1):
+            rec.mark("config", f"m{i}")
+        assert rec.drops.value == 1
+        markers = rec.snapshot()["markers"]
+        assert len(markers) == MAX_MARKERS
+        assert markers[0]["cursor"] == 2  # cursor 1 fell off the ring
+        assert rec.get_marker(1) is None
+        assert rec.get_marker(2) is not None
+
+
+# ------------------------------------------------------------------------ #
+# Fire-and-forget: a broken timeline never reaches an emission site
+# ------------------------------------------------------------------------ #
+
+
+class TestFireAndForget:
+    @pytest.fixture
+    def broken_timeline(self, monkeypatch):
+        """Seed a fault INSIDE the recorder: every mark() raises. The
+        sites below must complete their control flow anyway, with the
+        failure visible only in obs.mark_drops()."""
+        def boom(*_args, **_kwargs):
+            raise RuntimeError("seeded timeline fault")
+
+        monkeypatch.setattr(obs.timeline(), "mark", boom)
+
+    def test_unknown_kind_swallowed(self):
+        before = obs.mark_drops()
+        assert obs.mark("not-a-kind", "x") is None
+        assert obs.mark_drops() == before + 1
+
+    def test_note_verb_fault_swallowed(self, monkeypatch):
+        def boom(*_args, **_kwargs):
+            raise RuntimeError("seeded timeline fault")
+
+        monkeypatch.setattr(obs.timeline(), "note_verb", boom)
+        before = obs.mark_drops()
+        obs.note_verb("bind", 0.01, trace_id="t-1")  # must not raise
+        assert obs.mark_drops() == before + 1
+
+    def test_slo_config_site(self, broken_timeline):
+        before = obs.mark_drops()
+        slo.engine().set_config(slo_config.DEFAULTS)  # must not raise
+        assert slo.engine().config() is slo_config.DEFAULTS
+        assert obs.mark_drops() == before + 1
+
+    def test_leader_site(self, broken_timeline):
+        elector = LeaderElector(None, "me")
+        before = obs.mark_drops()
+        elector._became(True, "seeded-fault test")  # must not raise
+        assert elector._leader is True  # the flip itself landed
+        assert obs.mark_drops() == before + 1
+
+    def test_controller_quota_configmap_site(self, broken_timeline):
+        from tests.test_quota import quota_cm_doc
+        from tpushare.controller.controller import Controller
+        from tpushare.k8s.fake import FakeApiServer
+
+        controller = Controller(FakeApiServer())
+        cm = ConfigMap(quota_cm_doc({"team-x": {"limitHBM": 16}}))
+        before = obs.mark_drops()
+        controller._on_quota_configmap(cm)  # must not raise
+        assert controller.quota.configured("team-x")
+        assert obs.mark_drops() == before + 1
+
+    def test_router_scaleout_site(self, broken_timeline):
+        from tests.test_router import make_router
+        from tpushare.router import DecodeReplica
+
+        fired = []
+        router, clock = make_router(scaleout_queue_factor=0.5,
+                                    scaleout_cooldown_s=5.0,
+                                    on_scaleout=fired.append)
+        router.add_replica(DecodeReplica(
+            "r0", slots=2, hbm_gib=8.0, decode_tok_s=1000.0,
+            prefill_tok_s=1e9))
+        for _ in range(4):
+            router.submit("chat", 32, 100_000)
+        clock.advance(6.0)
+        before = obs.mark_drops()
+        router.tick()  # must not raise
+        assert len(fired) == 1  # the scale-out callback still fired
+        assert obs.mark_drops() == before + 1
+
+
+# ------------------------------------------------------------------------ #
+# /debug/timeline over the real stack
+# ------------------------------------------------------------------------ #
+
+
+@pytest.fixture
+def cluster(api):
+    from tests.test_quota import Cluster
+
+    api.create_node(make_node("v5e-0"))
+    c = Cluster(api)
+    yield c
+    c.close()
+
+
+class TestDebugTimelineOverStack:
+    def test_roundtrip_marker_resolves_to_flight(self, api, cluster):
+        api.create_pod(make_pod("p-0", hbm=16))
+        ok, _where = cluster.schedule(api.get_pod("default", "p-0"))
+        assert ok
+        flight = json.loads(cluster._get("/debug/flight"))
+        tid = flight["decisions"][-1]["traceId"]
+        assert tid
+
+        cursor = obs.mark("config", "timeline roundtrip probe",
+                          trace_id=tid, configmap="test")
+        assert cursor
+        obs.timeline().tick()  # fold verb buffers now, not in ~2s
+
+        doc = json.loads(cluster._get("/debug/timeline?window=3600"))
+        assert doc["enabled"] and doc["running"]
+        assert doc["cursorLatest"] >= cursor
+        (marker,) = [m for m in doc["markers"] if m["cursor"] == cursor]
+        assert marker["kind"] == "config"
+        assert marker["attrs"]["trace_id"] == tid
+        # the verbs the schedule() call exercised fed the p99 series
+        assert any(name.startswith("verb_p99_ms:")
+                   for name in doc["series"])
+        # the marker's trace-id resolves to the bind decision
+        with urllib.request.urlopen(
+                f"{cluster.base}/debug/trace/default/p-0?id={tid}") as r:
+            assert json.loads(r.read())["traceId"] == tid
+
+    def test_snapshot_query_filters(self, cluster):
+        rec = obs.timeline()
+        rec.record("alpha:one", 1.0)
+        rec.record("beta:two", 2.0)
+        obs.mark("config", "filtered out by markers=0")
+        doc = json.loads(
+            cluster._get("/debug/timeline?series=alpha&markers=0"))
+        assert set(doc["series"]) == {"alpha:one"}
+        assert doc["markers"] == []
+
+    def test_kill_switch_disarms_route_and_markers(self, cluster,
+                                                   monkeypatch):
+        monkeypatch.setenv("TPUSHARE_TIMELINE", "off")
+        assert obs.mark("config", "dropped silently") is None
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            cluster._get("/debug/timeline")
+        assert exc.value.code == 404
+
+    def test_eviction_drops_surface_in_scrape(self, cluster):
+        rec = obs.timeline()
+        # 1 past the cap with tiny timestamps: the cap-* series are the
+        # coldest, so each insert past MAX_SERIES evicts one of them
+        # (2 drops per eviction: the tier0 point + the series slot).
+        for i in range(MAX_SERIES + 1):
+            rec.record(f"cap-{i:03d}", float(i), ts=float(i + 1))
+        assert rec.drops.value >= 2
+        text = cluster.metrics_text()
+        dropped = _gauge(text, "tpushare_timeline_dropped_total")
+        assert dropped >= 2.0
+        assert _gauge(text, "tpushare_timeline_series") >= 1.0
+        # the restart-bracketing self-metrics ride in the same scrape
+        assert "tpushare_build_info{" in text
+        assert _gauge(text, "tpushare_uptime_seconds") > 0.0
+
+
+def _gauge(metrics_text: str, prefix: str) -> float:
+    for line in metrics_text.splitlines():
+        if line.startswith(prefix):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"no gauge line starts with {prefix!r}")
+
+
+# ------------------------------------------------------------------------ #
+# The acceptance story: a page resolves to a root cause
+# ------------------------------------------------------------------------ #
+
+
+class TestAcceptanceRetrospective:
+    def test_burn_event_cursor_resolves_through_timeline_to_trace(
+            self, api):
+        """Quota pressure burns the pod-e2e budget; the operator walks
+        Event → ``[timeline <cursor>]`` → /debug/timeline marker →
+        bucket exemplar → /debug/flight decision, then sees the same
+        story in the kubectl-inspect timeline rendering."""
+        from tests.test_quota import Cluster, quota_cm_doc
+        from tests.test_slo import _aged_pod_doc
+
+        api.create_node(make_node("v5e-0"))
+        api.create_configmap(quota_cm_doc({"team-x": {"limitHBM": 16}}))
+        cluster = Cluster(api)
+        try:
+            # saturate team-x's hard limit, then a pod that has already
+            # waited 60s retries into quota denials before binding
+            api.create_pod(make_pod("b-0", hbm=16, namespace="team-x"))
+            ok, _where = cluster.schedule(api.get_pod("team-x", "b-0"))
+            assert ok
+            api.create_pod(_aged_pod_doc("p-burn", 60, hbm=16,
+                                         namespace="team-x"))
+            burn_pod = api.get_pod("team-x", "p-burn")
+            for _ in range(3):
+                result = cluster.filter(burn_pod)
+                assert not (result["NodeNames"] or [])
+            api.delete_pod("team-x", "b-0")
+            cluster.stack.controller.wait_idle(timeout=10)
+            ok, where = cluster.schedule(api.get_pod("team-x", "p-burn"))
+            assert ok, where
+
+            # -- the burn fires; its Event carries the cursor -------- #
+            text = cluster.metrics_text()  # scrape evaluates the SLOs
+            cluster.metrics_text()         # second scrape: same burn,
+            assert events.flush()          # still exactly one Event
+            burns = [e for _ns, e in api.events
+                     if e["reason"] == "TPUShareSLOBurn"]
+            assert len(burns) == 1
+            message = burns[0]["message"]
+            assert "[timeline " in message
+            cursor = int(message.rsplit("[timeline ", 1)[1].rstrip("]"))
+
+            # -- the cursor resolves on /debug/timeline -------------- #
+            obs.timeline().tick()  # fold verb buffers without waiting
+            doc = json.loads(cluster._get("/debug/timeline?window=3600"))
+            (marker,) = [m for m in doc["markers"]
+                         if m["cursor"] == cursor]
+            assert marker["kind"] == "slo-burn"
+            assert marker["attrs"]["slo"] == "pod-bind-30s"
+            # ... next to the verb series the retries drew
+            assert "verb_p99_ms:filter" in doc["series"]
+            assert "verb_p99_ms:bind" in doc["series"]
+
+            # -- the scrape's exemplars join buckets to traces ------- #
+            text = cluster.metrics_text()
+            exemplar_lines = [line for line in text.splitlines()
+                              if '# {trace_id="' in line]
+            assert exemplar_lines
+            tid = exemplar_lines[0].split('trace_id="')[1].split('"')[0]
+            flight = json.loads(cluster._get("/debug/flight"))
+            assert any(d.get("traceId") == tid
+                       for d in flight["decisions"])
+
+            # -- the operator view renders the same story ------------ #
+            from tools.kubectl_inspect_tpushare import (fetch_timeline,
+                                                        render_timeline)
+            fetched = fetch_timeline(cluster.base, window=3600)
+            assert fetched is not None
+            rendered = render_timeline(fetched)
+            assert "slo-burn" in rendered
+            assert f"[{cursor}]" in rendered
+        finally:
+            cluster.close()
+
+
+if __name__ == "__main__":
+    import subprocess
+    import sys
+
+    sys.exit(subprocess.call(
+        [sys.executable, "-m", "pytest", __file__, "-v"]))

@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""bench_diff — drift gate between two bench JSON contracts.
+
+Every bench entrypoint (``bench.py``, ``bench.py --scale``, ``--wire``,
+…) prints a one-line JSON document whose ``gates`` object holds
+``{value, limit, pass}`` entries; the full-size runs are committed as
+``BENCH_SCALE.json`` / ``BENCH_WIRE_r01.json`` / …. This tool compares
+a fresh run against a committed contract and exits nonzero when any
+*gated* stat drifted more than ``--tolerance`` (default 10%) in the
+unfavorable direction:
+
+    python bench.py --scale --smoke > /tmp/fresh.json
+    python tools/bench_diff.py BENCH_SCALE.json /tmp/fresh.json
+
+Rules:
+
+* entries flagged ``"gated": false`` or with ``limit: null`` are
+  advisory in the bench itself (e.g. ``concurrent_throughput`` on a
+  core-starved host) and are skipped here too;
+* entries without a scalar ``value`` (e.g. ``profiler_overhead``,
+  which gates on a delta-of-minima) are skipped — their own bench gate
+  already bounds them;
+* direction comes from the committed contract: a passing gate whose
+  value sits at or under its limit is lower-is-better (latency), one
+  sitting over it is higher-is-better (coverage, throughput);
+* a gate present in the baseline but missing from the fresh run is a
+  warning, not a failure — benches grow and shrink across PRs.
+
+A smoke run measures a smaller scenario than the committed full-size
+contract, so CI wires this as an *advisory* step (``make bench-diff``
+locally): drift is a prompt to re-run the full bench, not proof of a
+regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+DEFAULT_TOLERANCE = 0.10
+
+
+def load_contract(path: str) -> dict:
+    """The last line of *path* that parses as a JSON object with a
+    ``gates`` key (bench prints exactly one, but a captured run may
+    carry stray log lines)."""
+    doc = None
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                candidate = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(candidate, dict) and "gates" in candidate:
+                doc = candidate
+    if doc is None:
+        raise SystemExit(f"{path}: no bench contract line "
+                         "(a JSON object with a 'gates' key) found")
+    return doc
+
+
+def diff_gates(base: dict, fresh: dict,
+               tolerance: float) -> tuple[list[list[str]], bool]:
+    """(table rows, any_regression) for the two contracts' gates."""
+    rows: list[list[str]] = []
+    regressed = False
+    base_gates = base.get("gates") or {}
+    fresh_gates = fresh.get("gates") or {}
+    for name, bg in sorted(base_gates.items()):
+        if bg.get("gated") is False or bg.get("limit") is None:
+            rows.append([name, "-", "-", "-", "skip (ungated)"])
+            continue
+        bval = bg.get("value")
+        if not isinstance(bval, (int, float)):
+            rows.append([name, "-", "-", "-", "skip (no scalar value)"])
+            continue
+        fg = fresh_gates.get(name)
+        fval = fg.get("value") if isinstance(fg, dict) else None
+        if not isinstance(fval, (int, float)):
+            rows.append([name, f"{bval:g}", "-", "-",
+                         "WARN (missing in fresh run)"])
+            continue
+        lower_better = bval <= bg["limit"]
+        if bval == 0:
+            verdict = "skip (zero baseline)"
+        else:
+            delta = (fval - bval) / abs(bval)
+            bad = (delta > tolerance if lower_better
+                   else delta < -tolerance)
+            if bad:
+                regressed = True
+                verdict = (f"REGRESSED (>{tolerance * 100:.0f}% "
+                           f"{'slower' if lower_better else 'worse'})")
+            else:
+                verdict = "ok"
+            rows.append([name, f"{bval:g}", f"{fval:g}",
+                         f"{delta * 100:+.1f}%",
+                         verdict
+                         + ("" if lower_better else " [higher=better]")])
+            continue
+        rows.append([name, f"{bval:g}", "-", "-", verdict])
+    return rows, regressed
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Compare a fresh bench JSON contract against a "
+                    "committed baseline; nonzero exit on >tolerance "
+                    "drift of any gated stat.")
+    ap.add_argument("baseline", help="committed contract "
+                                     "(e.g. BENCH_SCALE.json)")
+    ap.add_argument("fresh", help="fresh bench output (one JSON line)")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    metavar="FRAC",
+                    help="allowed unfavorable drift as a fraction "
+                         f"(default {DEFAULT_TOLERANCE:g})")
+    args = ap.parse_args(argv)
+
+    base = load_contract(args.baseline)
+    fresh = load_contract(args.fresh)
+    rows, regressed = diff_gates(base, fresh, args.tolerance)
+
+    header = [["GATE", "BASE", "FRESH", "DRIFT", "VERDICT"]]
+    widths = [max(len(r[i]) for r in header + rows)
+              for i in range(len(header[0]))]
+    print(f"bench drift: {args.baseline} "
+          f"(smoke={base.get('smoke')}) vs {args.fresh} "
+          f"(smoke={fresh.get('smoke')}), "
+          f"tolerance {args.tolerance * 100:g}%")
+    for r in header + rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+    if regressed:
+        print("RESULT: drift over tolerance — re-run the full bench "
+              "(make bench-scale / bench-wire) before trusting the "
+              "committed contract", file=sys.stderr)
+        return 1
+    print("RESULT: within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,9 +15,11 @@ renders the continuous profiler's per-verb top frames and exact
 wall/CPU/lock-wait/apiserver cost splits from ``/debug/hotspots``
 (docs/perf.md); the ``serving`` subcommand renders the decode fleet's
 per-tenant queue depth / slot occupancy / shed counts / TTFT
-percentiles from ``/debug/router`` (docs/serving.md); ``explain``
-heads its span timeline with the pod's journey (attempt N of M,
-cumulative queue-wait).
+percentiles from ``/debug/router`` (docs/serving.md); the ``timeline``
+subcommand renders the retrospective recorder's series sparklines and
+event-marker lane from ``/debug/timeline`` (docs/observability.md);
+``explain`` heads its span timeline with the pod's journey (attempt N
+of M, cumulative queue-wait).
 
 Install as a kubectl plugin by dropping an executable named
 ``kubectl-inspect_tpushare`` on PATH that execs this script, or run it
@@ -559,6 +561,111 @@ def render_slo(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def fetch_timeline(endpoint: str, window: float = 600.0) -> dict | None:
+    """The retrospective snapshot from ``/debug/timeline``; None when
+    the recorder is disarmed (TPUSHARE_TIMELINE=off) or debug routes
+    are disabled."""
+    try:
+        with urllib.request.urlopen(
+                f"{endpoint}/debug/timeline?window={window:g}",
+                timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float]) -> str:
+    """Eight-level Unicode sparkline; flat series render as all-low."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[0] * len(values)
+    return "".join(
+        _SPARK_BLOCKS[min(len(_SPARK_BLOCKS) - 1,
+                          int((v - lo) / span * len(_SPARK_BLOCKS)))]
+        for v in values)
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:  # NaN guard
+        return "?"
+    if abs(v) >= 1000:
+        return f"{v:.0f}"
+    if abs(v) >= 1:
+        return f"{v:.2f}".rstrip("0").rstrip(".")
+    return f"{v:.4f}".rstrip("0").rstrip(".") or "0"
+
+
+def render_timeline(doc: dict) -> str:
+    """Per-series sparklines over the raw tier plus the marker lane —
+    the terminal rendering of the flight history an Event's
+    ``[timeline <cursor>]`` points into."""
+    series = doc.get("series") or {}
+    markers = doc.get("markers") or []
+    lines = [
+        f"timeline: {'recording' if doc.get('running') else 'stopped'} "
+        f"(tier0 {doc.get('tiers', {}).get('tier0', {}).get('resolutionSeconds', '?')}s raw, "
+        f"tier1 {doc.get('tiers', {}).get('tier1', {}).get('resolutionSeconds', '?')}s min/avg/max), "
+        f"{len(series)} series, cursor {doc.get('cursorLatest', 0)}",
+    ]
+    if not series and not markers:
+        lines.append("no history yet — the sampler needs a few ticks "
+                     "after start-up")
+        return "\n".join(lines)
+    if series:
+        rows = []
+        for name in sorted(series):
+            s = series[name]
+            points = [v for _ts, v in (s.get("tier0") or [])]
+            if not points:
+                # Only the aggregated hour remains in the window:
+                # sparkline the per-bucket averages instead.
+                points = [avg for _ts, _lo, avg, _hi
+                          in (s.get("tier1") or [])]
+            last = s.get("last")
+            rows.append([name, sparkline(points[-60:]),
+                         _fmt_value(last) if last is not None else "-",
+                         (f"{_fmt_value(min(points))}"
+                          f"..{_fmt_value(max(points))}"
+                          if points else "-")])
+        header = [["SERIES", "TREND", "LAST", "RANGE"]]
+        widths = [max(len(r[i]) for r in header + rows)
+                  for i in range(len(header[0]))]
+        lines.append("")
+        lines += ["  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+                  for r in header + rows]
+    if markers:
+        lines.append("")
+        lines.append("markers:")
+        now = doc.get("now") or 0.0
+        for m in sorted(markers, key=lambda m: m.get("ts", 0.0)):
+            age = now - m.get("ts", now)
+            detail = m.get("detail", "")
+            lines.append(f"  [{m.get('cursor')}] "
+                         f"{('-%.0fs' % age).rjust(7)} "
+                         f"{m.get('kind', '?'):<15s} {detail}")
+    drops = doc.get("drops") or {}
+    if any(drops.values()):
+        lines.append("")
+        lines.append("drops: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(drops.items()) if v))
+    lines.append("")
+    lines.append("TREND spans the requested window (left = oldest). "
+                 "Cursors in Event messages ([timeline N]) name the "
+                 "marker rows above; resolve a marker's trace_id with "
+                 "kubectl inspect tpushare explain <pod> or "
+                 "/debug/trace?id=. Full data: GET /debug/timeline "
+                 "(docs/observability.md).")
+    return "\n".join(lines)
+
+
 def fetch_defrag(endpoint: str) -> dict | None:
     """The fragmentation/rebalance snapshot from ``/debug/defrag``;
     None when the extender runs without the defrag executor wired or
@@ -881,7 +988,9 @@ def main(argv: list[str] | None = None) -> int:
                              "per-tenant queue/shed/TTFT table; or the "
                              "literal 'topology' for the host-grid "
                              "slice-occupancy map with per-gang ring "
-                             "contiguity")
+                             "contiguity; or the literal 'timeline' "
+                             "for the retrospective fleet history "
+                             "(series sparklines + event markers)")
     parser.add_argument("pod", nargs="?", metavar="[ns/]pod",
                         help="with 'explain': the pod whose placement "
                              "decision to explain (namespace defaults "
@@ -926,6 +1035,24 @@ def main(argv: list[str] | None = None) -> int:
                   "(DEBUG_ROUTES=0)", file=sys.stderr)
             return 1
         print(render_slo(doc))
+        return 0
+    if args.node == "timeline":
+        if args.pod:
+            print(f"unexpected argument {args.pod!r} after 'timeline'",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = fetch_timeline(args.endpoint)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        if doc is None:
+            print("timeline unavailable — the recorder is disarmed "
+                  "(TPUSHARE_TIMELINE=off) or debug routes are "
+                  "disabled (DEBUG_ROUTES=0)", file=sys.stderr)
+            return 1
+        print(render_timeline(doc))
         return 0
     if args.node == "topology":
         if args.pod:

@@ -474,6 +474,14 @@ def simulate(scenario: dict) -> dict:
             # Read over the wire like every other surface here, so the
             # replay also proves the endpoint round-trips.
             hotspots_doc = client.get("/debug/hotspots?top=5")
+        # Retrospective timeline: force one sampler pass so even a
+        # sub-second replay has history, then read it over the wire so
+        # the replay also proves /debug/timeline round-trips.
+        from tpushare import obs as _obs
+        _obs.timeline().tick()
+        timeline_doc = client.get("/debug/timeline?window=3600")
+        if timeline_doc.get("Error"):
+            timeline_doc = None  # recorder disarmed (TPUSHARE_TIMELINE=off)
     finally:
         if profiled:
             profiling.stop()
@@ -484,6 +492,8 @@ def simulate(scenario: dict) -> dict:
                      defrag_report, serving_report)
     if hotspots_doc is not None:
         report["hotspots"] = hotspots_doc
+    if timeline_doc is not None:
+        report["timeline"] = timeline_doc
     return report
 
 
@@ -1052,6 +1062,26 @@ def _print_human(report: dict) -> None:
                        else "DID NOT drain")
             print(f"  scale-out: {s['scaleOut']['signals']} "
                   f"signal(s), bound {scaled or 'none'}; {drained}")
+    timeline = report.get("timeline")
+    if timeline:
+        series = timeline.get("series") or {}
+        markers = timeline.get("markers") or []
+        print(f"\ntimeline: {len(series)} series, "
+              f"{len(markers)} marker(s), cursor "
+              f"{timeline.get('cursorLatest', 0)}")
+        for name in sorted(series):
+            s = series[name]
+            points = [v for _ts, v in (s.get("tier0") or [])]
+            if not points:
+                continue
+            print(f"  {name}: last {s.get('last'):g} "
+                  f"(min {min(points):g} / max {max(points):g} over "
+                  f"{len(points)} point(s))")
+        now = timeline.get("now") or 0.0
+        for m in sorted(markers, key=lambda m: m.get("ts", 0.0)):
+            age = now - m.get("ts", now)
+            print(f"  [{m.get('cursor')}] -{age:.0f}s "
+                  f"{m.get('kind')}: {m.get('detail')}")
     for g in report.get("gangs", []):
         print(f"\ngang {g.get('name')}: {g}")
 

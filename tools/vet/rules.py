@@ -104,6 +104,13 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
     # cross-thread memo shape as the verb memos, but dict-mutation
     # based, so it gets the lock-guarded treatment.
     "SlicePlacer": ("_memo",),
+    # The retrospective layer (tpushare/obs/): the sampler thread
+    # writes series/sources while HTTP threads stamp markers and the
+    # /debug/timeline reader snapshots; the anomaly ledger is hit by
+    # the tick hook and the scrape. (_verb_samples is deliberately
+    # lock-free — GIL-atomic deque appends on the gated hot path.)
+    "TimelineRecorder": ("_series", "_sources"),
+    "AnomalyEngine": ("_fired", "_event_at"),
 }
 
 #: Method calls that mutate a dict/set/list in place.
@@ -317,7 +324,8 @@ def raw_lock(tree: ast.AST, src: str, path: str) -> list[Violation]:
 _TELEMETRY_PATHS = ("k8s/events.py", "routes/metrics.py")
 _TELEMETRY_DIRS = ("tpushare/trace/", "tpushare/slo/",
                    "tpushare/defrag/", "tpushare/profiling/",
-                   "tpushare/router/", "tpushare/topology/")
+                   "tpushare/router/", "tpushare/topology/",
+                   "tpushare/obs/")
 
 #: Call shapes that count as incrementing a drop/error counter
 #: (bare ``safe_inc(...)``, ``metrics.safe_inc(...)``, ``x.inc()``).

@@ -23,7 +23,7 @@ CORE_PACKAGES = ("tpushare/cache/", "tpushare/scheduler/",
                  "tpushare/utils/", "tpushare/api/", "tpushare/quota/",
                  "tpushare/slo/", "tpushare/defrag/",
                  "tpushare/profiling/", "tpushare/router/",
-                 "tpushare/topology/",
+                 "tpushare/topology/", "tpushare/obs/",
                  "tpushare/k8s/eviction.py")
 
 #: Parameter names exempt from annotation (bound implicitly).

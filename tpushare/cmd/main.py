@@ -65,6 +65,12 @@ Environment (reference cmd/main.go:23,92-98):
   ``TPUSHARE_DEFRAG_MAX_CONCURRENT`` /
   ``TPUSHARE_DEFRAG_INTERVAL_S``, leader-gated, and aborts whole plans
   while any SLO is burning.
+* ``TPUSHARE_TIMELINE`` — ``on`` (default) arms the retrospective
+  timeline recorder (bounded per-series history rings + fleet-event
+  markers + anomaly watchers, served at ``/debug/timeline``;
+  docs/observability.md §Retrospective). ``off`` disables sampling,
+  markers, and exemplar annotation; every emission site degrades to a
+  no-op.
 """
 
 from __future__ import annotations
@@ -182,6 +188,16 @@ def build_stack(client, is_leader=None) -> Stack:
                       quota=controller.quota)
     admission = Admission(controller.cache,
                           node_lister=controller.hub.nodes.list)
+    # Retrospective timeline (docs/observability.md §Retrospective):
+    # register the cheap snapshot sources the background sampler reads
+    # — published ledgers only, never a fleet rescan — and arm the
+    # sampler (no-op under TPUSHARE_TIMELINE=off). Wired here so every
+    # harness that builds a stack (main, serve_stack, bench, simulate)
+    # gets history for free.
+    from tpushare import obs
+    obs.wire(client=client, demand=predicate.demand,
+             defrag=controller.defrag, workqueue=controller.queue)
+    obs.start()
     return Stack(controller, predicate, prioritize, binder, inspect,
                  preempt, admission)
 
@@ -198,6 +214,11 @@ def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2,
     serving front door normally runs in its own process, but the
     harness hosts it in-process for e2e stories (docs/serving.md)."""
     stack = build_stack(client)
+    if router is not None:
+        # The in-process router's queue pressure joins the timeline
+        # (build_stack cannot see it — the router arrives here).
+        from tpushare import obs
+        obs.wire(router=router)
     stack.controller.start(workers=workers)
     server = ExtenderHTTPServer(
         address, stack.predicate, stack.binder, stack.inspect,

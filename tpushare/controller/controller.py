@@ -19,7 +19,7 @@ import logging
 import os
 import threading
 
-from tpushare import slo
+from tpushare import obs, slo
 from tpushare.api.objects import ConfigMap, Pod
 from tpushare.cache.cache import SchedulerCache
 from tpushare.k8s import events
@@ -152,6 +152,8 @@ class Controller:
         deletes: set_config is idempotent, needs no apiserver round-trip,
         and a rate-limited retry would only delay enforcement."""
         self.quota.set_config(quota_config.parse_configmap(cm))
+        obs.mark("config", f"quota ConfigMap {cm.namespace}/{cm.name} "
+                 "applied", configmap="quota")
 
     def _is_slo_configmap(self, cm: ConfigMap) -> bool:
         """Only ``tpushare-slos`` in the pinned namespace

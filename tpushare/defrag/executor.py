@@ -42,7 +42,7 @@ import threading
 import time
 from typing import Any, Callable
 
-from tpushare import trace
+from tpushare import obs, trace
 from tpushare.api.objects import Pod
 from tpushare.cache.cache import SchedulerCache
 from tpushare.defrag import frag
@@ -246,6 +246,10 @@ class DefragExecutor:
         if plan is not None:
             with self._lock:
                 self._last_plan = plan
+            obs.mark("defrag-plan",
+                     f"plan {plan.plan_id}: {len(plan.moves)} move(s), "
+                     f"unblocks {', '.join(plan.unblocks) or 'n/a'}",
+                     plan=plan.plan_id, moves=len(plan.moves))
         return plan
 
     def execute(self, plan: Plan) -> None:
@@ -322,6 +326,10 @@ class DefragExecutor:
         log.warning("defrag plan %s ABORTED (%s): %s — %d move(s) "
                     "cancelled", plan.plan_id, reason, detail,
                     len(remaining))
+        obs.mark("defrag-abort",
+                 f"plan {plan.plan_id} aborted ({reason}): {detail}",
+                 plan=plan.plan_id, reason=reason,
+                 cancelled=len(remaining))
         self._emit_abort_event(plan, remaining, reason, detail)
 
     # -- telemetry -------------------------------------------------------- #

@@ -36,7 +36,7 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from tpushare import trace
+from tpushare import obs, trace
 from tpushare.utils import locks
 from tpushare.api.objects import Pod, binding_doc
 from tpushare.cache.nodeinfo import AllocationError
@@ -548,6 +548,10 @@ class GangPlanner:
             self._note_ring_contiguity(key, group, newly_committed)
             if self.placer is not None:
                 self.placer.forget(key)
+            obs.mark("gang-commit",
+                     f"gang {group.name} reached quorum "
+                     f"({len(newly_committed)} member(s) committing)",
+                     gang=group.name, members=len(newly_committed))
         for member_pod, member_node in newly_committed:
             events.record(
                 self.client, member_pod, events.REASON_GANG_COMMITTED,
@@ -860,6 +864,10 @@ class GangPlanner:
             log.warning("gang %s/%s: expired at %d/%d members; rolling "
                         "back", key[0], group.name, len(victims),
                         group.minimum)
+            obs.mark("gang-rollback",
+                     f"gang {group.name} expired at {len(victims)}/"
+                     f"{group.minimum} members; rolling back",
+                     gang=group.name, members=len(victims))
             for pod, _node in victims:
                 self.cache.remove_pod(pod)
                 self._strip_annotations(pod)

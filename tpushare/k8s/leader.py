@@ -36,6 +36,7 @@ import threading
 import time
 from datetime import datetime, timedelta, timezone
 
+from tpushare import obs
 from tpushare.k8s.errors import ApiError, ConflictError
 from tpushare.utils import locks
 
@@ -191,6 +192,15 @@ class LeaderElector:
         if changed or why:
             log.info("leader election [%s]: %s (%s)", self.identity,
                      "LEADER" if leader else "follower", why or "observed")
+        if changed:
+            # Fire-and-forget timeline marker: a leadership flip is the
+            # canonical "what happened at 14:02" anchor. obs.mark
+            # swallows every internal failure — election control flow
+            # must never depend on history-keeping.
+            obs.mark("leader",
+                     "acquired leadership" if leader
+                     else f"lost leadership ({why or 'observed'})",
+                     identity=self.identity)
 
     def _run(self) -> None:
         first = True

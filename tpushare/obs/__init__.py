@@ -1,0 +1,180 @@
+"""tpushare.obs — retrospective observability, module-level face.
+
+One process-wide :class:`~tpushare.obs.timeline.TimelineRecorder`,
+:class:`~tpushare.obs.anomaly.AnomalyEngine`, and
+:class:`~tpushare.obs.exemplars.ExemplarStore` (module singletons,
+like :mod:`tpushare.trace`'s recorder and :mod:`tpushare.slo`'s
+engine) so emission sites, the routes layer, and the tools all reach
+the same rings without constructor plumbing.
+
+Usage map:
+
+* stack wiring:        ``obs.wire(client=…, demand=…, defrag=…, …)``
+  then ``obs.start()`` (no-op under ``TPUSHARE_TIMELINE=off``)
+* fleet events:        ``obs.mark("slo-burn", detail, slo=name)`` —
+  fire-and-forget at every emission site; exceptions are swallowed
+  into a drop counter, never the caller's control flow
+* verb hot path:       ``obs.note_verb("bind", seconds, trace_id)`` —
+  feeds the p99 series AND files the bucket exemplar
+* the metrics render:  ``obs.annotate_metrics(text)`` appends the
+  OpenMetrics ``# {trace_id="…"}`` exemplars
+* debug surface:       ``obs.snapshot(window_s=…)`` → /debug/timeline
+
+See docs/observability.md §Retrospective for the tier math, marker
+taxonomy, and the burn → cursor → timeline → exemplar → trace runbook.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpushare.obs import sources
+from tpushare.obs.anomaly import AnomalyEngine, Rule
+from tpushare.obs.exemplars import ExemplarStore
+from tpushare.obs.timeline import (MARKER_KINDS, TimelineRecorder,
+                                   enabled)
+
+__all__ = [
+    "AnomalyEngine", "ExemplarStore", "MARKER_KINDS", "Rule",
+    "TimelineRecorder", "anomalies", "annotate_metrics", "enabled",
+    "exemplars", "mark", "mark_drops", "note_verb", "reset",
+    "snapshot", "sources", "start", "stop", "timeline", "wire",
+]
+
+_timeline = TimelineRecorder()
+_anomalies = AnomalyEngine(_timeline)
+_exemplars = ExemplarStore()
+
+
+def _hook_anomalies() -> None:
+    _timeline.add_tick_hook(lambda now: _anomalies.evaluate(now))
+
+
+_hook_anomalies()
+
+
+def timeline() -> TimelineRecorder:
+    return _timeline
+
+
+def anomalies() -> AnomalyEngine:
+    return _anomalies
+
+
+def exemplars() -> ExemplarStore:
+    return _exemplars
+
+
+# -- wiring ---------------------------------------------------------------- #
+
+
+def wire(client: object | None = None, demand: object | None = None,
+         defrag: object | None = None, workqueue: object | None = None,
+         router: object | None = None) -> None:
+    """Register sample sources for whatever subsystems exist (replaces
+    any prior registration under the same name) and arm anomaly Event
+    emission. Called from ``build_stack``; safe to call repeatedly."""
+    _timeline.add_source("registry", sources.registry_source())
+    if demand is not None:
+        _timeline.add_source("demand", sources.demand_source(demand))
+    if defrag is not None:
+        _timeline.add_source("frag", sources.stranded_source(defrag))
+    if workqueue is not None:
+        _timeline.add_source("workqueue",
+                             sources.workqueue_source(workqueue))
+    if router is not None:
+        _timeline.add_source("router", sources.router_source(router))
+    if client is not None:
+        _anomalies.set_client(client)
+
+
+def start() -> bool:
+    """Arm the background sampler (idempotent; False under the
+    ``TPUSHARE_TIMELINE=off`` kill switch)."""
+    return _timeline.start()
+
+
+def stop() -> None:
+    _timeline.stop()
+
+
+# -- fire-and-forget intake ------------------------------------------------- #
+
+
+def mark(kind: str, detail: str = "", trace_id: str | None = None,
+         **attrs: object) -> int | None:
+    """Stamp a typed marker onto the fleet timeline; returns its
+    cursor, or None when disabled or on any internal failure. This is
+    the ONLY marker entry point emission sites may call: whatever goes
+    wrong inside the timeline layer is swallowed into the drop counter
+    — a leadership flip must never fail because history-keeping did."""
+    try:
+        if not enabled():
+            return None
+        str_attrs = {key: str(value) for key, value in attrs.items()}
+        if trace_id is None:
+            from tpushare import trace
+            trace_id = trace.current_trace_id()
+        if trace_id:
+            str_attrs["trace_id"] = trace_id
+        return _timeline.mark(kind, detail, str_attrs)
+    except Exception:  # noqa: BLE001 - marking must never reach callers
+        _timeline.mark_drops.inc()
+        return None
+
+
+def note_verb(verb: str, seconds: float, trace_id: str = "") -> None:
+    """Hot-path verb observation: feeds the ``verb_p99_ms:<verb>``
+    series and files the histogram-bucket exemplar. Lock-free,
+    fire-and-forget (see mark())."""
+    try:
+        if not enabled():
+            return
+        _timeline.note_verb(verb, seconds)
+        if trace_id:
+            _exemplars.record(verb, seconds, trace_id)
+    except Exception:  # noqa: BLE001 - telemetry must never reach callers
+        _timeline.mark_drops.inc()
+
+
+def mark_drops() -> int:
+    """Swallowed-exception count across the fire-and-forget surface."""
+    return _timeline.mark_drops.value
+
+
+# -- render/read ------------------------------------------------------------ #
+
+
+def annotate_metrics(text: bytes) -> bytes:
+    """Append OpenMetrics exemplars to a rendered exposition;
+    fire-and-forget (the scrape must never fail because of us)."""
+    try:
+        if not enabled():
+            return text
+        return _exemplars.annotate(text)
+    except Exception:  # noqa: BLE001 - rendering must never break /metrics
+        _exemplars.drops.inc()
+        return text
+
+
+def snapshot(window_s: float | None = None,
+             series: list[str] | None = None,
+             markers: bool = True) -> dict[str, Any]:
+    """The ``/debug/timeline`` document: series + markers + exemplars
+    + anomaly state."""
+    doc = _timeline.snapshot(window_s=window_s, series=series,
+                             markers=markers)
+    doc["exemplars"] = _exemplars.snapshot()
+    doc["anomalies"] = {"fired": _anomalies.fired_counts(),
+                        "rules": [r.name for r in _anomalies.rules()]}
+    doc["drops"]["exemplars"] = _exemplars.drops.value
+    doc["drops"]["anomaly"] = _anomalies.drops.value
+    return doc
+
+
+def reset() -> None:
+    """Stop the sampler and drop all retrospective state (tests)."""
+    _timeline.reset()
+    _anomalies.reset()
+    _exemplars.reset()
+    _hook_anomalies()

@@ -48,6 +48,7 @@ import time
 from collections import deque
 from typing import TYPE_CHECKING, Callable, Deque, Iterable
 
+from tpushare import obs
 from tpushare.utils import locks, stats
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
@@ -509,6 +510,11 @@ class Router:
                 fired = self.on_scaleout
                 spec = self.scaleout_spec()
         if fired is not None:
+            obs.mark("router-scaleout",
+                     f"queue depth {queued_total} over "
+                     f"{self.scaleout_queue_factor}x fleet slots "
+                     f"({fleet})",
+                     queued=queued_total, fleet_slots=fleet)
             # Outside the ledger lock: the callback schedules pods
             # (apiserver round-trips must never run under it).
             fired(spec)

@@ -8,6 +8,7 @@ here are the source of the bench harness's latency numbers.
 from __future__ import annotations
 
 import os
+import time as _time_mod
 
 from tpushare.utils import locks
 
@@ -24,6 +25,11 @@ _BUCKETS = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
     0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 )
+
+#: Public alias: the exemplar store (tpushare.obs.exemplars) buckets
+#: its trace-ids by these bounds so the ``# {trace_id=…}`` annotations
+#: land on the exact ``le`` series prometheus_client renders.
+LATENCY_BUCKETS = _BUCKETS
 
 FILTER_LATENCY = Histogram(
     "tpushare_filter_latency_seconds",
@@ -613,11 +619,80 @@ GC_COLLECTIONS = Gauge(
     "webhook path shows up as latency p99 spikes",
     ["generation"], registry=REGISTRY,
 )
+BUILD_INFO = Gauge(
+    "tpushare_build_info",
+    "Always 1; the labels carry the extender version and Python "
+    "runtime so dashboards and the timeline can bracket restarts and "
+    "correlate behavior changes with rollouts",
+    ["version", "python"], registry=REGISTRY,
+)
+UPTIME = Gauge(
+    "tpushare_uptime_seconds",
+    "Seconds since this process imported the metrics layer. A reset "
+    "to ~0 on an otherwise-continuous scrape series IS the restart "
+    "marker retrospective queries bracket on",
+    registry=REGISTRY,
+)
+ANOMALIES_FIRED = Gauge(
+    "tpushare_anomaly_fired_total",
+    "Anomaly-rule firings (threshold / rate-of-change / z-score "
+    "watchers over the timeline rings; monotonic, set at scrape from "
+    "the engine's counters). Each firing stamped a timeline marker "
+    "and, rate-limited, a TPUShareAnomaly Event carrying the cursor",
+    ["rule"], registry=REGISTRY,
+)
+TIMELINE_DROPPED = Gauge(
+    "tpushare_timeline_dropped_total",
+    "Timeline points/markers lost to the memory caps plus exceptions "
+    "swallowed on the fire-and-forget record path (monotonic, set at "
+    "scrape). Nonzero eviction is normal once rings wrap; a RISING "
+    "swallowed count means the retrospective layer itself is broken",
+    registry=REGISTRY,
+)
+TIMELINE_SERIES = Gauge(
+    "tpushare_timeline_series",
+    "Series currently held in the timeline rings (capped; at the cap "
+    "the coldest series is evicted per new one)",
+    registry=REGISTRY,
+)
+
+
+#: Process birth for tpushare_uptime_seconds — import time of this
+#: module is within milliseconds of process start for every entrypoint.
+_PROCESS_START = _time_mod.time()
 
 
 def render() -> bytes:
     with _SCRAPE_LOCK:
-        return generate_latest(REGISTRY)
+        text = generate_latest(REGISTRY)
+    # Exemplar annotation runs OUTSIDE the scrape lock (it reads only
+    # the obs layer's own lock-free cells) and is fire-and-forget:
+    # obs.annotate_metrics returns the input unchanged on any failure.
+    from tpushare import obs
+    return obs.annotate_metrics(text)
+
+
+def observe_timeline() -> None:
+    """Refresh the retrospective layer's self-series: build/uptime
+    bracketing, anomaly firings, and the timeline's own drop counters
+    (the flight recorder and SLO engine surface drops the same way —
+    silent telemetry loss is the failure this layer exists to catch)."""
+    import platform
+
+    from tpushare import __version__, obs
+
+    with _SCRAPE_LOCK:
+        BUILD_INFO.labels(version=__version__,
+                          python=platform.python_version()).set(1)
+        UPTIME.set(_time_mod.time() - _PROCESS_START)
+        timeline = obs.timeline()
+        TIMELINE_SERIES.set(timeline.series_count())
+        TIMELINE_DROPPED.set(timeline.drops.value
+                             + timeline.mark_drops.value
+                             + obs.exemplars().drops.value)
+        ANOMALIES_FIRED.clear()
+        for rule, count in obs.anomalies().fired_counts().items():
+            ANOMALIES_FIRED.labels(rule=rule).set(count)
 
 
 def observe_cache(cache) -> None:
@@ -934,6 +1009,7 @@ def scrape(cache, gang_planner=None, leader=None, demand=None,
             observe_slo()
             observe_profiling()
             observe_process()
+            observe_timeline()
             if http_server is not None:
                 observe_http(http_server)
             if quota is not None:

@@ -40,6 +40,11 @@ Routes:
   wall/CPU/lock-wait/apiserver cost ledger (``?top=``, ``?window=``)
 * ``GET  /debug/journey/<ns>/<pod>`` — the pod's journey: creation to
   bound, every attempt's trace-id, queue-wait vs in-verb split
+* ``GET  /debug/timeline`` — the retrospective layer: tiered per-series
+  history rings, typed fleet-event markers with cursor ids, per-bucket
+  verb-latency exemplars (``?window=`` seconds, ``?series=`` comma
+  list of name prefixes, ``?markers=0`` omits the marker lane;
+  docs/observability.md §Retrospective)
 
 The scheduling verbs run inside :mod:`tpushare.trace` phases, so every
 TPU pod's filter → prioritize → (preempt) → bind story is captured
@@ -73,7 +78,7 @@ import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import tpushare
-from tpushare import slo, trace
+from tpushare import obs, slo, trace
 from tpushare.api.extender import (ExtenderArgs, ExtenderBindingArgs,
                                    ExtenderPreemptionArgs)
 from tpushare.routes import metrics, pprof, wire
@@ -355,6 +360,11 @@ class ExtenderHTTPServer(HTTPServer):
             # filter wins the race, per docs/slo.md).
             slo.note_decision(args.pod.namespace, args.pod.name,
                               args.pod.uid, dec, pod=args.pod)
+        # Timeline + exemplar (fire-and-forget, lock-free): the p99
+        # series stays fresh without a scrape, and the histogram bucket
+        # this latency lands in remembers the trace-id.
+        obs.note_verb("filter", handler_ms / 1e3,
+                      dec.trace_id if dec is not None else "")
         return wire.encode_filter_result(result), handler_ms
 
     def _prioritize_batch(self, items: list[WorkItem]):
@@ -373,11 +383,13 @@ class ExtenderHTTPServer(HTTPServer):
         with metrics.PRIORITIZE_LATENCY.time(), \
                 trace.phase("prioritize", args.pod.namespace,
                             args.pod.name, args.pod.uid,
-                            enabled=_traced_pod(args.pod)):
+                            enabled=_traced_pod(args.pod)) as dec:
             if queue_s:
                 trace.note_queue_wait(queue_s)
             entries = self.prioritize.handle(args, table=table)
         handler_ms = (time.perf_counter() - t0) * 1e3
+        obs.note_verb("prioritize", handler_ms / 1e3,
+                      dec.trace_id if dec is not None else "")
         # HostPriorityList is a bare JSON array on the wire.
         return wire.encode_host_priorities(entries), handler_ms
 
@@ -660,6 +672,29 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(doc)
             elif path == "/debug/slo":
                 self._send_json(slo.snapshot())
+            elif path == "/debug/timeline":
+                if not obs.enabled():
+                    self._send_json(
+                        {"Error": "timeline recorder is disabled "
+                                  "(TPUSHARE_TIMELINE=off)"}, 404)
+                    return
+                query = self._query()
+                window: float | None = None
+                raw_window = query.get("window", "")
+                if raw_window:
+                    try:
+                        window = min(max(float(raw_window), 1.0), 3600.0)
+                    except ValueError:
+                        self._send_json(
+                            {"Error": "window must be numeric"}, 400)
+                        return
+                series = None
+                if query.get("series"):
+                    series = [s for s in query["series"].split(",") if s]
+                markers = query.get("markers", "1") not in ("0", "false")
+                self._send_json(obs.snapshot(window_s=window,
+                                             series=series,
+                                             markers=markers))
             elif path.startswith("/debug/journey/"):
                 rest = path[len("/debug/journey/"):]
                 ns, sep, pod_name = rest.partition("/")
@@ -784,11 +819,15 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"Error": "preempt not configured"}, 404)
                     return
                 pre_args = ExtenderPreemptionArgs.from_json(doc)
+                t0 = time.perf_counter()
                 with metrics.PREEMPT_LATENCY.time(), \
                         trace.phase("preempt", pre_args.pod.namespace,
                                     pre_args.pod.name, pre_args.pod.uid,
-                                    enabled=_traced_pod(pre_args.pod)):
+                                    enabled=_traced_pod(pre_args.pod)) \
+                        as dec:
                     result = self.server.preempt.handle(pre_args)
+                obs.note_verb("preempt", time.perf_counter() - t0,
+                              dec.trace_id if dec is not None else "")
                 self._send_json(result.to_json())
             elif path == f"{prefix}/validate":
                 doc = self._read_json()
@@ -852,6 +891,8 @@ class _Handler(BaseHTTPRequestHandler):
                                   args_parsed.pod_name,
                                   args_parsed.pod_uid, dec,
                                   open_new=False)
+                obs.note_verb("bind", handler_ms / 1e3,
+                              dec.trace_id if dec is not None else "")
                 # Reference returns HTTP 500 when bind fails
                 # (routes.go:139-143) so the scheduler retries.
                 self._send_json(result.to_json(),

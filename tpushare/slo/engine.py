@@ -35,6 +35,7 @@ import time
 from collections import deque
 from typing import Callable
 
+from tpushare import obs
 from tpushare.api.objects import Pod
 from tpushare.slo import config as slo_config
 from tpushare.trace.recorder import DropCounter
@@ -87,6 +88,10 @@ class SLOEngine:
                 del self._events[name]
         log.info("SLO config applied: %d objective(s): %s",
                  len(config.slos), sorted(config.slos))
+        obs.mark("config",
+                 f"SLO config applied: {len(config.slos)} objective(s)",
+                 configmap="slo",
+                 objectives=",".join(sorted(config.slos)))
 
     def set_client(self, client: object) -> None:
         """Arm Event emission (without a client the burn alert is gauge
@@ -234,6 +239,15 @@ class SLOEngine:
         # The JSON log line of the alert contract: grep-able whether or
         # not TPUSHARE_LOG_JSON is on.
         log.warning("SLO burn: %s", json.dumps(payload, sort_keys=True))
+        # Timeline marker (fire-and-forget): the burn joins the series
+        # on the fleet clock, and its cursor rides in the Event message
+        # so `kubectl describe` resolves to /debug/timeline state at
+        # the moment the budget tripped.
+        cursor = obs.mark(
+            "slo-burn",
+            f"SLO {spec.name} burning "
+            f"({row['errorBudgetRemaining'] * 100:.1f}% budget left)",
+            slo=spec.name, signal=spec.signal)
         if client is None or bad_pod is None:
             return
         try:
@@ -248,7 +262,8 @@ class SLOEngine:
                             for label, w in row["windows"].items())
                 + f" >= fast-burn {spec.fast_burn}x; error budget "
                   f"{row['errorBudgetRemaining'] * 100:.1f}% remaining "
-                  "(see /debug/slo and docs/slo.md runbook)",
+                  "(see /debug/slo and docs/slo.md runbook)"
+                + (f" [timeline {cursor}]" if cursor else ""),
                 event_type="Warning", trace_id="")
         except Exception:  # noqa: BLE001 - alerting must not throw
             self.drops.inc()
