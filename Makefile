@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-wire bench-topo bench-workload bench-router bench-diff all
+.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-wire bench-topo bench-autoscale bench-workload bench-router bench-diff all
 
 # CPU test suite (virtual 8-device mesh; kernels in interpreter mode).
 test:
@@ -71,6 +71,14 @@ bench-wire:
 bench-topo:
 	python bench.py --topology --gate
 
+# Demand-driven fleet autoscaling: the diurnal-wave scenario, gated
+# (autoscaled SLO compliance >= peak-static baseline on <= 70% of its
+# node-hours, zero tenant-guarantee evictions, slice-completing
+# scale-up at ring contiguity 1.0). Writes BENCH_AUTOSCALE.json
+# (docs/autoscale.md).
+bench-autoscale:
+	python bench.py --autoscale --gate
+
 # On-chip workload perf: flash-vs-XLA attention + flagship MFU, with
 # regression gates — REQUIRES real TPU hardware (chipcheck's perf twin).
 bench-workload:
@@ -87,7 +95,9 @@ bench-router:
 bench-diff:
 	python bench.py --scale --smoke > /tmp/tpushare-bench-scale.json
 	python bench.py --wire --smoke > /tmp/tpushare-bench-wire.json
+	python bench.py --autoscale --smoke > /tmp/tpushare-bench-autoscale.json
 	python tools/bench_diff.py BENCH_SCALE.json /tmp/tpushare-bench-scale.json
 	python tools/bench_diff.py BENCH_WIRE_r01.json /tmp/tpushare-bench-wire.json
+	python tools/bench_diff.py BENCH_AUTOSCALE.json /tmp/tpushare-bench-autoscale.json
 
 all: native test

@@ -299,6 +299,21 @@ def _make_handler(server: MiniApiServer):
                     store.record("Pod", "ADDED", doc)
                 self._json(doc, 201)
                 return
+            if path == "/api/v1/nodes":
+                # Node create: the autoscaler's provisioning actuator
+                # (a cloud provider would do this out of band; the
+                # simulated fleet does it over the same wire verb).
+                doc = self._body()
+                meta = doc.setdefault("metadata", {})
+                with store.lock:
+                    if meta.get("name") in store.nodes:
+                        self._status_error(409, "AlreadyExists")
+                        return
+                    meta["resourceVersion"] = store.bump()
+                    store.nodes[meta["name"]] = doc
+                    store.record("Node", "ADDED", doc)
+                self._json(doc, 201)
+                return
             m = _EVENTS_RE.match(path)
             if m:
                 with store.lock:
@@ -390,6 +405,17 @@ def _make_handler(server: MiniApiServer):
                         return
                     store.bump()
                     store.record("Pod", "DELETED", doc)
+                self._json({"kind": "Status", "status": "Success"})
+                return
+            m = _NODE_RE.match(self.path.split("?", 1)[0])
+            if m:
+                with store.lock:
+                    doc = store.nodes.pop(m.group(1), None)
+                    if doc is None:
+                        self._status_error(404, "NotFound")
+                        return
+                    store.bump()
+                    store.record("Node", "DELETED", doc)
                 self._json({"kind": "Status", "status": "Success"})
                 return
             self._status_error(404, "NotFound")

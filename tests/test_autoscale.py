@@ -1,0 +1,846 @@
+"""Fleet autoscaling tests: demand-driven scale-up held behind the
+defrag-first rule, topology-preferring node-template election,
+drain-aware scale-down (cordon → budgeted evictions → delete), and the
+safety rails (hysteresis, cooldown, SLO abort, guarantee protection).
+
+The acceptance stories (ISSUE 14), both over the miniapiserver wire:
+
+* a shape no node can admit → the autoscaler first refuses to
+  provision while a defrag plan could unblock it, then — once moves
+  cannot help — provisions a node and the pod binds on it;
+* a demand trough → the most strandable node is cordoned, drained
+  through the shared eviction machinery, and deleted, with zero tenant
+  guarantee cuts along the way.
+"""
+
+import json
+import time
+
+import pytest
+
+from tpushare import trace
+from tpushare.api.objects import Node, Pod
+from tpushare.autoscale import provision
+from tpushare.autoscale.executor import AutoscaleExecutor
+from tpushare.cache.cache import SchedulerCache
+from tpushare.k8s import events, eviction
+from tpushare.k8s.builders import make_node, make_pod
+from tpushare.k8s.fake import FakeApiServer
+from tpushare.routes import metrics
+from tpushare.utils import const
+
+
+def _bound(name, hbm, node, chips, uid=None, ns="default",
+           annotations=None, labels=None, hbm_chip=16):
+    """A bound, running HBM-slice pod with its full commit record."""
+    ann = {
+        const.ANN_CHIP_IDX: ",".join(str(c) for c in chips),
+        const.ANN_HBM_POD: str(hbm),
+        const.ANN_HBM_CHIP: str(hbm_chip),
+        const.ANN_ASSIGNED: const.ASSIGNED_TRUE,
+        const.ANN_ASSUME_TIME: "1",
+    }
+    ann.update(annotations or {})
+    return make_pod(name, hbm=hbm, namespace=ns, node_name=node,
+                    phase="Running", uid=uid or f"uid-{name}",
+                    annotations=ann, labels=labels)
+
+
+def _cache(api):
+    cache = SchedulerCache(api.get_node, api.list_pods)
+    for node in api.list_nodes():
+        cache.get_node_info(node.name)
+    cache.build()
+    return cache
+
+
+class _Demand:
+    """DemandTracker stand-in with injectable per-shape ages, so the
+    hysteresis clock is under test control."""
+
+    def __init__(self, ages=None):
+        self.ages = dict(ages or {})
+
+    def snapshot(self):
+        return {}
+
+    def oldest_age_by_shape(self):
+        return dict(self.ages)
+
+
+def _executor(api, cache, mode, clock=None, demand=None, **kw):
+    kw.setdefault("burning_fn", lambda: [])
+    if clock is not None:
+        kw.setdefault("now", lambda: clock[0])
+    ex = AutoscaleExecutor(cache, api, pod_lister=api.list_pods,
+                           mode=mode, **kw)
+    ex.up_delay_s = 0.0
+    ex.down_delay_s = 0.0
+    ex.cooldown_s = 0.0
+    if demand is not None:
+        ex.set_demand(demand)
+    return ex
+
+
+def _counter(counter, **labels):
+    child = counter.labels(**labels) if labels else counter
+    return child._value.get()
+
+
+@pytest.fixture
+def api():
+    return FakeApiServer()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace():
+    yield
+    trace.reset()
+
+
+# ------------------------------------------------------------------------ #
+# Node-template election (provision.py)
+# ------------------------------------------------------------------------ #
+
+
+class TestProvision:
+    def _slice_fleet(self, api, skip=3):
+        """7 of the 8 hosts of a v5p 4x4x2 slice (2x2x2 host grid);
+        worker ``skip`` is the hole."""
+        for i in range(8):
+            if i == skip:
+                continue
+            api.create_node(make_node(
+                f"h-{i:02d}", chips=4, hbm_per_chip=95,
+                topology="2x2x1", tpu_type="v5p", slice_id="pod-a",
+                slice_topology="4x4x2", worker_index=i))
+        return _cache(api)
+
+    def test_slice_hole_completion_is_preferred(self, api):
+        cache = self._slice_fleet(api, skip=3)
+        doc, elect = provision.elect_template(
+            cache.sharing_node_infos(), (0, 4),
+            frozenset(cache.node_table()))
+        assert elect["kind"] == "slice-completion"
+        assert elect["sliceId"] == "pod-a"
+        assert elect["workerIndex"] == 3
+        assert elect["holesRemaining"] == 0
+        # Every ICI neighbor of the hole exists: this is the one spot
+        # that turns the partial grid into a full contiguous block.
+        assert elect["occupiedNeighbors"] >= 3
+        node = Node(doc)
+        from tpushare.utils import node as nodeutils
+        pos = nodeutils.host_position(node)
+        assert pos is not None
+        coords, grid = pos
+        assert coords == grid.coords(3)
+        # The clone is homogeneous with its slice siblings.
+        assert nodeutils.get_chip_capacities(node) == [95] * 4
+        assert nodeutils.get_slice_id(node) == "pod-a"
+
+    def test_completed_grid_serves_contiguity_one(self, api):
+        """The acceptance clause: with the elected node added, the
+        slice placer hands out a worker-ordered ring at contiguity 1.0
+        (the hole was the only thing preventing a contiguous block)."""
+        from tpushare.topology import fleet as topo
+
+        cache = self._slice_fleet(api, skip=3)
+        doc, _ = provision.elect_template(
+            cache.sharing_node_infos(), (0, 4),
+            frozenset(cache.node_table()))
+        api.create_node(doc)
+        cache = _cache(api)
+        grids = topo.build_host_grids(cache.sharing_node_infos())
+        hg = grids["pod-a"]
+        assert len(hg.hosts) == hg.grid.chip_count  # grid complete
+        # The full grid in snake order is a perfectly contiguous ring
+        # — exactly what SlicePlacer elects once the hole is plugged.
+        stats = topo.ring_stats(topo.snake_order(hg.grid.dims), hg.grid)
+        assert stats["contiguity"] == 1.0, stats
+
+    def test_template_clone_when_no_grid(self, api):
+        api.create_node(make_node("small", chips=2, hbm_per_chip=16))
+        api.create_node(make_node("big", chips=4, hbm_per_chip=32))
+        cache = _cache(api)
+        doc, elect = provision.elect_template(
+            cache.sharing_node_infos(), (0, 4),
+            frozenset(cache.node_table()))
+        # "small" cannot admit 4 chips: the roomiest FITTING node wins.
+        assert elect == {"kind": "template", "clonedFrom": "big"}
+        from tpushare.utils import node as nodeutils
+        assert nodeutils.get_chip_capacities(Node(doc)) == [32] * 4
+        assert doc["metadata"]["name"] not in ("small", "big")
+
+    def test_generic_cold_start_on_empty_fleet(self, api):
+        doc, elect = provision.elect_template([], (24, 0), frozenset())
+        assert elect["kind"] == "generic"
+        from tpushare.utils import node as nodeutils
+        node = Node(doc)
+        caps = nodeutils.get_chip_capacities(node)
+        assert caps and max(caps) >= 24
+
+    def test_names_never_collide(self, api):
+        api.create_node(make_node("n0", chips=4))
+        cache = _cache(api)
+        existing = frozenset(cache.node_table()) | {"autoscale-1"}
+        doc, _ = provision.elect_template(
+            cache.sharing_node_infos(), (0, 4), existing)
+        assert doc["metadata"]["name"] not in existing
+
+
+# ------------------------------------------------------------------------ #
+# Cordon honored by the filter verb (satellite)
+# ------------------------------------------------------------------------ #
+
+
+class TestCordonFilter:
+    def test_cordoned_node_fails_filter_both_paths(self, api):
+        from tpushare.api.extender import ExtenderArgs
+        from tpushare.scheduler.predicate import Predicate
+
+        api.create_node(make_node("up", chips=4))
+        api.create_node(make_node("down", chips=4, unschedulable=True))
+        cache = _cache(api)
+        pred = Predicate(cache)
+        pod = Pod(make_pod("p", hbm=6, uid="u-p"))
+        # Slow path (per-node assume).
+        ok, why = pred.filter_node(pod, "down")
+        assert not ok and "cordoned" in why
+        assert pred.filter_node(pod, "up")[0]
+        # Hot path (summary-table loop).
+        result = pred.handle(ExtenderArgs.from_json({
+            "Pod": pod.raw, "NodeNames": ["up", "down"]}))
+        assert result.node_names == ["up"]
+        assert "cordoned" in result.failed_nodes["down"]
+
+    def test_cordon_flip_via_document_swap(self, api):
+        """The cached summary bit follows apply_node_document, so a
+        kubectl-cordon observed by the informer takes effect without a
+        cache rebuild."""
+        api.create_node(make_node("n0", chips=4))
+        cache = _cache(api)
+        info = cache.get_node_info("n0")
+        assert info.summary().unschedulable is False
+        info.apply_node_document(Node(make_node("n0", chips=4,
+                                                unschedulable=True)))
+        assert info.summary().unschedulable is True
+
+
+# ------------------------------------------------------------------------ #
+# Scale-up: defrag-first, hysteresis, provisioning
+# ------------------------------------------------------------------------ #
+
+
+def _fragmented(api):
+    """The defrag suite's canonical stranding: 3 nodes x 4 chips, one
+    splinter per n1/n2, two on n0 — a 4-chip pod fits nowhere, but ONE
+    move unblocks it."""
+    for n in ("n0", "n1", "n2"):
+        api.create_node(make_node(n))
+    api.create_pod(_bound("s0", 6, "n0", [0]))
+    api.create_pod(_bound("s1", 6, "n0", [1]))
+    api.create_pod(_bound("a0", 6, "n1", [0]))
+    api.create_pod(_bound("b0", 6, "n2", [0]))
+    return _cache(api)
+
+
+def _pinned(api):
+    """One node, every chip held by a checkpointing (immovable) pod:
+    no fit, no legal defrag plan — only provisioning can serve demand."""
+    api.create_node(make_node("n0"))
+    frozen = {const.ANN_CKPT_IN_FLIGHT: "true"}
+    for c in range(4):
+        api.create_pod(_bound(f"p{c}", 6, "n0", [c], annotations=frozen))
+    return _cache(api)
+
+
+class TestScaleUp:
+    def test_off_mode_and_follower_never_decide(self, api):
+        cache = _pinned(api)
+        demand = _Demand({(0, 4): 100.0})
+        assert _executor(api, cache, "off", demand=demand).tick() is None
+        ex = _executor(api, cache, "active", demand=demand,
+                       is_leader=lambda: False)
+        assert ex.tick() is None
+        assert len(api.list_nodes()) == 1
+
+    def test_young_demand_does_not_buy_a_node(self, api):
+        cache = _pinned(api)
+        ex = _executor(api, cache, "active",
+                       demand=_Demand({(0, 4): 5.0}))
+        ex.up_delay_s = 30.0
+        doc = ex.tick()  # demand exists but hasn't aged: no action
+        assert doc is None or doc["action"] != "scale-up"
+        assert len(api.list_nodes()) == 1
+        # The same demand past the delay buys the node.
+        ex.demand.ages[(0, 4)] = 31.0
+        doc = ex.tick()
+        assert doc["action"] == "scale-up"
+        assert len(api.list_nodes()) == 2
+
+    def test_fitting_shape_holds_capacity_exists(self, api):
+        api.create_node(make_node("n0"))
+        cache = _cache(api)
+        ex = _executor(api, cache, "active",
+                       demand=_Demand({(0, 4): 100.0}))
+        doc = ex.tick()
+        assert doc["action"] == "hold"
+        assert doc["reason"] == "capacity-exists"
+        assert len(api.list_nodes()) == 1
+
+    def test_defrag_plan_refuses_provisioning(self, api):
+        cache = _fragmented(api)
+        api.create_pod(make_pod("ring", chips=4, uid="u-ring"))
+        ex = _executor(api, cache, "active",
+                       demand=_Demand({(0, 4): 100.0}))
+        doc = ex.tick()
+        assert doc["action"] == "hold"
+        assert doc["reason"] == "defrag-first"
+        assert "unblocks" in doc["detail"]
+        assert len(api.list_nodes()) == 3
+
+    def test_unserveable_demand_provisions(self, api):
+        cache = _pinned(api)
+        up_before = _counter(metrics.AUTOSCALE_ACTIONS, action="up")
+        ex = _executor(api, cache, "active",
+                       demand=_Demand({(0, 4): 100.0}))
+        doc = ex.tick()
+        assert doc["action"] == "scale-up"
+        assert doc["election"]["kind"] == "template"  # clone of n0
+        assert api.get_node(doc["node"]) is not None
+        assert _counter(metrics.AUTOSCALE_ACTIONS,
+                        action="up") == up_before + 1
+        assert doc["demand"]["tracker"] == {"0GiBx4c": 100.0}
+
+    def test_dry_run_provably_creates_nothing(self, api):
+        cache = _pinned(api)
+        ex = _executor(api, cache, "dry-run",
+                       demand=_Demand({(0, 4): 100.0}))
+        doc = ex.tick()
+        assert doc["action"] == "scale-up" and doc["dryRun"]
+        assert len(api.list_nodes()) == 1
+        assert ex.status()["lastDecision"]["action"] == "scale-up"
+
+    def test_cooldown_spaces_consecutive_actions(self, api):
+        clock = [0.0]
+        cache = _pinned(api)
+        ex = _executor(api, cache, "active", clock=clock,
+                       demand=_Demand({(0, 4): 100.0}))
+        ex.cooldown_s = 120.0
+        assert ex.tick()["action"] == "scale-up"
+        cache.get_node_info(api.list_nodes()[-1].name)  # observe it
+        clock[0] = 30.0  # inside the cooldown window
+        doc = ex.tick()
+        assert doc["action"] == "hold" and doc["reason"] == "cooldown"
+        clock[0] = 121.0
+        doc = ex.tick()
+        assert doc["action"] != "hold" or doc["reason"] != "cooldown"
+
+    def test_max_nodes_is_a_hard_ceiling(self, api):
+        cache = _pinned(api)
+        ex = _executor(api, cache, "active",
+                       demand=_Demand({(0, 4): 100.0}))
+        ex.max_nodes = 1
+        doc = ex.tick()
+        assert doc["action"] == "hold" and doc["reason"] == "max-nodes"
+        assert len(api.list_nodes()) == 1
+
+    def test_router_want_is_a_demand_source(self, api):
+        api.create_node(make_node("n0"))
+        frozen = {const.ANN_CKPT_IN_FLIGHT: "true"}
+        for c in range(4):
+            api.create_pod(_bound(f"p{c}", 16, "n0", [c],
+                                  annotations=frozen, hbm_chip=16))
+        cache = _cache(api)
+
+        class _Router:
+            def snapshot(self):
+                return {"scaleOut": {"wanted": True,
+                                     "spec": {"hbmGiB": 24,
+                                              "reason": "cold-start"}}}
+
+        ex = _executor(api, cache, "active", demand=_Demand())
+        ex.set_router(_Router())
+        doc = ex.tick()
+        assert doc["action"] == "scale-up"
+        assert doc["shape"] == {"hbmGiB": 24, "chips": 0}
+        assert doc["demand"]["router"]["spec"]["reason"] == "cold-start"
+        # 24 GiB doesn't fit a 16-GiB/chip clone: the template is
+        # generic, sized to the request.
+        assert doc["election"]["kind"] == "generic"
+
+
+# ------------------------------------------------------------------------ #
+# Scale-down: election, drain, budgets, aborts
+# ------------------------------------------------------------------------ #
+
+
+class TestScaleDown:
+    def test_trough_elects_empty_node_first(self, api):
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        api.create_pod(_bound("a0", 6, "n0", [0]))
+        cache = _cache(api)
+        deleted_before = _counter(metrics.AUTOSCALE_ACTIONS,
+                                  action="deleted")
+        ex = _executor(api, cache, "active", demand=_Demand())
+        doc = ex.tick()
+        # n1 is empty: zero-disruption drain, immediate delete.
+        assert doc["action"] == "scale-down"
+        assert doc["node"] == "n1"
+        assert doc["phase"] == "delete"
+        assert api.get_node("n1") is None
+        assert api.get_node("n0") is not None
+        assert _counter(metrics.AUTOSCALE_ACTIONS,
+                        action="deleted") == deleted_before + 1
+
+    def test_recent_demand_blocks_scale_down(self, api):
+        clock = [1000.0]
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        cache = _cache(api)
+        demand = _Demand({(0, 4): 100.0})
+        ex = _executor(api, cache, "active", clock=clock, demand=demand)
+        ex.down_delay_s = 300.0
+        ex.max_nodes = 2  # the aged demand must not scale UP here
+        assert ex.tick()["reason"] == "max-nodes"  # demand seen, held
+        demand.ages.clear()
+        clock[0] += 100.0  # quiet, but not down_delay-quiet
+        assert ex.tick() is None
+        assert api.get_node("n1") is not None
+        clock[0] += 300.0  # trough proven
+        doc = ex.tick()
+        assert doc["action"] == "scale-down"
+
+    def test_min_nodes_floor_is_hard(self, api):
+        api.create_node(make_node("n0"))
+        cache = _cache(api)
+        ex = _executor(api, cache, "active", demand=_Demand())
+        ex.min_nodes = 1
+        assert ex.tick() is None
+        assert api.get_node("n0") is not None
+
+    def test_dry_run_cordons_nothing(self, api):
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        cache = _cache(api)
+        ex = _executor(api, cache, "dry-run", demand=_Demand())
+        doc = ex.tick()
+        assert doc["action"] == "scale-down" and doc["dryRun"]
+        assert api.get_node("n1").unschedulable is False
+        assert ex.status()["draining"] is None
+
+    def test_guarantee_protected_node_is_never_drained(self, api):
+        """Zero tenant-guarantee cuts: a node whose resident sits
+        inside its tenant's guarantee is not even a candidate."""
+        from tpushare.api.objects import ConfigMap
+        from tpushare.quota import config as quota_config
+        from tpushare.quota.manager import QuotaManager
+
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        api.create_pod(_bound("g0", 6, "n0", [0], ns="team-a"))
+        api.create_pod(_bound("b0", 6, "n1", [0]))
+        cache = _cache(api)
+        quota = QuotaManager()
+        quota.set_config(quota_config.parse_configmap(ConfigMap({
+            "metadata": {"name": const.QUOTA_CONFIGMAP,
+                         "namespace": "kube-system"},
+            "data": {"team-a": json.dumps({"guaranteeHBM": 24})}})))
+        for pod in api.list_pods():
+            quota.charge(pod)
+        ex = _executor(api, cache, "active", demand=_Demand(),
+                       quota=quota)
+        doc = ex.tick()
+        # Both nodes hold one pod; only n1's (borrowed) is movable.
+        assert doc["action"] == "scale-down" and doc["node"] == "n1"
+        assert api.get_pod("team-a", "g0") is not None
+
+    def test_resident_with_no_room_elsewhere_blocks_drain(self, api):
+        api.create_node(make_node("n0"))
+        api.create_pod(_bound("a0", 6, "n0", [0]))
+        api.create_node(make_node("tiny", chips=1, hbm_per_chip=4))
+        cache = _cache(api)
+        ex = _executor(api, cache, "active", demand=_Demand())
+        # tiny (empty) drains fine; n0's resident has nowhere to go
+        # (tiny's 4-GiB chip cannot host 6 GiB), so after tiny is gone
+        # the fleet stays at n0 forever.
+        doc = ex.tick()
+        assert doc["node"] == "tiny"
+        cache.remove_node("tiny")
+        assert ex.tick() is None
+        assert api.get_node("n0") is not None
+
+    def test_drain_evicts_then_deletes(self, api):
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        api.create_pod(_bound("a0", 6, "n0", [0]))
+        api.create_pod(_bound("a1", 6, "n1", [0]))
+        api.create_pod(_bound("a2", 6, "n1", [1]))
+        cache = _cache(api)
+        evicted_before = _counter(metrics.AUTOSCALE_ACTIONS,
+                                  action="evicted")
+        ex = _executor(api, cache, "active", demand=_Demand())
+        doc = ex.tick()
+        # n0 moves one body, n1 two: n0 is the cheaper drain.
+        assert doc["node"] == "n0"
+        assert doc["phase"] == "drain"
+        assert doc["evictions"] == [{"pod": "default/a0",
+                                     "status": "evicted"}]
+        from tpushare.k8s.errors import NotFoundError
+        with pytest.raises(NotFoundError):
+            api.get_pod("default", "a0")
+        assert api.get_node("n0").unschedulable is True
+        assert _counter(metrics.AUTOSCALE_ACTIONS,
+                        action="evicted") == evicted_before + 1
+        # The informer (played here by hand) syncs the eviction into
+        # the ledger; the next tick finds the node empty and deletes.
+        cache.remove_pod(cache.get_pod("uid-a0"))
+        doc = ex.tick()
+        assert doc["phase"] == "delete"
+        assert api.get_node("n0") is None
+        assert ex.status()["draining"] is None
+
+    def test_slo_burn_aborts_and_uncordons(self, api):
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        api.create_pod(_bound("a0", 6, "n0", [0]))
+        api.create_pod(_bound("a1", 6, "n1", [0]))
+        api.create_pod(_bound("a2", 6, "n1", [1]))
+        cache = _cache(api)
+        aborted_before = _counter(metrics.AUTOSCALE_ABORTED,
+                                  reason="slo-burn")
+        ex = _executor(api, cache, "active", demand=_Demand(),
+                       burning_fn=lambda: ["pod-bind-30s"])
+        doc = ex.tick()
+        assert doc["action"] == "scale-down"
+        assert doc["phase"] == "abort" and doc["reason"] == "slo-burn"
+        # The node went cordon → uncordon and NOTHING was evicted.
+        assert api.get_node("n0").unschedulable is False
+        assert api.get_pod("default", "a0") is not None
+        assert ex.status()["draining"] is None
+        assert _counter(metrics.AUTOSCALE_ABORTED,
+                        reason="slo-burn") == aborted_before + 1
+        assert events.flush()
+        reasons = [e["reason"] for _, e in api.events]
+        assert events.REASON_AUTOSCALE_ABORTED in reasons
+
+    def test_budget_denial_pauses_not_aborts(self, api):
+        """An exhausted eviction budget PAUSES the drain: the cordon
+        holds (no re-admit/re-evict flapping), and the drain resumes
+        when the budget refills."""
+        clock = [0.0]
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        api.create_pod(_bound("a0", 6, "n0", [0]))
+        api.create_pod(_bound("a1", 6, "n0", [1]))
+        api.create_pod(_bound("b0", 6, "n1", [0]))
+        api.create_pod(_bound("b1", 6, "n1", [1]))
+        api.create_pod(_bound("b2", 6, "n1", [2]))
+        cache = _cache(api)
+        budget = eviction.EvictionBudget(per_hour=1,
+                                         now=lambda: clock[0])
+        ex = _executor(api, cache, "active", clock=clock,
+                       demand=_Demand(), budget=budget)
+        doc = ex.tick()
+        assert doc["node"] == "n0" and doc["phase"] == "drain"
+        statuses = {e["pod"]: e["status"] for e in doc["evictions"]}
+        assert statuses["default/a0"] == "evicted"
+        assert statuses["default/a1"] == "paused"
+        assert "paused" in doc["detail"]
+        # Still cordoned, still remembered as draining.
+        assert api.get_node("n0").unschedulable is True
+        assert ex.status()["draining"]["node"] == "n0"
+        # An hour later the budget refills and the drain finishes.
+        cache.remove_pod(cache.get_pod("uid-a0"))
+        clock[0] += 3601.0
+        doc = ex.tick()
+        statuses = {e["pod"]: e["status"] for e in doc["evictions"]}
+        assert statuses["default/a1"] == "evicted"
+        cache.remove_pod(cache.get_pod("uid-a1"))
+        assert ex.tick()["phase"] == "delete"
+        assert api.get_node("n0") is None
+
+    def test_mid_drain_checkpoint_defers_not_aborts(self, api):
+        for n in ("n0", "n1"):
+            api.create_node(make_node(n))
+        api.create_pod(_bound("a0", 6, "n0", [0]))
+        api.create_pod(_bound("b0", 6, "n1", [0]))
+        api.create_pod(_bound("b1", 6, "n1", [1]))
+        cache = _cache(api)
+        ex = _executor(api, cache, "active", demand=_Demand())
+        # The resident starts checkpointing BETWEEN election and
+        # eviction: n0 was drainable at election time...
+        real_movable = ex.planner.movable
+
+        def checkpointing_after_election(pod):
+            if pod.name == "a0" and api.get_node("n0").unschedulable:
+                return False, "checkpoint in flight"
+            return real_movable(pod)
+
+        ex.planner.movable = checkpointing_after_election
+        doc = ex.tick()
+        assert doc["node"] == "n0"
+        assert doc["evictions"][0]["status"] == "deferred"
+        # ...and the drain WAITS (cordon holds) rather than aborting.
+        assert api.get_node("n0").unschedulable is True
+        assert api.get_pod("default", "a0") is not None
+        assert ex.status()["draining"]["node"] == "n0"
+
+
+# ------------------------------------------------------------------------ #
+# Surfaces: gauges, status doc, /debug/autoscale
+# ------------------------------------------------------------------------ #
+
+
+class TestSurfaces:
+    def test_cluster_gauges_rebuilt_by_scrape(self, api):
+        from tpushare.scheduler.predicate import DemandTracker
+
+        api.create_node(make_node("n0"))
+        api.create_node(make_node("n1", unschedulable=True))
+        cache = _cache(api)
+        ex = _executor(api, cache, "dry-run", demand=_Demand())
+        tracker = DemandTracker()
+        tracker.record_unplaceable(Pod(make_pod("w", chips=4,
+                                                uid="u-w")))
+        text = metrics.scrape(cache, demand=tracker,
+                              autoscale=ex).decode()
+        assert "tpushare_cluster_capacity_hbm_gib 128.0" in text
+        assert 'tpushare_cluster_nodes{state="ready"} 1.0' in text
+        assert 'tpushare_cluster_nodes{state="cordoned"} 1.0' in text
+        assert ('tpushare_unschedulable_demand_oldest_age_seconds'
+                '{shape="0GiBx4c"}') in text
+
+    def test_status_doc_shape(self, api):
+        api.create_node(make_node("n0"))
+        cache = _cache(api)
+        ex = _executor(api, cache, "dry-run", demand=_Demand())
+        ex.tick()
+        doc = ex.status()
+        assert doc["mode"] == "dry-run"
+        assert doc["ticks"] == 1
+        assert doc["fleet"] == {"nodes": 1, "ready": 1, "cordoned": 0,
+                                "capacityHbmGiB": 64}
+        assert doc["bounds"]["maxNodes"] >= doc["bounds"]["minNodes"]
+        assert "perHour" in doc["budget"]
+
+    def test_debug_autoscale_route(self, api):
+        import urllib.request
+
+        from tpushare.routes.server import (ExtenderHTTPServer,
+                                            serve_forever)
+        from tpushare.scheduler.inspect import Inspect
+        from tpushare.scheduler.predicate import Predicate
+
+        api.create_node(make_node("n0"))
+        cache = _cache(api)
+        ex = _executor(api, cache, "dry-run", demand=_Demand())
+        server = ExtenderHTTPServer(
+            ("127.0.0.1", 0), Predicate(cache), None,
+            Inspect(cache), autoscale=ex)
+        serve_forever(server)
+        try:
+            host, port = server.server_address[:2]
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/autoscale") as resp:
+                doc = json.loads(resp.read())
+            assert doc["mode"] == "dry-run"
+            assert doc["fleet"]["nodes"] == 1
+        finally:
+            server.shutdown()
+
+    def test_route_404s_when_unwired(self, api):
+        import urllib.error
+        import urllib.request
+
+        from tpushare.routes.server import (ExtenderHTTPServer,
+                                            serve_forever)
+        from tpushare.scheduler.inspect import Inspect
+        from tpushare.scheduler.predicate import Predicate
+
+        cache = _cache(api)
+        server = ExtenderHTTPServer(("127.0.0.1", 0), Predicate(cache),
+                                    None, Inspect(cache))
+        serve_forever(server)
+        try:
+            host, port = server.server_address[:2]
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/debug/autoscale")
+            assert err.value.code == 404
+        finally:
+            server.shutdown()
+
+
+# ------------------------------------------------------------------------ #
+# The e2e acceptance stories, over the real wire (miniapiserver)
+# ------------------------------------------------------------------------ #
+
+
+def _wire_stack(server):
+    from tpushare.cmd.main import serve_stack
+    from tpushare.k8s.client import ApiClient, ClusterConfig
+
+    client = ApiClient(ClusterConfig(
+        host=f"http://127.0.0.1:{server.port}"))
+    stack, http_server = serve_stack(client)
+    ex = stack.controller.autoscale
+    ex.mode = "active"
+    ex.up_delay_s = 0.0
+    ex.down_delay_s = 0.0
+    ex.cooldown_s = 0.0
+    ex._burning_fn = lambda: []
+    return client, stack, http_server
+
+
+class TestAcceptanceStories:
+    def test_scale_up_defrag_first_then_provision_then_bind(self):
+        import http.client
+
+        from tests.miniapiserver import MiniApiServer
+        from tpushare.cmd.main import shutdown_stack
+
+        server = MiniApiServer().start()
+        stack = http_server = None
+        try:
+            for n in ("n0", "n1", "n2"):
+                server.seed_node(make_node(n))
+            server.seed_pod(_bound("s0", 6, "n0", [0]))
+            server.seed_pod(_bound("s1", 6, "n0", [1]))
+            server.seed_pod(_bound("a0", 6, "n1", [0]))
+            server.seed_pod(_bound("b0", 6, "n2", [0]))
+            client, stack, http_server = _wire_stack(server)
+            ex = stack.controller.autoscale
+            host, port = http_server.server_address[:2]
+            conn = http.client.HTTPConnection(host, port)
+
+            def post(path, doc):
+                conn.request("POST", path, json.dumps(doc).encode(),
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                return resp.status, json.loads(resp.read())
+
+            # 1. The 4-chip pod fits nowhere; the failed filter feeds
+            #    the DemandTracker the autoscaler reads.
+            ring = client.create_pod(make_pod("ring", chips=4))
+            names = ["n0", "n1", "n2"]
+            _, result = post("/tpushare-scheduler/filter",
+                             {"Pod": ring.raw, "NodeNames": names})
+            assert result["NodeNames"] == []
+
+            # 2. Defrag-first refusal: one move can unblock the pod,
+            #    so the autoscaler refuses to buy a node.
+            doc = ex.tick()
+            assert doc["action"] == "hold"
+            assert doc["reason"] == "defrag-first"
+            assert len(client.list_nodes()) == 3
+
+            # 3. Every resident starts a checkpoint: moves are now
+            #    illegal, so only provisioning can serve the demand.
+            for pname in ("s0", "s1", "a0", "b0"):
+                pod = client.get_pod("default", pname)
+                raw = dict(pod.raw)
+                raw["metadata"]["annotations"][
+                    const.ANN_CKPT_IN_FLIGHT] = "true"
+                client.update_pod(Pod(raw))
+            cache = stack.controller.cache
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                stack.controller.wait_idle(timeout=10)
+                if all(const.ANN_CKPT_IN_FLIGHT
+                       in (cache.get_pod(f"uid-{p}") or Pod({}))
+                       .annotations
+                       for p in ("s0", "s1", "a0", "b0")):
+                    break
+                time.sleep(0.05)
+            doc = ex.tick()
+            assert doc["action"] == "scale-up", doc
+            new_name = doc["node"]
+            assert client.get_node(new_name) is not None
+
+            # 4. The pending pod passes the filter on the new node
+            #    (fetched on demand — no rebuild needed) and binds.
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                _, result = post("/tpushare-scheduler/filter",
+                                 {"Pod": ring.raw,
+                                  "NodeNames": names + [new_name]})
+                if result["NodeNames"] == [new_name]:
+                    break
+                time.sleep(0.05)
+            assert result["NodeNames"] == [new_name], result
+            status, bound = post("/tpushare-scheduler/bind", {
+                "PodName": "ring", "PodNamespace": "default",
+                "PodUID": ring.uid, "Node": new_name})
+            assert status == 200, bound
+            assert stack.controller.wait_idle(timeout=10)
+            assert client.get_pod("default",
+                                  "ring").node_name == new_name
+
+            # 5. The story is on the timeline and in /debug/autoscale.
+            assert ex.status()["lastDecision"]["action"] == "scale-up"
+            conn.close()
+        finally:
+            if stack is not None:
+                shutdown_stack(stack, http_server)
+            server.close()
+
+    def test_scale_down_drains_without_guarantee_cuts(self):
+        from tests.miniapiserver import MiniApiServer
+        from tpushare.api.objects import ConfigMap
+        from tpushare.cmd.main import shutdown_stack
+        from tpushare.quota import config as quota_config
+
+        server = MiniApiServer().start()
+        stack = http_server = None
+        try:
+            for n in ("n0", "n1"):
+                server.seed_node(make_node(n))
+            # n0: one borrowed (movable) pod. n1: a pod inside team-a's
+            # guarantee — untouchable, pinning its node.
+            server.seed_pod(_bound("a0", 6, "n0", [0]))
+            server.seed_pod(_bound("g0", 6, "n1", [0], ns="team-a"))
+            client, stack, http_server = _wire_stack(server)
+            ex = stack.controller.autoscale
+            stack.controller.quota.set_config(
+                quota_config.parse_configmap(ConfigMap({
+                    "metadata": {"name": const.QUOTA_CONFIGMAP,
+                                 "namespace": "kube-system"},
+                    "data": {"team-a": json.dumps(
+                        {"guaranteeHBM": 24})}})))
+            for pod in client.list_pods():
+                stack.controller.quota.charge(pod)
+
+            # Trough: no demand was ever seen → cordon + drain n0.
+            doc = ex.tick()
+            assert doc["action"] == "scale-down"
+            assert doc["node"] == "n0", doc
+            assert doc["evictions"] == [{"pod": "default/a0",
+                                         "status": "evicted"}]
+            # When the informer digests the eviction before the tick
+            # re-reads the ledger, the SAME tick finishes the drain
+            # (phase "delete"); otherwise the node sits cordoned and a
+            # follow-up tick deletes it. Both are correct drains.
+            if doc["phase"] != "delete":
+                assert client.get_node("n0").unschedulable is True
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    stack.controller.wait_idle(timeout=10)
+                    if not ex._residents("n0"):
+                        break
+                    time.sleep(0.05)
+                assert not ex._residents("n0")
+                doc = ex.tick()
+                assert doc["phase"] == "delete", doc
+            assert client.get_node("n0") is None
+
+            # Zero guarantee cuts: team-a's pod never moved, and its
+            # node is still there (min_nodes floor + immovable pin).
+            assert client.get_pod("team-a", "g0").node_name == "n1"
+            assert client.get_node("n1") is not None
+            assert ex.tick() is None  # floor: never drain the last node
+        finally:
+            if stack is not None:
+                shutdown_stack(stack, http_server)
+            server.close()

@@ -17,6 +17,7 @@ OBSERVABILITY_MD = os.path.join(REPO_ROOT, "docs", "observability.md")
 QUOTA_MD = os.path.join(REPO_ROOT, "docs", "quota.md")
 SLO_MD = os.path.join(REPO_ROOT, "docs", "slo.md")
 DEFRAG_MD = os.path.join(REPO_ROOT, "docs", "defrag.md")
+AUTOSCALE_MD = os.path.join(REPO_ROOT, "docs", "autoscale.md")
 VET_MD = os.path.join(REPO_ROOT, "docs", "vet.md")
 PERF_MD = os.path.join(REPO_ROOT, "docs", "perf.md")
 
@@ -155,6 +156,54 @@ def test_defrag_doc_covers_the_contract():
     missing = [n for n in defrag_metrics if n not in doc]
     assert not missing, (
         f"defrag metrics absent from docs/defrag.md: {missing}")
+
+
+def test_autoscale_doc_covers_the_contract():
+    """docs/autoscale.md is the fleet-sizing contract: it must keep
+    naming the mode env (all three postures), both demand sources, the
+    defrag-first rule with its hold reasons, the topology preference
+    order, every drain rule (cordon, budgets, pause-vs-abort, the
+    guarantee veto), the hysteresis knobs, the surfaces, and a
+    runbook."""
+    with open(AUTOSCALE_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("TPUSHARE_AUTOSCALE", "off", "dry-run", "active",
+                   "DemandTracker", "oldest_age_by_shape",
+                   "scaleout_spec", "defrag-first", "capacity-exists",
+                   "slice-completion", "contiguity 1.0",
+                   "occupied ICI neighbors", "trandab",
+                   "spec.unschedulable", "kubectl cordon",
+                   "EvictionBudget", "pauses", "uncordoned",
+                   "tpushare.io/checkpoint-in-flight",
+                   "quota guarantee", "zero guarantee cuts",
+                   "TPUSHARE_AUTOSCALE_UP_DELAY_S",
+                   "TPUSHARE_AUTOSCALE_DOWN_DELAY_S",
+                   "TPUSHARE_AUTOSCALE_COOLDOWN_S",
+                   "TPUSHARE_AUTOSCALE_MIN_NODES",
+                   "TPUSHARE_AUTOSCALE_MAX_NODES",
+                   "TPUShareAutoscaleAborted", "slo-burn",
+                   "/debug/autoscale",
+                   "kubectl inspect tpushare autoscale",
+                   "bench.py --autoscale", "make bench-autoscale",
+                   "BENCH_AUTOSCALE.json", "node-hours", "Runbook"):
+        assert needle in doc, needle
+    autoscale_metrics = [n for n in registered_metric_names()
+                         if "autoscale" in n or "cluster_nodes" in n
+                         or "cluster_capacity" in n or "oldest_age" in n]
+    assert len(autoscale_metrics) >= 5
+    missing = [n for n in autoscale_metrics if n not in doc]
+    assert not missing, (
+        f"autoscale metrics absent from docs/autoscale.md: {missing}")
+
+
+def test_autoscale_doc_is_linked():
+    """observability.md (the catalogue), the README, and the user
+    guide must keep pointing at the fleet-sizing contract."""
+    for path in (OBSERVABILITY_MD,
+                 os.path.join(REPO_ROOT, "README.md"),
+                 os.path.join(REPO_ROOT, "docs", "userguide.md")):
+        with open(path, encoding="utf-8") as f:
+            assert "autoscale.md" in f.read(), path
 
 
 def test_defrag_doc_is_linked():
@@ -325,6 +374,8 @@ if __name__ == "__main__":
                   test_slo_doc_is_linked,
                   test_defrag_doc_covers_the_contract,
                   test_defrag_doc_is_linked,
+                  test_autoscale_doc_covers_the_contract,
+                  test_autoscale_doc_is_linked,
                   test_perf_doc_covers_the_contract,
                   test_perf_doc_is_linked,
                   test_vet_doc_covers_the_flow_layer,

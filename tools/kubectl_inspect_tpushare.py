@@ -10,7 +10,10 @@ from ``/debug/quota`` (docs/quota.md); the ``slo`` subcommand renders
 the error-budget / burn-rate table from ``/debug/slo`` (docs/slo.md);
 the ``defrag`` subcommand renders the fragmentation index and the last
 rebalance plan (proposed vs executed vs aborted moves, with trace-ids)
-from ``/debug/defrag`` (docs/defrag.md); the ``hotspots`` subcommand
+from ``/debug/defrag`` (docs/defrag.md); the ``autoscale`` subcommand
+renders the fleet autoscaler's posture, fleet counts, the drain in
+flight, and the last scale decision with its demand detail from
+``/debug/autoscale`` (docs/autoscale.md); the ``hotspots`` subcommand
 renders the continuous profiler's per-verb top frames and exact
 wall/CPU/lock-wait/apiserver cost splits from ``/debug/hotspots``
 (docs/perf.md); the ``serving`` subcommand renders the decode fleet's
@@ -748,6 +751,104 @@ def render_defrag(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def fetch_autoscale(endpoint: str) -> dict | None:
+    """The fleet autoscaler's snapshot from ``/debug/autoscale``; None
+    when the extender runs without the autoscaler wired or with debug
+    routes disabled."""
+    try:
+        with urllib.request.urlopen(f"{endpoint}/debug/autoscale",
+                                    timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def render_autoscale(doc: dict) -> str:
+    """Posture + bounds/hysteresis + fleet counts + the drain in
+    flight + the last decision with its demand detail."""
+    bounds = doc.get("bounds") or {}
+    hyst = doc.get("hysteresis") or {}
+    fleet = doc.get("fleet") or {}
+    lines = [
+        f"autoscale mode: {doc.get('mode', '?')} "
+        f"(tick every {doc.get('intervalSeconds', '?')}s, fleet bounds "
+        f"{bounds.get('minNodes', '?')}..{bounds.get('maxNodes', '?')} "
+        "node(s))",
+        f"hysteresis: demand ages {hyst.get('upDelaySeconds', '?')}s "
+        f"before a node, {hyst.get('downDelaySeconds', '?')}s of quiet "
+        f"before a drain, {hyst.get('cooldownSeconds', '?')}s between "
+        "actions",
+        f"fleet: {fleet.get('nodes', 0)} node(s) — "
+        f"{fleet.get('ready', 0)} ready, {fleet.get('cordoned', 0)} "
+        f"cordoned, {fleet.get('capacityHbmGiB', 0)} GiB HBM capacity",
+    ]
+    shapes = doc.get("recentShapes") or []
+    if shapes:
+        wants = ", ".join(
+            (f"{chips} chip(s)" if chips else f"{hbm} GiB")
+            for hbm, chips in shapes)
+        lines.append(f"recent demand shapes: {wants}")
+    draining = doc.get("draining")
+    if draining:
+        lines.append(
+            f"draining: {draining.get('node')} — "
+            f"{draining.get('residents', 0)} resident pod(s) left, "
+            f"{draining.get('forSeconds', 0)}s under cordon")
+    decision = doc.get("lastDecision")
+    lines.append("")
+    if not decision:
+        lines.append("last decision: none (no tick has run yet)")
+    else:
+        action = decision.get("action", "?")
+        if action == "hold":
+            lines.append(f"last decision: hold "
+                         f"({decision.get('reason', '?')}) — "
+                         f"{decision.get('detail', '')}")
+        elif action == "scale-up":
+            elect = decision.get("election") or {}
+            shape = decision.get("shape") or {}
+            lines.append(
+                f"last decision: scale-up {decision.get('node')} "
+                f"({elect.get('kind', '?')} template) for "
+                f"{shape.get('hbmGiB', 0)} GiB x "
+                f"{shape.get('chips', 0)} chip(s)"
+                + (" [dry-run]" if decision.get("dryRun") else ""))
+        else:
+            lines.append(
+                f"last decision: {action} {decision.get('node')} "
+                f"[{decision.get('phase', '?')}]"
+                + (f" ({decision.get('reason')}: {decision.get('detail')})"
+                   if decision.get("reason") else "")
+                + (" [dry-run]" if decision.get("dryRun") else ""))
+            for ev in decision.get("evictions") or []:
+                extra = f" ({ev['detail']})" if ev.get("detail") else ""
+                lines.append(f"  {ev['pod']}: {ev['status']}{extra}")
+        demand = (decision.get("demand") or {})
+        tracker = demand.get("tracker") or {}
+        if tracker:
+            lines.append("  demand: " + ", ".join(
+                f"{shape} aged {age}s"
+                for shape, age in sorted(tracker.items())))
+        if demand.get("router"):
+            lines.append("  router scale-out want: "
+                         f"{demand['router'].get('spec')}")
+    budget = doc.get("budget") or {}
+    lines.append(
+        f"budgets (shared with defrag): {budget.get('usedLastHour', 0)}/"
+        f"{budget.get('perHour', 0) or '∞'} evictions this hour, "
+        f"{budget.get('inFlight', 0)}/"
+        f"{budget.get('maxConcurrent', 0) or '∞'} in flight, "
+        f"node cooldown {budget.get('nodeCooldownSeconds', 0)}s")
+    lines.append("")
+    lines.append("Decisions are proposals in dry-run mode and real "
+                 "provisions/drains in active mode (TPUSHARE_AUTOSCALE). "
+                 "A hold names the cheaper fix (capacity-exists / "
+                 "defrag-first). Runbook: docs/autoscale.md.")
+    return "\n".join(lines)
+
+
 def fetch_router(endpoint: str) -> dict | None:
     """The serving front door's snapshot from ``/debug/router``; None
     when the extender runs without a router wired or with debug routes
@@ -982,6 +1083,9 @@ def main(argv: list[str] | None = None) -> int:
                              "/ burn-rate table; or the literal "
                              "'defrag' for the fragmentation index and "
                              "the last rebalance plan; or the literal "
+                             "'autoscale' for the fleet autoscaler's "
+                             "posture, drain in flight, and last scale "
+                             "decision; or the literal "
                              "'hotspots' for the continuous profiler's "
                              "per-verb top frames + cost splits; or the "
                              "literal 'serving' for the decode fleet's "
@@ -1084,6 +1188,24 @@ def main(argv: list[str] | None = None) -> int:
                   "(DEBUG_ROUTES=0)", file=sys.stderr)
             return 1
         print(render_defrag(doc))
+        return 0
+    if args.node == "autoscale":
+        if args.pod:
+            print(f"unexpected argument {args.pod!r} after 'autoscale'",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = fetch_autoscale(args.endpoint)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        if doc is None:
+            print("autoscale view unavailable — the extender runs "
+                  "without the fleet autoscaler, or debug routes are "
+                  "disabled (DEBUG_ROUTES=0)", file=sys.stderr)
+            return 1
+        print(render_autoscale(doc))
         return 0
     if args.node == "serving":
         if args.pod:

@@ -34,6 +34,16 @@ Scenario schema (YAML or JSON)::
                                  # unschedulable: dry-run reports the
                                  # move plan; active executes it and
                                  # re-binds the migrants (optional)
+    autoscale: dry-run           # after the replay (and any defrag
+                                 # round), run the extender's fleet
+                                 # autoscaler: scale-up provisions for
+                                 # the surviving unplaceable demand
+                                 # (defrag-first rule intact) and the
+                                 # pods re-bind on the new capacity;
+                                 # scale-down cordons + drains provably
+                                 # idle nodes; dry-run reports the
+                                 # decisions without changing the
+                                 # fleet (optional, docs/autoscale.md)
     profile: on                  # arm the continuous profiler for the
                                  # replay; the report gains a hotspots
                                  # section (per-verb top frames + the
@@ -167,6 +177,26 @@ workload:
     name: shard
     hbm: 6
     annotations: {tpushare.io/scoring: spread}
+  - {count: 1, name: ring, chips: 4}
+"""
+
+
+EXAMPLE_AUTOSCALE = """\
+# Fleet-autoscaling demo (docs/autoscale.md): eight 16-GiB pods fill
+# both nodes chip for chip, so the 4-chip ring pod fits NOWHERE and no
+# rebalance move can help (every chip is full — defrag-first rules
+# itself out honestly). `autoscale: active` then runs the extender's
+# real autoscaler: the surviving demand provisions a node cloned from
+# the roomiest existing template and the ring pod binds on it. Use
+# `autoscale: dry-run` to see the decision without growing the fleet.
+fleet:
+  - count: 2
+    prefix: v5e
+    chips: 4
+    hbm_per_chip: 16
+autoscale: active
+workload:
+  - {count: 8, name: shard, hbm: 16}
   - {count: 1, name: ring, chips: 4}
 """
 
@@ -455,6 +485,17 @@ def simulate(scenario: dict) -> dict:
             defrag_report = _run_defrag(
                 api, client, stack, scenario["defrag"],
                 unschedulable, placements, all_nodes)
+        # Autoscale round (scenario `autoscale: dry-run|active`): run
+        # the extender's REAL fleet autoscaler after the replay (and
+        # after any defrag round, which is cheaper and goes first) —
+        # the offline dry-run of the demand → provision → bind and
+        # trough → drain → delete stories (docs/autoscale.md). The
+        # fleet CHANGES here, so the rounds re-list nodes each pass.
+        autoscale_report = None
+        if scenario.get("autoscale"):
+            autoscale_report = _run_autoscale(
+                api, client, stack, scenario["autoscale"],
+                unschedulable, placements)
         # Serving round (scenario `serving:` key): front the bound
         # decode pods with the REAL router and replay the traffic
         # stream — scale-out binds land in the packing below.
@@ -489,7 +530,7 @@ def simulate(scenario: dict) -> dict:
         shutdown_stack(stack, server)
     report = _report(inspect_doc, placements, held, unschedulable,
                      latencies, executed_preemptions, tenants, slo_doc,
-                     defrag_report, serving_report)
+                     defrag_report, serving_report, autoscale_report)
     if hotspots_doc is not None:
         report["hotspots"] = hotspots_doc
     if timeline_doc is not None:
@@ -562,6 +603,108 @@ def _run_defrag(api, client: _Client, stack, mode, unschedulable,
             placements.append(retry)
             recovered.append(f"{pod.namespace}/{pod.name}")
     out["recovered"] = recovered
+    return out
+
+
+def _run_autoscale(api, client: _Client, stack, mode, unschedulable,
+                   placements) -> dict:
+    """Autoscale rounds through ``stack.controller.autoscale`` (the
+    REAL executor). Scale-up provisions for the replay's surviving
+    unplaceable demand — with the defrag-first rule intact, so a hold
+    naming ``capacity-exists`` or ``defrag-first`` is itself the
+    answer — and the pending pods re-bind on the new capacity.
+    Scale-down cordons and drains provably idle nodes; evicted
+    residents are re-created and re-scheduled (the replay plays the
+    Job controller, same as the defrag round). A replay has no wall
+    clock to age demand against, so the hysteresis delays (up/down/
+    cooldown) are collapsed to zero: the report answers "what would
+    the fleet settle at", not "when". Mutates ``unschedulable`` and
+    ``placements`` in place like the defrag round."""
+    from tpushare.k8s.errors import NotFoundError
+    from tpushare.utils import const as _c
+    from tpushare.utils import node as nodeutils
+
+    executor = stack.controller.autoscale
+    executor.mode = "active" if mode is True else str(mode)
+    if executor.mode not in ("dry-run", "active"):
+        return {"error": f"autoscale: unknown mode {mode!r} "
+                         "(want dry-run or active)"}
+    executor.up_delay_s = 0.0
+    executor.down_delay_s = 0.0
+    executor.cooldown_s = 0.0
+    # Victims' specs BEFORE a drain eviction deletes them.
+    originals = {f"{p.namespace}/{p.name}": p for p in api.list_pods()}
+    out: dict = {"mode": executor.mode, "decisions": [],
+                 "provisioned": [], "drained": [], "recovered": []}
+
+    def _retry_pending() -> None:
+        """The whole point of a scale-up: pods the fleet size blocked
+        now bind — against the RE-LISTED fleet (it just changed)."""
+        for verdict in unschedulable[:]:
+            try:
+                pod = api.get_pod(verdict.get("namespace", "default"),
+                                  verdict["pod"])
+            except NotFoundError:
+                continue
+            candidates = [n.name for n in api.list_nodes()
+                          if nodeutils.is_schedulable(n, pod)]
+            retry = _schedule_one(client, pod, candidates)
+            if retry.pop("state") == "bound":
+                unschedulable.remove(verdict)
+                retry["pod"] = pod.name
+                retry["namespace"] = pod.namespace
+                retry["via"] = "autoscale"
+                placements.append(retry)
+                out["recovered"].append(f"{pod.namespace}/{pod.name}")
+
+    # Bounded rounds: a drain spans ticks (deferred residents), and a
+    # pathological scenario must still terminate.
+    for _ in range(8):
+        decision = executor.tick()
+        if decision is None:
+            break
+        out["decisions"].append(decision)
+        action = decision.get("action")
+        # Dry-run changes nothing, so a second tick would repeat the
+        # same decision forever; one decision IS the dry-run story.
+        # Holds and actuation errors likewise end the round.
+        if (decision.get("dryRun") or action == "hold"
+                or decision.get("error")):
+            break
+        stack.controller.wait_idle(timeout=10)
+        if action == "scale-up":
+            out["provisioned"].append(decision["node"])
+            _retry_pending()
+            continue
+        # scale-down: play the Job controller for every eviction —
+        # re-create the victim and re-schedule it on what remains.
+        for ev in decision.get("evictions") or []:
+            if ev.get("status") != "evicted":
+                continue
+            original = originals.get(ev["pod"])
+            if original is None:
+                continue
+            raw = original.deepcopy().raw
+            meta = raw.setdefault("metadata", {})
+            for key in ("uid", "resourceVersion"):
+                meta.pop(key, None)
+            ann = meta.get("annotations") or {}
+            for key in _c.GRANT_ANNOTATIONS:
+                ann.pop(key, None)
+            raw.setdefault("spec", {}).pop("nodeName", None)
+            raw["status"] = {"phase": "Pending"}
+            pod = api.create_pod(raw)
+            candidates = [n.name for n in api.list_nodes()
+                          if nodeutils.is_schedulable(n, pod)]
+            verdict = _schedule_one(client, pod, candidates)
+            verdict["pod"] = pod.name
+            verdict["namespace"] = pod.namespace
+            if verdict.pop("state") == "bound":
+                verdict["via"] = "autoscale drain"
+                placements.append(verdict)
+        if decision.get("phase") == "delete":
+            out["drained"].append(decision["node"])
+            stack.controller.wait_idle(timeout=10)
     return out
 
 
@@ -858,7 +1001,8 @@ def _gang_topology(inspect_doc) -> list[dict]:
 
 def _report(inspect_doc, placements, held, unschedulable,
             latencies, executed_preemptions=(), tenants=(),
-            slo_doc=None, defrag_report=None, serving_report=None):
+            slo_doc=None, defrag_report=None, serving_report=None,
+            autoscale_report=None):
     nodes = []
     total_hbm = used_hbm = free_whole_chips = cordoned_hbm = 0
     for n in inspect_doc.get("nodes", []):
@@ -906,6 +1050,7 @@ def _report(inspect_doc, placements, held, unschedulable,
         "slo": slo_doc or {},
         **({"defrag": defrag_report} if defrag_report else {}),
         **({"serving": serving_report} if serving_report else {}),
+        **({"autoscale": autoscale_report} if autoscale_report else {}),
     }
 
 
@@ -985,6 +1130,35 @@ def _print_human(report: dict) -> None:
             if defrag_doc.get("recovered"):
                 print("  unblocked: "
                       + ", ".join(defrag_doc["recovered"]))
+    as_doc = report.get("autoscale")
+    if as_doc:
+        print(f"\nautoscale ({as_doc.get('mode')}):")
+        if as_doc.get("error"):
+            print(f"  error: {as_doc['error']}")
+        for d in as_doc.get("decisions", []):
+            tag = " [dry-run]" if d.get("dryRun") else ""
+            if d.get("action") == "hold":
+                print(f"  hold: {d.get('reason')} — {d.get('detail')}")
+            elif d.get("action") == "scale-up":
+                shape = d.get("shape") or {}
+                want = (f"{shape['chips']} chip(s)" if shape.get("chips")
+                        else f"{shape.get('hbmGiB')} GiB")
+                kind = (d.get("election") or {}).get("kind", "?")
+                print(f"  scale-up {d.get('node')} for {want} "
+                      f"({kind}){tag}")
+            else:
+                print(f"  scale-down {d.get('node')} "
+                      f"[{d.get('phase')}]{tag}")
+                for ev in d.get("evictions") or []:
+                    print(f"    {ev['pod']}: {ev['status']}")
+            if d.get("error"):
+                print(f"    error: {d['error']}")
+        if as_doc.get("provisioned"):
+            print("  provisioned: " + ", ".join(as_doc["provisioned"]))
+        if as_doc.get("drained"):
+            print("  drained: " + ", ".join(as_doc["drained"]))
+        if as_doc.get("recovered"):
+            print("  unblocked: " + ", ".join(as_doc["recovered"]))
     slo_doc = report.get("slo") or {}
     journeys = slo_doc.get("journeys") or {}
     if journeys.get("closed"):
@@ -1323,6 +1497,11 @@ def main() -> None:
                     help="print a defragmentation demo scenario "
                          "(fragment -> plan -> migrate -> pending pod "
                          "binds in one run) and exit")
+    ap.add_argument("--example-autoscale", action="store_true",
+                    help="print a fleet-autoscaling demo scenario "
+                         "(packed fleet where defrag can't help -> "
+                         "scale-up clones a node template -> the "
+                         "pending ring pod binds on it) and exit")
     ap.add_argument("--example-serving", action="store_true",
                     help="print a serving front-door demo scenario "
                          "(surge -> shed the flooder -> scale-out "
@@ -1354,6 +1533,9 @@ def main() -> None:
         return
     if args.example_defrag:
         print(EXAMPLE_DEFRAG, end="")
+        return
+    if args.example_autoscale:
+        print(EXAMPLE_AUTOSCALE, end="")
         return
     if args.example_serving:
         print(EXAMPLE_SERVING, end="")

@@ -83,6 +83,13 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
     # state while HTTP threads read /debug/defrag and the scrape reads
     # the frag gauges — cross-thread like every ledger above.
     "DefragExecutor": ("_last_plan", "_ticks", "_abort_event_at"),
+    # The fleet autoscaler (tpushare/autoscale/executor.py): the tick
+    # loop mutates the drain-in-flight and decision state while HTTP
+    # threads read /debug/autoscale and the scrape reads the
+    # fleet-size gauges — defrag's exact cross-thread shape.
+    "AutoscaleExecutor": ("_draining", "_last_decision", "_ticks",
+                          "_last_action_at", "_demand_seen_at",
+                          "_recent_shapes", "_abort_event_at"),
     # The shared eviction budget (tpushare/k8s/eviction.py) is hit
     # concurrently by the defrag executor and any parallel eviction.
     "EvictionBudget": ("_node_last", "_recent", "_in_flight"),
@@ -323,9 +330,9 @@ def raw_lock(tree: ast.AST, src: str, path: str) -> list[Violation]:
 #: increment a drop/error counter so the loss itself is observable.
 _TELEMETRY_PATHS = ("k8s/events.py", "routes/metrics.py")
 _TELEMETRY_DIRS = ("tpushare/trace/", "tpushare/slo/",
-                   "tpushare/defrag/", "tpushare/profiling/",
-                   "tpushare/router/", "tpushare/topology/",
-                   "tpushare/obs/")
+                   "tpushare/defrag/", "tpushare/autoscale/",
+                   "tpushare/profiling/", "tpushare/router/",
+                   "tpushare/topology/", "tpushare/obs/")
 
 #: Call shapes that count as incrementing a drop/error counter
 #: (bare ``safe_inc(...)``, ``metrics.safe_inc(...)``, ``x.inc()``).

@@ -22,9 +22,9 @@ from tools.vet.engine import Violation
 CORE_PACKAGES = ("tpushare/cache/", "tpushare/scheduler/",
                  "tpushare/utils/", "tpushare/api/", "tpushare/quota/",
                  "tpushare/slo/", "tpushare/defrag/",
-                 "tpushare/profiling/", "tpushare/router/",
-                 "tpushare/topology/", "tpushare/obs/",
-                 "tpushare/k8s/eviction.py")
+                 "tpushare/autoscale/", "tpushare/profiling/",
+                 "tpushare/router/", "tpushare/topology/",
+                 "tpushare/obs/", "tpushare/k8s/eviction.py")
 
 #: Parameter names exempt from annotation (bound implicitly).
 _IMPLICIT = {"self", "cls"}

@@ -63,6 +63,12 @@ class NodeSummary(NamedTuple):
     #: Largest single-chip free HBM — the slice-admission test.
     max_free_chip: int
     chip_count: int
+    #: ``spec.unschedulable`` (kubectl/autoscaler cordon). Upstream
+    #: kube-scheduler filters cordoned nodes before any extender, but
+    #: test harnesses (and any scheduler that skips the upstream pass)
+    #: offer them — honoring the bit here keeps the filter verb's
+    #: verdict identical either way, for one tuple-field read.
+    unschedulable: bool = False
 
 
 def apply_nominated_demand(avail: dict[int, int], free_chips: set[int],
@@ -150,6 +156,9 @@ class NodeInfo:
         #: Refreshed only when the node DOCUMENT changes
         #: (SchedulerCache.get_node_info's document swap).
         self._sharing: bool = nodeutils.is_tpu_sharing_node(node)
+        #: The node document's cordon bit, cached like ``_sharing``
+        #: (spec.unschedulable only changes via a document swap).
+        self._unschedulable: bool = node.unschedulable
         #: Per-request-shape verdict/score memos for the verb fast
         #: paths: key → (summary-at-compute-time, cached value). An
         #: entry is valid only while its summary object IS the current
@@ -269,6 +278,7 @@ class NodeInfo:
         with self._lock:
             self.node = node
             self._sharing = nodeutils.is_tpu_sharing_node(node)
+            self._unschedulable = node.unschedulable
             self._invalidate_summary()
 
     def _invalidate_summary(self) -> None:
@@ -316,6 +326,7 @@ class NodeInfo:
                 free_chips=tuple(free),
                 max_free_chip=max_free,
                 chip_count=self.chip_count,
+                unschedulable=self._unschedulable,
             )
             self._summary = s
             return s

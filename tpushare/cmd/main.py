@@ -65,6 +65,18 @@ Environment (reference cmd/main.go:23,92-98):
   ``TPUSHARE_DEFRAG_MAX_CONCURRENT`` /
   ``TPUSHARE_DEFRAG_INTERVAL_S``, leader-gated, and aborts whole plans
   while any SLO is burning.
+* ``TPUSHARE_AUTOSCALE`` — ``off`` | ``dry-run`` (default) |
+  ``active``: the fleet autoscaler's posture (docs/autoscale.md).
+  Dry-run decides and publishes without touching the fleet; active
+  provisions nodes for aged unplaceable demand (defrag-first, slice-
+  completing) and cordons + drains + deletes the most strandable node
+  in a trough. Bounded by ``TPUSHARE_AUTOSCALE_MIN_NODES`` /
+  ``TPUSHARE_AUTOSCALE_MAX_NODES``; paced by
+  ``TPUSHARE_AUTOSCALE_UP_DELAY_S`` /
+  ``TPUSHARE_AUTOSCALE_DOWN_DELAY_S`` /
+  ``TPUSHARE_AUTOSCALE_COOLDOWN_S`` /
+  ``TPUSHARE_AUTOSCALE_INTERVAL_S``; drains spend the defrag eviction
+  budget and abort (uncordoning) while any SLO is burning.
 * ``TPUSHARE_TIMELINE`` — ``on`` (default) arms the retrospective
   timeline recorder (bounded per-series history rings + fleet-event
   markers + anomaly watchers, served at ``/debug/timeline``;
@@ -172,6 +184,9 @@ def build_stack(client, is_leader=None) -> Stack:
     # predicate owns that tracker, so it is wired in here, after both
     # exist (docs/defrag.md).
     controller.defrag.set_demand(predicate.demand)
+    # The autoscaler consumes the SAME tracker as first-class demand
+    # (shapes + ages drive scale-up hysteresis).
+    controller.autoscale.set_demand(predicate.demand)
     prioritize = Prioritize(
         controller.cache, gang_planner=gang, policy=scoring,
         quota=controller.quota)
@@ -216,9 +231,11 @@ def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2,
     stack = build_stack(client)
     if router is not None:
         # The in-process router's queue pressure joins the timeline
-        # (build_stack cannot see it — the router arrives here).
+        # (build_stack cannot see it — the router arrives here), and
+        # its scale-out want becomes autoscaler demand.
         from tpushare import obs
         obs.wire(router=router)
+        stack.controller.autoscale.set_router(router)
     stack.controller.start(workers=workers)
     server = ExtenderHTTPServer(
         address, stack.predicate, stack.binder, stack.inspect,
@@ -228,6 +245,7 @@ def serve_stack(client, address=("127.0.0.1", 0), workers: int = 2,
         workqueue=stack.controller.queue,
         quota=stack.controller.quota,
         defrag=stack.controller.defrag,
+        autoscale=stack.controller.autoscale,
         router=router)
     serve_forever(server)
     return stack, server
@@ -374,7 +392,8 @@ def main() -> None:
                                 debug_routes=debug_routes,
                                 workqueue=stack.controller.queue,
                                 quota=stack.controller.quota,
-                                defrag=stack.controller.defrag)
+                                defrag=stack.controller.defrag,
+                                autoscale=stack.controller.autoscale)
     cert, key = os.environ.get("TLS_CERT_FILE"), os.environ.get("TLS_KEY_FILE")
     if bool(cert) != bool(key):
         log.error("TLS misconfigured: exactly one of TLS_CERT_FILE / "

@@ -73,6 +73,18 @@ class Controller:
         self.defrag = DefragExecutor(
             self.cache, client, quota=self.quota,
             pod_lister=self.hub.pods.list, is_leader=self._is_leader)
+        #: Fleet autoscaling: demand-driven scale-up, drain-aware
+        #: scale-down (docs/autoscale.md). Dry-run by default;
+        #: TPUSHARE_AUTOSCALE=active arms node create/delete. Shares
+        #: the defrag executor's eviction budget — drains and
+        #: rebalance moves disrupt the same pods, so they spend one
+        #: hourly allowance. build_stack wires the DemandTracker (and
+        #: serve_stack the router) post-construction.
+        from tpushare.autoscale.executor import AutoscaleExecutor
+        self.autoscale = AutoscaleExecutor(
+            self.cache, client, quota=self.quota,
+            pod_lister=self.hub.pods.list, is_leader=self._is_leader,
+            budget=self.defrag.budget)
         self._removed_lock = locks.TracingRLock("controller/removed")
         #: ns/name -> last seen Pod, for deletes (reference removePodCache)
         self._removed: dict[str, Pod] = locks.guarded_dict(
@@ -218,6 +230,14 @@ class Controller:
         if known and podutils.is_complete_pod(new):
             self.queue.add(new.key())
         elif known and self._usage_changed(old, new):
+            self.queue.add(new.key())
+        elif known and (old is None or old.annotations.get(
+                const.ANN_CKPT_IN_FLIGHT) != new.annotations.get(
+                const.ANN_CKPT_IN_FLIGHT)):
+            # Checkpoint-in-flight flips gate eviction eligibility
+            # (defrag moves, autoscale drains): the ledger copy must
+            # learn the transition or movable() reads a stale verdict
+            # for the pod's whole checkpoint window.
             self.queue.add(new.key())
         elif not known and podutils.is_assumed(new) and new.node_name:
             self.queue.add(new.key())
@@ -429,11 +449,15 @@ class Controller:
         # first tick only fires a full interval from now, so transient
         # controllers never rebalance by accident).
         self.defrag.start()
+        # Autoscale tick loop (same posture: off by env, first tick a
+        # full interval out).
+        self.autoscale.start()
         log.info("controller started with %d sync workers", workers)
 
     def stop(self) -> None:
         self._stop.set()
         self.defrag.stop()
+        self.autoscale.stop()
         self.queue.shut_down()
         self.hub.stop()
         for t in self._workers:

@@ -256,6 +256,20 @@ class ApiClient:
         doc = self._request("GET", "/api/v1/nodes")
         return [Node(item) for item in doc.get("items", [])]
 
+    def create_node(self, raw: dict) -> Node:
+        """Register a node object — the autoscaler's provisioning
+        actuator. Against a real cluster the kubelet self-registers and
+        a cloud provider boots the machine; in the simulated fleet the
+        node document IS the machine, so creating it over the wire is
+        the whole scale-up."""
+        return Node(self._request("POST", "/api/v1/nodes", body=raw))
+
+    def delete_node(self, name: str) -> None:
+        """Deregister a drained node — the autoscaler's scale-down
+        actuator. Caller must have cordoned and emptied it first; this
+        verb does not check."""
+        self._request("DELETE", f"/api/v1/nodes/{name}")
+
     def list_pdbs(self) -> list[PodDisruptionBudget]:
         """All PodDisruptionBudgets (policy/v1) — the preempt verb's
         violation recount input. Needs a ``poddisruptionbudgets``

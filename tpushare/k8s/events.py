@@ -100,6 +100,7 @@ REASON_QUOTA_DENIED = "TPUShareQuotaDenied"
 REASON_SLO_BURN = "TPUShareSLOBurn"
 REASON_DEFRAG_MOVE = "TPUShareDefragMove"
 REASON_DEFRAG_ABORTED = "TPUShareDefragAborted"
+REASON_AUTOSCALE_ABORTED = "TPUShareAutoscaleAborted"
 REASON_ANOMALY = "TPUShareAnomaly"
 
 

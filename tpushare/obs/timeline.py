@@ -71,6 +71,7 @@ VERB_BUFFER = 4096
 MARKER_KINDS = frozenset({
     "leader", "defrag-plan", "defrag-abort", "router-scaleout",
     "slo-burn", "config", "gang-commit", "gang-rollback", "anomaly",
+    "autoscale-up", "autoscale-down", "autoscale-abort",
 })
 
 

@@ -314,6 +314,49 @@ DEFRAG_PLANS_ABORTED = Counter(
     ["reason"], registry=REGISTRY,
 )
 
+# -- Fleet autoscaling (tpushare/autoscale/, docs/autoscale.md) ------------ #
+
+CLUSTER_CAPACITY_HBM = Gauge(
+    "tpushare_cluster_capacity_hbm_gib",
+    "Total shareable HBM (GiB) the sharing fleet advertises — the "
+    "denominator fleet-sizing decisions divide demand by. Moves only "
+    "when nodes join or leave (the autoscaler's own actuations "
+    "included)",
+    registry=REGISTRY,
+)
+CLUSTER_NODES = Gauge(
+    "tpushare_cluster_nodes",
+    "Sharing nodes by state: ready (schedulable) or cordoned "
+    "(spec.unschedulable — an operator cordon or an autoscale drain "
+    "in flight). ready shrinking while cordoned grows is a drain; "
+    "both shrinking is a completed scale-down",
+    ["state"], registry=REGISTRY,
+)
+DEMAND_OLDEST_AGE = Gauge(
+    "tpushare_unschedulable_demand_oldest_age_seconds",
+    "Per request shape (label '<hbm>GiBx<chips>c'), how long the "
+    "OLDEST currently-unplaceable pod of that shape has waited — the "
+    "autoscaler's hysteresis input. A shape aging past "
+    "TPUSHARE_AUTOSCALE_UP_DELAY_S is about to buy a node",
+    ["shape"], registry=REGISTRY,
+)
+AUTOSCALE_ACTIONS = Counter(
+    "tpushare_autoscale_actions_total",
+    "Autoscaler actions by kind: up (node provisioned), down (node "
+    "cordoned for drain), evicted (drain eviction), deleted (drained "
+    "node removed), hold (demand present but provisioning refused — "
+    "cooldown, ceiling, capacity-exists, or defrag-first), dry-run, "
+    "aborted, failed",
+    ["action"], registry=REGISTRY,
+)
+AUTOSCALE_ABORTED = Counter(
+    "tpushare_autoscale_aborts_total",
+    "Autoscale drains aborted mid-flight, by reason (slo-burn: the "
+    "node was uncordoned and returned to service). See the "
+    "docs/autoscale.md runbook",
+    ["reason"], registry=REGISTRY,
+)
+
 # -- Serving front door (tpushare/router/, docs/serving.md) ---------------- #
 # All router series are SET at scrape time from the Router ledger's
 # monotonic counters and rolling windows (the workqueue-retries
@@ -850,6 +893,22 @@ def observe_frag(defrag) -> None:
             NODE_FRAG_SCORE.labels(node=node["node"]).set(node["score"])
 
 
+def observe_autoscale(autoscale) -> None:
+    """Refresh the fleet-size gauges from the autoscale executor's
+    fleet snapshot (live ledger math — node counts by state, total
+    shareable capacity). Failure keeps the last good values together,
+    counted, like observe_frag."""
+    with _SCRAPE_LOCK:
+        try:
+            fleet = autoscale.fleet_snapshot()
+        except Exception:
+            safe_inc(TELEMETRY_ERRORS)
+            return
+        CLUSTER_CAPACITY_HBM.set(fleet["capacityHbmGiB"])
+        CLUSTER_NODES.labels(state="ready").set(fleet["ready"])
+        CLUSTER_NODES.labels(state="cordoned").set(fleet["cordoned"])
+
+
 def observe_router(router) -> None:
     """Refresh the serving-router gauges from the router ledger's
     snapshot. Rebuilt from scratch each scrape (the per-node-gauge
@@ -992,7 +1051,7 @@ def observe_http(http_server) -> None:
 
 def scrape(cache, gang_planner=None, leader=None, demand=None,
            workqueue=None, quota=None, defrag=None, router=None,
-           http_server=None) -> bytes:
+           autoscale=None, http_server=None) -> bytes:
     """Atomic observe+render for the /metrics handler, timed and
     error-counted (a scrape that raises is a sample Prometheus never
     saw — that loss must itself be countable)."""
@@ -1021,6 +1080,16 @@ def scrape(cache, gang_planner=None, leader=None, demand=None,
                 UNSCHED_PODS.set(pods)
                 UNSCHED_HBM.set(hbm)
                 UNSCHED_CHIPS.set(chips)
+                # Demand AGE per shape (the autoscaler's hysteresis
+                # input), after the snapshot() prune so vanished
+                # demand stops aging. Clear-then-set: a shape whose
+                # last pod placed drops its series instead of
+                # freezing at its final age.
+                DEMAND_OLDEST_AGE.clear()
+                for (d_hbm, d_chips), age in \
+                        demand.oldest_age_by_shape().items():
+                    DEMAND_OLDEST_AGE.labels(
+                        shape=f"{d_hbm}GiBx{d_chips}c").set(age)
                 for gauge in (UNSCHED_PODS_TENANT, UNSCHED_HBM_TENANT,
                               UNSCHED_CHIPS_TENANT):
                     gauge.clear()
@@ -1033,6 +1102,8 @@ def scrape(cache, gang_planner=None, leader=None, demand=None,
                 # After the demand block: the frag index reads the
                 # DemandTracker's shapes, which snapshot() just pruned.
                 observe_frag(defrag)
+            if autoscale is not None:
+                observe_autoscale(autoscale)
             if gang_planner is not None:
                 # stats() is the cheap view (no member lists / TTL math)
                 # — this runs under the scrape lock.

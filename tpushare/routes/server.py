@@ -24,6 +24,9 @@ Routes:
   node scores) + the last rebalance plan (proposed vs executed vs
   aborted moves, with trace-ids) and the eviction budgets
   (docs/defrag.md)
+* ``GET  /debug/autoscale`` — fleet autoscaler: posture, hysteresis
+  bounds, fleet capacity/cordon counts, the drain in flight and the
+  last scale decision with its hold reason (docs/autoscale.md)
 * ``GET  /debug/slo``       — SLO objectives: error-budget remaining,
   burn rates per window, journey aggregates (docs/slo.md)
 * ``GET  /debug/router``    — serving front door: per-tenant queue
@@ -135,7 +138,8 @@ class ExtenderHTTPServer(HTTPServer):
                  prefix: str = DEFAULT_PREFIX, prioritize=None,
                  preempt=None, admission=None, leader=None,
                  gang_planner=None, debug_routes: bool = True,
-                 workqueue=None, quota=None, defrag=None, router=None,
+                 workqueue=None, quota=None, defrag=None, autoscale=None,
+                 router=None,
                  http_workers: int | None = None,
                  socket_timeout_s: float | None = None,
                  max_body_bytes: int = MAX_BODY_BYTES,
@@ -174,6 +178,11 @@ class ExtenderHTTPServer(HTTPServer):
         #: gauges in /metrics and GET /debug/defrag. Wired explicitly
         #: like quota: dropping it must 404, not freeze the frag score.
         self.defrag = defrag
+        #: Fleet autoscaler (AutoscaleExecutor), for the cluster
+        #: capacity/node-state gauges in /metrics and GET
+        #: /debug/autoscale. Wired explicitly like defrag: dropping it
+        #: must 404, not freeze the fleet-size series.
+        self.autoscale = autoscale
         #: Serving front door (router.Router), for the tpushare_router_*
         #: gauges in /metrics and GET /debug/router. Wired explicitly
         #: like the rest: dropping it must 404, not freeze the fleet
@@ -619,6 +628,7 @@ class _Handler(BaseHTTPRequestHandler):
                                    workqueue=self.server.workqueue,
                                    quota=self.server.quota,
                                    defrag=self.server.defrag,
+                                   autoscale=self.server.autoscale,
                                    router=self.server.router,
                                    http_server=self.server),
                     ctype="text/plain; version=0.0.4")
@@ -646,6 +656,12 @@ class _Handler(BaseHTTPRequestHandler):
                                     404)
                 else:
                     self._send_json(self.server.defrag.status())
+            elif path == "/debug/autoscale":
+                if self.server.autoscale is None:
+                    self._send_json({"Error": "autoscale not configured"},
+                                    404)
+                else:
+                    self._send_json(self.server.autoscale.status())
             elif path == "/debug/router":
                 if self.server.router is None:
                     self._send_json({"Error": "router not configured"},

@@ -145,6 +145,22 @@ class DemandTracker:
             return sorted({(hbm, chips) for hbm, chips, _, _, _
                            in self._entries.values()})
 
+    def oldest_age_by_shape(self) -> dict[tuple[int, int], float]:
+        """(hbm GiB, chips) -> seconds the OLDEST pod of that shape has
+        been unplaceable. The autoscaler's hysteresis input: a shape is
+        only worth provisioning for once its demand has aged past the
+        up-delay (transient filter blips self-clear). Pure read; call
+        after :meth:`snapshot` when freshness matters."""
+        now = time.monotonic()
+        out: dict[tuple[int, int], float] = {}
+        with self._lock:
+            for hbm, chips, _, seen, _ in self._entries.values():
+                age = now - seen
+                key = (hbm, chips)
+                if age > out.get(key, -1.0):
+                    out[key] = age
+        return out
+
     def by_tenant(self) -> dict[str, tuple[int, int, int]]:
         """tenant -> (pods, hbm GiB, chips) of the CURRENT entries —
         whose demand the fleet cannot place. Call after :meth:`snapshot`
@@ -245,6 +261,11 @@ class Predicate:
         info = self.cache.get_node_info(node_name)
         if info is None:
             return False, f"unknown node {node_name}"
+        if not nodeutils.is_schedulable(info.node, pod):
+            # Upstream kube-scheduler filters cordoned nodes before any
+            # extender; honoring the bit here keeps the verdict identical
+            # for harnesses (and autoscaler drains) that skip that pass.
+            return False, f"node {node_name} is cordoned (unschedulable)"
         if not nodeutils.is_tpu_sharing_node(info.node):
             return False, f"node {node_name} advertises no shareable TPU HBM"
         ok, reason = info.assume(pod,
@@ -325,6 +346,15 @@ class Predicate:
             s = info._summary
             if s is None:
                 s = info.summary()
+            if s.unschedulable and not nodeutils.is_schedulable(info.node,
+                                                                pod):
+                # Cordoned (autoscaler drain / kubectl cordon): one
+                # tuple-field read for the common uncordoned fleet; the
+                # full toleration check only runs for the rare cordoned
+                # node, so pods tolerating the unschedulable taint still
+                # pass exactly as upstream would let them.
+                failed[name] = f"node {name} is cordoned (unschedulable)"
+                continue
             ent = info.admit_memo.get(shape)
             if ent is None or ent[0] is not s:
                 ent = _admit(s, req_chips, req_hbm, name)
