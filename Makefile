@@ -88,10 +88,12 @@ bench-workload:
 bench-router:
 	python bench_router.py --gate
 
-# Drift check: re-run the scale + wire smokes and diff their gated
-# stats against the committed full-run contracts (>10% unfavorable
+# Drift check: re-run the scale + wire + workload smokes and diff
+# their gated stats against the committed contracts (>10% unfavorable
 # drift exits nonzero). Smoke scenarios are smaller than the committed
-# runs, so treat failures as a prompt to re-run the full bench.
+# runs, so treat failures as a prompt to re-run the full bench. The
+# workload row drift-checks the paged-KV density scalar (grant
+# arithmetic — gated even on the CPU smoke artifact).
 bench-diff:
 	python bench.py --scale --smoke > /tmp/tpushare-bench-scale.json
 	python bench.py --wire --smoke > /tmp/tpushare-bench-wire.json
@@ -99,5 +101,7 @@ bench-diff:
 	python tools/bench_diff.py BENCH_SCALE.json /tmp/tpushare-bench-scale.json
 	python tools/bench_diff.py BENCH_WIRE_r01.json /tmp/tpushare-bench-wire.json
 	python tools/bench_diff.py BENCH_AUTOSCALE.json /tmp/tpushare-bench-autoscale.json
+	python bench_workload.py --allow-cpu > /tmp/tpushare-bench-workload.json
+	python tools/bench_diff.py BENCH_WORKLOAD_r09.json /tmp/tpushare-bench-workload.json
 
 all: native test

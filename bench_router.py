@@ -24,6 +24,17 @@ starve the tenants inside their shares). A second replay with the
 pre-chunked-prefill admission overhead (22.1%, BENCH_WORKLOAD_r05)
 quantifies what closing the serving gap buys at fleet level.
 
+A third replay runs the SAME traffic against a paged-KV fleet: each
+replica holds the same HBM but bills streams by pages, so it carries
+2x the slots at the page budget one row fleet had, with 2x aggregate
+decode tok/s (per-stream tok/s is flat — bench_workload's
+``paged_per_stream_tok_s`` gate). The chat tenants declare a shared
+128-token system preamble (``prefix_key``), so paged replicas charge
+its pages once per live prefix. Gated: the paged fleet must shed the
+flooder LATER (and less), raise FEWER scale-out signals, and hold the
+same fairness floor — density showing up as deferred capacity
+escalation, not as collateral on in-quota tenants.
+
 Deterministic: virtual clock, seeded arrivals, no wall-time
 dependence — CI runs it gated (``--gate``; ``--smoke`` shortens the
 phases). Output: ONE JSON line (the bench.py contract).
@@ -54,6 +65,15 @@ PREFILL_TOK_S = 150_000.0
 OVERHEAD_CHUNKED = 0.10
 OVERHEAD_WHOLE = 0.221
 
+#: Paged-fleet service model (bench_workload ``paged_decode``): same
+#: HBM, 2x slots against the page budget, per-stream tok/s flat (the
+#: ``paged_per_stream_tok_s`` gate) so aggregate decode doubles.
+PAGE_TOKENS = 64
+MAX_LEN = 2048
+#: Chat requests share a tenant-scoped system preamble this long; the
+#: paged fleet charges its pages once per live prefix.
+SYSTEM_PREFIX_TOKENS = 128
+
 
 def jain(xs: list[float]) -> float:
     """Jain's fairness index: 1.0 = perfectly equal shares."""
@@ -76,8 +96,14 @@ def build_quota() -> QuotaManager:
 def replay(*, overhead: float, replicas: int, slots: int,
            steady_s: float, surge_s: float, recovery_s: float,
            provision_delay_s: float, max_extra: int, seed: int,
-           dt: float = 0.02) -> dict:
-    """One full open-loop replay; returns the result document."""
+           dt: float = 0.02, paged: bool = False) -> dict:
+    """One full open-loop replay; returns the result document.
+
+    ``paged=True`` swaps every replica for its paged twin — same HBM,
+    ``pages_total`` = the row fleet's page budget, 2x slots to let a
+    mixed trace spend it, 2x aggregate decode (per-stream flat) — and
+    leaves the TRAFFIC identical, so the two replays isolate what the
+    memory model buys at fleet level."""
     rng = random.Random(seed)
     now = 0.0
     router = Router(quota=build_quota(), clock=lambda: now,
@@ -88,11 +114,24 @@ def replay(*, overhead: float, replicas: int, slots: int,
                     # so the shed gate tests POLICY (the 12x flooder),
                     # not transient queueing noise.
                     shed_slack=3.0)
+
+    def make_replica(name: str, node: str) -> DecodeReplica:
+        if paged:
+            return DecodeReplica(
+                name, slots=slots * 2, node=node, hbm_gib=8.0,
+                max_len=MAX_LEN, decode_tok_s=DECODE_TOK_S * 2,
+                prefill_tok_s=PREFILL_TOK_S,
+                admission_overhead=overhead,
+                page_tokens=PAGE_TOKENS,
+                pages_total=slots * (MAX_LEN // PAGE_TOKENS))
+        return DecodeReplica(
+            name, slots=slots, node=node, hbm_gib=8.0,
+            max_len=MAX_LEN, decode_tok_s=DECODE_TOK_S,
+            prefill_tok_s=PREFILL_TOK_S, admission_overhead=overhead)
+
     for i in range(replicas):
-        router.add_replica(DecodeReplica(
-            f"decode-{i}", slots=slots, node=f"node-{i % 4}",
-            hbm_gib=8.0, decode_tok_s=DECODE_TOK_S,
-            prefill_tok_s=PREFILL_TOK_S, admission_overhead=overhead))
+        router.add_replica(make_replica(f"decode-{i}",
+                                        f"node-{i % 4}"))
 
     #: Scheduler side of the scale-out loop: each signal provisions one
     #: replica of the requested shape after the bind+boot delay.
@@ -140,6 +179,7 @@ def replay(*, overhead: float, replicas: int, slots: int,
     # 12x past its share (it sheds).
     surge_mult = {"chat-a": 1.15, "chat-b": 1.15, "burst": 12.0}
     max_queue = 0
+    first_shed_at: float | None = None
 
     while now < t_end:
         phase = phase_of(now)
@@ -150,19 +190,24 @@ def replay(*, overhead: float, replicas: int, slots: int,
                 prompt = rng.choice((32, 64, 128, 128, 256, 512, 768,
                                      1024))
                 n_new = max(16, min(256, int(rng.gauss(mean_new, 48))))
-                dec = router.submit(tenant, prompt, n_new, now=now)
+                # Chat requests carry the tenant's system preamble —
+                # shareable prefix pages on a paged fleet, inert on a
+                # rows fleet (pages are whole rows there).
+                prefix = (dict(prefix_key="system-preamble",
+                               prefix_len=SYSTEM_PREFIX_TOKENS)
+                          if tenant.startswith("chat") else {})
+                dec = router.submit(tenant, prompt, n_new, now=now,
+                                    **prefix)
                 outcomes[tenant][dec["outcome"]] += 1
                 if dec["outcome"] != "shed":
                     book[dec["rid"]] = (tenant, now, phase)
+                elif first_shed_at is None:
+                    first_shed_at = round(now, 2)
                 next_arrival[tenant] += rng.expovariate(eff)
         while pending_joins and pending_joins[0] <= now:
             pending_joins.pop(0)
-            router.add_replica(DecodeReplica(
-                f"decode-x{extra}-{len(pending_joins)}",
-                slots=slots, node="node-new", hbm_gib=8.0,
-                decode_tok_s=DECODE_TOK_S,
-                prefill_tok_s=PREFILL_TOK_S,
-                admission_overhead=overhead))
+            router.add_replica(make_replica(
+                f"decode-x{extra}-{len(pending_joins)}", "node-new"))
         for ev in router.tick(now=now):
             meta = book.get(ev.rid)
             if meta is None:
@@ -190,7 +235,10 @@ def replay(*, overhead: float, replicas: int, slots: int,
     surge_chat = [served["surge"]["chat-a"], served["surge"]["chat-b"]]
     doc = {
         "fleet": {"replicas": replicas, "extraProvisioned": extra,
-                  "slotsPerReplica": slots,
+                  "slotsPerReplica": slots * 2 if paged else slots,
+                  "paged": paged,
+                  "pagesPerReplica": (slots * (MAX_LEN // PAGE_TOKENS)
+                                      if paged else None),
                   "admissionOverhead": overhead},
         "phases": {p: {"ttft": pctl(ttft[p]),
                        "served": {t: served[p][t] for t in rates}}
@@ -205,6 +253,9 @@ def replay(*, overhead: float, replicas: int, slots: int,
         "scaleOut": {"signals": final["scaleOut"]["signals"],
                      "signalTimes": signals_at[:8]},
         "fairnessJainSurge": round(jain(surge_chat), 4),
+        "firstShedAt": first_shed_at,
+        "shedTotal": sum(o["shed"] for o in outcomes.values()),
+        "prefix": final.get("prefix"),
     }
     return doc
 
@@ -232,10 +283,15 @@ def main() -> None:
     print("replay (whole-prefill fleet, overhead "
           f"{OVERHEAD_WHOLE:.1%}):", file=sys.stderr)
     whole = replay(overhead=OVERHEAD_WHOLE, **common)
+    print("replay (paged-KV fleet, same traffic):", file=sys.stderr)
+    paged = replay(overhead=OVERHEAD_CHUNKED, paged=True, **common)
+    print(f"  {json.dumps(paged['phases']['surge'])}", file=sys.stderr)
 
     shed = {t: chunked["tenants"][t]["shed"]
             for t in ("chat-a", "chat-b", "burst")}
     steady_p99 = chunked["phases"]["steady"]["ttft"]["p99"]
+    paged_shed = {t: paged["tenants"][t]["shed"]
+                  for t in ("chat-a", "chat-b", "burst")}
     gates = {
         # The surge must not starve the tenants inside their shares.
         "fairness_min": bool(
@@ -252,6 +308,24 @@ def main() -> None:
         "ttft_p99_steady": bool(
             steady_p99 is not None
             and steady_p99 <= TTFT_P99_STEADY_MAX_S),
+        # Paged fleet, same traffic: the density must show up as
+        # DEFERRED capacity escalation — later first shed, less total
+        # shed, fewer scale-out signals — at the same fairness floor
+        # and with shedding still isolated to the flooder.
+        "paged_fairness_min": bool(
+            paged["fairnessJainSurge"] >= FAIRNESS_MIN),
+        "paged_shed_isolated": bool(
+            paged_shed["chat-a"] == 0 and paged_shed["chat-b"] == 0),
+        "paged_sheds_later": bool(
+            paged["firstShedAt"] is None
+            or (chunked["firstShedAt"] is not None
+                and paged["firstShedAt"] >= chunked["firstShedAt"])),
+        "paged_sheds_less": bool(
+            paged["shedTotal"] < chunked["shedTotal"]),
+        "paged_fewer_scaleout_signals": bool(
+            paged["scaleOut"]["signals"]
+            < chunked["scaleOut"]["signals"]),
+        "paged_queues_drain": bool(paged["queuedAtEnd"] == 0),
     }
     doc = {
         "metric": "router_traffic_replay",
@@ -266,6 +340,10 @@ def main() -> None:
             "surgeTtft": whole["phases"]["surge"]["ttft"],
             "recoveryTtft": whole["phases"]["recovery"]["ttft"],
         },
+        # Same traffic on the paged fleet (the tentpole's fleet-level
+        # payoff): pages_free routing + per-page admission defer the
+        # shed and the scale-out signal the row fleet had to raise.
+        "paged": paged,
         "gates": gates,
     }
     print(json.dumps(doc))

@@ -32,12 +32,24 @@ Measures, on the one real chip:
    (prefill + scan-compiled KV-cache decode) on the flagship — the
    HBM-slice co-tenant workload; decode tokens/s.
 
-Output: ONE JSON line (the `bench.py` contract), plus human-readable
-progress on stderr. `--gate` exits nonzero unless:
+5. **Paged decode** (`bench_decode_paged`): the paged-KV density claim
+   (streams per HBM grant vs whole-row serving — pure page arithmetic,
+   gated even off-chip) and the measured per-stream tok/s of the paged
+   chunk at 2x the stream count (TPU-gated).
+
+Output: ONE JSON line (the `bench.py` contract — ``gates`` entries are
+``{value, limit, pass, gated}`` so ``tools/bench_diff.py`` can drift-
+check the committed artifact), plus human-readable progress on stderr.
+`--gate` exits nonzero when any gated entry fails:
 
 * flash fwd+bwd beats XLA at L=8k (speedup >= 1.0), and
 * flash runs L=32k fwd+bwd at all (the XLA path cannot), and
-* flagship MFU with flash attention >= ``MFU_FLOOR``.
+* flagship MFU with flash attention >= ``MFU_FLOOR`` (large config
+  >= ``MFU_LARGE_FLOOR``), and
+* continuous admission overhead <= ``ADMISSION_OVERHEAD_MAX_PCT``, and
+* paged density >= ``PAGED_DENSITY_FLOOR`` streams per whole-row
+  stream (every run), per-stream throughput at 2x streams >=
+  ``PAGED_PER_STREAM_FLOOR`` of the rows baseline (TPU only).
 """
 
 from __future__ import annotations
@@ -86,6 +98,20 @@ MFU_LARGE_FLOOR = 0.62
 #: scatter path; the fused chunk-ring step (serving._fused_chunk_step)
 #: is gated to hold it at or under this.
 ADMISSION_OVERHEAD_MAX_PCT = 10.0
+
+#: Paged-KV density floor: admitted streams on the mixed-length trace
+#: per whole-row stream under the SAME HBM grant. Pure page arithmetic
+#: (pages_for_grant vs max_batch_for_grant), so it is device-
+#: independent and gated even on a CPU smoke run. The flagship trace
+#: measures 3.29x; 2.0 is the ISSUE's headline claim with margin.
+PAGED_DENSITY_FLOOR = 2.0
+
+#: Per-stream throughput floor for the paged server at 2x the stream
+#: count of the whole-row baseline: decode at these batch sizes is
+#: weight-read-bound, so doubling streams should hold per-stream
+#: tok/s roughly flat (>= 0.9x). TPU-only — tiny CPU shapes are
+#: dispatch-dominated and say nothing about the HBM-bound step.
+PAGED_PER_STREAM_FLOOR = 0.9
 
 
 def _require_tpu(allow_cpu: bool) -> str:
@@ -509,6 +535,154 @@ def bench_decode_continuous(allow_cpu: bool) -> dict:
     }
 
 
+def bench_decode_paged(allow_cpu: bool) -> dict:
+    """Paged KV-cache decode: the density claim and what it costs.
+
+    Two halves, gated separately:
+
+    * **Density** — pure capacity arithmetic on the flagship config
+      under one HBM grant: ``max_batch_for_grant`` rows (every stream
+      billed a whole ``max_len`` KV row) vs streams admitted from
+      ``pages_for_grant`` pages when each stream pays only
+      ``pages_for(prompt + decode budget)``. Device-independent, so the
+      CPU smoke artifact still regression-checks the real scalar.
+    * **Per-stream throughput** — the paged chunk step (gathered view +
+      page-granular flush) timed at 2x the stream count of the
+      contiguous slot server. Decode is weight-read-bound at these
+      batch sizes, so the density should be ~free: per-stream tok/s
+      paged/2x vs rows/1x is gated >= PAGED_PER_STREAM_FLOOR on TPU.
+
+    The second half of the admitted mix repeats the first half's
+    prompts (same tenant), so the pool's prefix index gets exercised
+    and ``prefix`` in the result shows a real hit rate. Bit-identity
+    of paged vs contiguous emissions is pinned by tests; the bench
+    records it as a cross-check on the shapes it actually ran.
+    """
+    from tpushare.workload import model as M
+    from tpushare.workload import paging
+    from tpushare.workload import serving as S
+
+    # --- density: grant arithmetic, no device work -----------------------
+    cap_cfg = dataclasses.replace(M.ModelConfig(), remat=False)
+    grant_gib, cap_max_len, max_new = 8.0, 2048, 256
+    trace = [32, 64, 128, 128, 256, 512, 768, 1024]
+    page = paging.PAGE_TOKENS
+    rows_cap = S.max_batch_for_grant(cap_cfg, grant_gib, cap_max_len)
+    pages_total = S.pages_for_grant(cap_cfg, grant_gib)
+    admitted, pages_used, i = 0, 0, 0
+    while rows_cap:
+        lp = trace[i % len(trace)]
+        need = paging.pages_for(min(lp + max_new, cap_max_len), page)
+        if pages_used + need > pages_total:
+            break
+        pages_used, admitted, i = pages_used + need, admitted + 1, i + 1
+    density = {
+        "grant_hbm_gib": grant_gib, "max_len": cap_max_len,
+        "decode_budget": max_new, "page_tokens": page,
+        "trace": trace,
+        "whole_row_streams": rows_cap,
+        "pages_total": pages_total,
+        "paged_streams": admitted,
+        "streams_per_row_stream": (round(admitted / rows_cap, 2)
+                                   if rows_cap else None),
+    }
+    print(f"  density: {density['paged_streams']} paged vs "
+          f"{rows_cap} whole-row streams "
+          f"({density['streams_per_row_stream']}x)", file=sys.stderr)
+
+    # --- measured per-stream throughput ----------------------------------
+    cfg = dataclasses.replace(M.ModelConfig(), remat=False)
+    slots, chunk, max_len, page_tokens = 8, 64, 2048, page
+    prompt_lens = [32, 64, 128, 128, 256, 512, 768, 1024]
+    if allow_cpu:
+        cfg = M.ModelConfig().tiny()
+        slots, chunk, max_len, page_tokens = 2, 4, 32, 8
+        # 12 > page_tokens so the repeat admissions below actually hit
+        # the prefix index even in the smoke shapes.
+        prompt_lens = [4, 12]
+
+    key = jax.random.PRNGKey(0)
+    params = M.init_params(key, cfg)
+
+    def prompt_for(i: int) -> jax.Array:
+        lp = prompt_lens[i % len(prompt_lens)]
+        return jax.random.randint(
+            jax.random.fold_in(key, i % len(prompt_lens)), (lp,), 0,
+            cfg.vocab_size)
+
+    # Rows baseline: the contiguous slot server at `slots` streams.
+    state = S.init_server_state(cfg, slots, max_len)
+    for i in range(slots):
+        state = S.admit(params, state, prompt_for(i), jnp.int32(i))
+
+    @jax.jit
+    def run_rows(params, state):
+        _, emitted = S.serve_chunk(params, state, chunk)
+        return jnp.sum(emitted[-1]).astype(jnp.float32)
+
+    float(run_rows(params, state))  # compile
+    t_rows = _time_scalar_fn(run_rows, params, state, iters=20, reps=3)
+
+    # Paged server at 2x streams; the second half repeats the first
+    # half's prompts (same tenant) so prefix pages get shared.
+    pslots = slots * 2
+    pool_pages = sum(
+        paging.pages_for(
+            min(prompt_lens[i % len(prompt_lens)] + chunk, max_len),
+            page_tokens)
+        for i in range(pslots)) + 2
+    pool = paging.PagePool(pool_pages, page_tokens=page_tokens)
+    pstate = S.init_paged_state(cfg, pslots, max_len, pool_pages,
+                                page_tokens)
+    for i in range(pslots):
+        pstate = S.admit_paged(params, pstate, pool, prompt_for(i), i)
+    # Map the chunk's growth pages up front (public path): the timed
+    # region is then the compiled chunk alone on both sides — the
+    # host-side growth check does per-call readbacks that would bill
+    # the tunnel RTT, not the chip, to the paged column.
+    pstate = S.ensure_chunk_pages(pstate, pool, chunk)
+
+    @jax.jit
+    def run_paged(params, pstate):
+        _, emitted = S._serve_chunk_paged(params, pstate, chunk,
+                                          None, None)
+        return jnp.sum(emitted[-1]).astype(jnp.float32)
+
+    float(run_paged(params, pstate))  # compile
+    t_paged = _time_scalar_fn(run_paged, params, pstate, iters=20,
+                              reps=3)
+
+    # Cross-check on these exact shapes (tests pin it exhaustively):
+    # slot i of the rows server and slots i, i+slots of the paged one
+    # ran the same prompt — their emitted streams must be bit-equal.
+    _, em_rows = S.serve_chunk(params, state, chunk)
+    _, em_paged = S._serve_chunk_paged(params, pstate, chunk,
+                                       None, None)
+    er = jax.device_get(em_rows).T       # [slots, chunk]
+    ep = jax.device_get(em_paged).T      # [2*slots, chunk]
+    bit_identical = bool(
+        (er == ep[:slots]).all() and (er == ep[slots:]).all())
+
+    per_stream_rows = chunk / t_rows
+    per_stream_paged = chunk / t_paged
+    result = {
+        "density": density,
+        "streams_rows": slots, "streams_paged": pslots,
+        "chunk": chunk, "max_len": max_len,
+        "page_tokens": page_tokens,
+        "rows_chunk_ms": round(t_rows * 1e3, 2),
+        "paged_chunk_ms": round(t_paged * 1e3, 2),
+        "per_stream_tok_s_rows": round(per_stream_rows, 1),
+        "per_stream_tok_s_paged_2x": round(per_stream_paged, 1),
+        "per_stream_ratio": round(per_stream_paged / per_stream_rows,
+                                  3),
+        "aggregate_tok_s_paged": round(pslots * per_stream_paged),
+        "bit_identical": bit_identical,
+        "prefix": pool.stats(),
+    }
+    return result
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--gate", action="store_true",
@@ -573,24 +747,57 @@ def main() -> None:
     print("serving decode (continuous, mixed lengths):", file=sys.stderr)
     continuous = bench_decode_continuous(args.allow_cpu)
     print(f"  {continuous}", file=sys.stderr)
+    print("serving decode (paged KV cache):", file=sys.stderr)
+    paged = bench_decode_paged(args.allow_cpu)
+    print(f"  {paged}", file=sys.stderr)
 
     flash_mfu = train["flash"]["mfu"]
     large_mfu = large["flash"]["mfu"]
     long_l = attn.get("32768", {})
     overhead = continuous["admission_overhead_pct"]
+    speedup_8k = attn.get("8192", {}).get("speedup")
+    density = paged["density"]["streams_per_row_stream"]
+    ratio = paged["per_stream_ratio"]
+    # bench_diff-shaped gates: {value, limit, pass, gated}. ``gated``
+    # false on a CPU smoke run means "recorded, no claim" — tiny CPU
+    # shapes are dispatch-dominated and say nothing about the chip.
+    # The paged DENSITY gate stays on even off-chip: it is grant
+    # arithmetic, not a measurement, so the committed smoke artifact
+    # still regression-checks the headline scalar.
+    on_tpu = not args.allow_cpu
     gates = {
-        "flash_beats_xla_8k": bool(
-            attn.get("8192", {}).get("speedup") is not None
-            and attn["8192"]["speedup"] >= 1.0),
-        "flash_runs_32k": bool(long_l.get("flash_ms")),
-        "mfu_floor": bool(flash_mfu is not None
-                          and flash_mfu >= MFU_FLOOR),
-        "mfu_large_floor": bool(large_mfu is None  # CPU smoke: no claim
-                                or large_mfu >= MFU_LARGE_FLOOR),
-        # CPU smoke: no claim — tiny shapes are dispatch-dominated and
-        # say nothing about the TPU's HBM-bound decode step.
-        "continuous_admission_overhead": bool(
-            args.allow_cpu or overhead <= ADMISSION_OVERHEAD_MAX_PCT),
+        "flash_beats_xla_8k": {
+            "value": speedup_8k, "limit": 1.0,
+            "pass": bool(speedup_8k is not None and speedup_8k >= 1.0),
+            "gated": on_tpu},
+        # Capability gate (the XLA path cannot run 32k at all): no
+        # drift direction, so limit stays null and bench_diff skips it.
+        "flash_runs_32k": {
+            "value": long_l.get("flash_ms"), "limit": None,
+            "pass": bool(long_l.get("flash_ms")), "gated": on_tpu},
+        "mfu_floor": {
+            "value": flash_mfu, "limit": MFU_FLOOR,
+            "pass": bool(flash_mfu is not None
+                         and flash_mfu >= MFU_FLOOR),
+            "gated": on_tpu},
+        "mfu_large_floor": {
+            "value": large_mfu, "limit": MFU_LARGE_FLOOR,
+            "pass": bool(large_mfu is not None
+                         and large_mfu >= MFU_LARGE_FLOOR),
+            "gated": on_tpu},
+        "continuous_admission_overhead": {
+            "value": overhead, "limit": ADMISSION_OVERHEAD_MAX_PCT,
+            "pass": bool(overhead <= ADMISSION_OVERHEAD_MAX_PCT),
+            "gated": on_tpu},
+        "paged_density": {
+            "value": density, "limit": PAGED_DENSITY_FLOOR,
+            "pass": bool(density is not None
+                         and density >= PAGED_DENSITY_FLOOR),
+            "gated": True},
+        "paged_per_stream_tok_s": {
+            "value": ratio, "limit": PAGED_PER_STREAM_FLOOR,
+            "pass": bool(ratio >= PAGED_PER_STREAM_FLOOR),
+            "gated": on_tpu},
     }
     doc = {
         "metric": "workload_perf",
@@ -613,11 +820,13 @@ def main() -> None:
         "train_step_large": large,
         "serving_decode": serving,
         "serving_continuous": continuous,
+        "paged_decode": paged,
         "gates": gates,
     }
     print(json.dumps(doc))
-    if args.gate and not all(gates.values()):
-        failed = [k for k, v in gates.items() if not v]
+    failed = [k for k, g in gates.items()
+              if g["gated"] and not g["pass"]]
+    if args.gate and failed:
         print(f"bench_workload: GATE FAILURE: {failed}", file=sys.stderr)
         sys.exit(1)
 

@@ -462,6 +462,25 @@ def test_serving_doc_covers_the_contract():
         assert needle in doc, needle
 
 
+def test_serving_doc_covers_paged_kv():
+    """The paged-KV section is part of the serving contract: page math
+    and capacity arithmetic, the prefix-reuse/isolation semantics, the
+    bench gates with their artifacts, and the pool-exhaustion runbook
+    entry must all stay pinned."""
+    with open(SERVING_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("Paged KV cache", "pages_for_grant", "admit_paged",
+                   "serve_chunk_paged", "TPUSHARE_KV_PAGE",
+                   "shareable_pages", "PagePool", "PoolExhausted",
+                   "bit-identical", "copy-on-write",
+                   "paged_density", "paged_per_stream_tok_s",
+                   "paged_sheds_later", "prefix_key",
+                   "BENCH_WORKLOAD_r09.json", "BENCH_ROUTER_r02.json",
+                   "tpushare_router_pages_free",
+                   "tpushare_router_prefix_hit_rate"):
+        assert needle in doc, needle
+
+
 def test_serving_doc_is_linked():
     """observability.md (the catalogue), the README, and the user
     guide must keep pointing at the serving contract."""

@@ -1,0 +1,119 @@
+"""Unit tests for the host-side paged-KV allocator
+(:mod:`tpushare.workload.paging`): page math, chain hashing, lease
+lifecycle (no leaks), and the tenant isolation of the prefix index.
+All jax-free — the pool is control-plane bookkeeping."""
+
+import pytest
+
+from tpushare.workload import paging as P
+
+
+def test_pages_for_ceil():
+    assert P.pages_for(0, 4) == 0
+    assert P.pages_for(-3, 4) == 0
+    assert P.pages_for(1, 4) == 1
+    assert P.pages_for(4, 4) == 1
+    assert P.pages_for(5, 4) == 2
+    assert P.pages_for(8, 4) == 2
+    with pytest.raises(ValueError, match="page_tokens"):
+        P.pages_for(4, 0)
+
+
+def test_shareable_pages_excludes_last_token_page():
+    # The page holding position true_len - 1 is always re-run (it
+    # recomputes the first-token hidden state), so it never shares.
+    assert P.shareable_pages(0, 4) == 0
+    assert P.shareable_pages(1, 4) == 0
+    assert P.shareable_pages(4, 4) == 0   # last token IS page 0
+    assert P.shareable_pages(5, 4) == 1
+    assert P.shareable_pages(8, 4) == 1
+    assert P.shareable_pages(9, 4) == 2
+
+
+def test_prefix_hashes_chain_and_tenant_seed():
+    toks = list(range(20))
+    h1 = P.prefix_hashes("a", toks, 20, 4)
+    assert len(h1) == P.shareable_pages(20, 4) == 4
+    # Chain property: equal leading pages, equal leading hashes; a
+    # diverged page changes ITS hash and every later one.
+    toks2 = list(toks)
+    toks2[6] = 99  # inside page 1
+    h2 = P.prefix_hashes("a", toks2, 20, 4)
+    assert h1[0] == h2[0]
+    assert all(a != b for a, b in zip(h1[1:], h2[1:]))
+    # Tenant seeding: byte-identical prompts never collide across
+    # tenants.
+    hb = P.prefix_hashes("b", toks, 20, 4)
+    assert all(a != b for a, b in zip(h1, hb))
+
+
+def test_admit_release_no_leak():
+    pool = P.PagePool(8, page_tokens=4)
+    toks = list(range(10))
+    for _ in range(5):  # cycles: release must return EVERY page
+        lease = pool.admit("s0", "t", toks, 10)
+        assert len(lease.pages) == 3 and lease.shared == 0
+        assert pool.pages_free() == 5
+        assert pool.grow("s0", 2) and pool.pages_free() == 3
+        assert pool.release("s0") == 5
+        assert pool.pages_free() == 8
+    assert pool.release("s0") == 0  # idempotent
+
+
+def test_prefix_sharing_refcounts():
+    pool = P.PagePool(8, page_tokens=4)
+    toks = list(range(10))  # 3 pages, 2 shareable
+    a = pool.admit("a", "t", toks, 10)
+    b = pool.admit("b", "t", toks, 10)
+    assert b.shared == 2
+    assert b.pages[:2] == a.pages[:2]     # physical reuse
+    assert b.pages[2] != a.pages[2]       # private last pages
+    assert pool.pages_free() == 8 - 4     # 3 + 1, not 6
+    assert pool.refcount(a.pages[0]) == 2
+    st = pool.stats()
+    assert st["prefixHits"] == 2 and st["prefixMisses"] == 2
+    assert st["prefixHitRate"] == 0.5
+    # First holder leaves: shared pages stay resident for b.
+    assert pool.release("a") == 1         # only a's private page
+    assert pool.refcount(b.pages[0]) == 1
+    assert pool.release("b") == 3
+    assert pool.pages_free() == 8
+    assert pool.stats()["indexedPages"] == 0
+
+
+def test_no_sharing_across_tenants():
+    pool = P.PagePool(8, page_tokens=4)
+    toks = list(range(10))
+    a = pool.admit("a", "tenant-a", toks, 10)
+    b = pool.admit("b", "tenant-b", toks, 10)
+    assert b.shared == 0
+    assert not set(a.pages) & set(b.pages)
+    assert pool.stats()["prefixHits"] == 0
+
+
+def test_exhaustion_allocates_nothing():
+    pool = P.PagePool(4, page_tokens=4)
+    pool.admit("a", "t", list(range(12)), 12)  # 3 of 4 pages
+    free = pool.pages_free()
+    with pytest.raises(P.PoolExhausted):
+        pool.admit("b", "t2", list(range(8)), 8)
+    assert pool.pages_free() == free          # nothing leaked
+    assert pool.held("b") == ()
+    with pytest.raises(P.PoolExhausted):
+        pool.grow("a", 2)
+    assert pool.pages_free() == free
+
+
+def test_admit_validation():
+    pool = P.PagePool(4, page_tokens=4)
+    with pytest.raises(ValueError, match="true_len"):
+        pool.admit("a", "t", [], 0)
+    with pytest.raises(ValueError, match="shorter"):
+        pool.admit("a", "t", [1, 2], 3)
+    pool.admit("a", "t", [1, 2], 2)
+    with pytest.raises(ValueError, match="already holds"):
+        pool.admit("a", "t", [1, 2], 2)
+    with pytest.raises(ValueError, match="no lease"):
+        pool.grow("ghost", 1)
+    with pytest.raises(ValueError, match="total_pages"):
+        P.PagePool(0, page_tokens=4)

@@ -290,6 +290,129 @@ class TestRoutingPolicy:
             DecodeReplica("bad", slots=0)
 
 
+class TestPagedRouting:
+    """Pages as the routing currency: rows-mode replicas derive
+    pages_free from free slots (one unit across mixed fleets), paged
+    replicas charge per-request page reservations with shared-prefix
+    discounts, and every page returns at retirement."""
+
+    def test_rows_mode_derives_pages_from_slots(self):
+        rep = DecodeReplica("r0", slots=2, max_len=2048, page_tokens=64)
+        row_pages = 2048 // 64
+        assert rep.pages_total_effective() == 2 * row_pages
+        assert rep.pages_free() == 2 * row_pages
+        router, _ = make_router()
+        router.add_replica(rep)
+        router.submit("chat", 64, 16)
+        assert rep.pages_free() == 1 * row_pages  # a slot IS a row
+
+    def test_paged_admission_charges_true_length_and_retires(self):
+        router, clock = make_router()
+        rep = DecodeReplica("p0", slots=4, max_len=2048,
+                            page_tokens=64, pages_total=100,
+                            decode_tok_s=1000.0, prefill_tok_s=1e9)
+        router.add_replica(rep)
+        dec = router.submit("chat", prompt_len=100, max_new=28)
+        assert dec["outcome"] == "assigned"
+        # reservation: ceil(min(100+28, 2048) / 64) = 2 pages, not 32
+        assert rep.pages_free() == 98
+        clock.advance(1.0)
+        router.tick()  # 28 tokens at 250 tok/s/slot retire well inside
+        assert rep.pages_free() == 100  # every page returned
+
+    def test_paged_replica_full_pages_queues_despite_free_slots(self):
+        router, _ = make_router()
+        rep = DecodeReplica("p0", slots=4, max_len=2048,
+                            page_tokens=64, pages_total=6)
+        router.add_replica(rep)
+        assert router.submit("chat", 100, 28)["outcome"] == "assigned"
+        assert router.submit("chat", 100, 28)["outcome"] == "assigned"
+        assert rep.free_slots() == 2            # slots remain...
+        assert rep.pages_free() == 2            # ...pages do not
+        dec = router.submit("chat", 200, 56)    # needs 4 pages
+        assert dec["outcome"] == "queued"
+
+    def test_routes_to_most_pages_free(self):
+        router, _ = make_router()
+        a = DecodeReplica("a", slots=4, max_len=2048, page_tokens=64,
+                          pages_total=10)
+        b = DecodeReplica("b", slots=4, max_len=2048, page_tokens=64,
+                          pages_total=100)
+        router.add_replica(a)
+        router.add_replica(b)
+        dec = router.submit("chat", 64, 16)
+        assert dec["replica"] == "b"
+
+    def test_prefix_sharing_charged_once_and_counted(self):
+        router, clock = make_router()
+        rep = DecodeReplica("p0", slots=4, max_len=2048,
+                            page_tokens=64, pages_total=100,
+                            decode_tok_s=1000.0, prefill_tok_s=1e9)
+        router.add_replica(rep)
+        # 256-token system preamble = 4 shareable pages; each request
+        # reserves ceil((300+84)/64) = 6 pages total.
+        d1 = router.submit("chat", 300, 84, prefix_key="sys",
+                           prefix_len=256)
+        assert d1["outcome"] == "assigned"
+        assert rep.pages_free() == 94
+        d2 = router.submit("chat", 300, 84, prefix_key="sys",
+                           prefix_len=256)
+        assert d2["outcome"] == "assigned"
+        # second holder pays only its private tail: 6 - 4 shared
+        assert rep.pages_free() == 92
+        snap = router.snapshot()
+        assert snap["prefix"] == {"hits": 1, "misses": 1,
+                                  "hitRate": 0.5}
+        # ANOTHER tenant with the same key shares nothing
+        d3 = router.submit("other", 300, 84, prefix_key="sys",
+                           prefix_len=256)
+        assert rep.pages_free() == 86
+        # all retire: the prefix entry's pages return with the last
+        # holder, the ledger is clean
+        clock.advance(5.0)
+        router.tick()
+        assert rep.pages_free() == 100
+
+    def test_snapshot_and_scaleout_carry_pages(self):
+        router, _ = make_router()
+        router.add_replica(DecodeReplica(
+            "p0", slots=4, max_len=2048, page_tokens=64,
+            pages_total=100))
+        router.submit("chat", 100, 28)
+        snap = router.snapshot()
+        rep = snap["replicas"][0]
+        assert rep["paged"] is True
+        assert rep["pageTokens"] == 64
+        assert rep["pagesTotal"] == 100 and rep["pagesFree"] == 98
+        assert snap["fleetPages"] == 100
+        assert snap["fleetPagesFree"] == 98
+        spec = router.scaleout_spec()
+        assert spec["pageTokens"] == 64 and spec["pagesTotal"] == 100
+
+    def test_replica_validates_paged_args(self):
+        with pytest.raises(ValueError, match="pages_total"):
+            DecodeReplica("bad", slots=2, pages_total=0)
+        with pytest.raises(ValueError, match="page_tokens"):
+            DecodeReplica("bad", slots=2, page_tokens=0)
+
+    def test_from_grant_paged_doubles_slots_and_prices_pages(self):
+        from tpushare.runtime.jaxenv import ShareGrant
+        from tpushare.workload import model as M
+        from tpushare.workload import serving as S
+
+        grant = ShareGrant(chip_ids=(0,), hbm_pod_gib=8,
+                           hbm_chip_gib=16)
+        rows = DecodeReplica.from_grant("r", grant, max_len=2048)
+        paged = DecodeReplica.from_grant("p", grant, max_len=2048,
+                                         paged=True)
+        assert paged.slots == 2 * rows.slots
+        assert paged.pages_total == S.pages_for_grant(
+            M.ModelConfig(), 8)
+        # Same grant prices >= the row fleet's page budget: the density
+        # comes from billing true lengths, not from extra HBM.
+        assert paged.pages_total >= rows.slots * (2048 // 64)
+
+
 class TestServingIntegration:
     def test_prompt_buckets_mirror_serving(self):
         """The router's control-plane bucket table must equal the slot

@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import pytest
 
 from tpushare.workload import model as M
+from tpushare.workload import paging
 from tpushare.workload import serving as S
 
 
@@ -708,6 +709,156 @@ class TestChunkedPrefill:
             S.bucket_len(10, (8, 16), max_len=9)
         # padding TO the cache still works at the boundary
         assert S.bucket_len(9, (8, 16), max_len=9) == 9
+        # Regression: a prompt past EVERY bucket but within the cache
+        # pads to max_len instead of raising — the cache is the final
+        # bucket. Covers both a top bucket above max_len (16 > 12) and
+        # below it (16 < 24), and the prompt-exactly-max_len corner.
+        assert S.bucket_len(12, (8, 16), max_len=12) == 12
+        assert S.bucket_len(20, (8, 16), max_len=24) == 24
+        assert S.bucket_len(24, (8, 16), max_len=24) == 24
         padded, tl = S.pad_to_bucket(jnp.arange(5, dtype=jnp.int32),
                                      (8, 16))
         assert padded.shape == (8,) and int(tl) == 5
+        # pad_to_bucket rides the same fallback (no negative pad).
+        padded, tl = S.pad_to_bucket(jnp.arange(20, dtype=jnp.int32),
+                                     (8, 16), max_len=24)
+        assert padded.shape == (24,) and int(tl) == 20
+
+
+class TestPagedKV:
+    """Paged KV cache: the pool + page-table server must be a pure
+    MEMORY-LAYOUT change — every emitted token bit-identical to the
+    contiguous slot server — while prefix sharing stays inside a
+    tenant and release returns every page."""
+
+    PAGE = 4
+    MAX_LEN = 32
+
+    def _paged(self, cfg, slots, total_pages=16):
+        pool = paging.PagePool(total_pages, page_tokens=self.PAGE)
+        st = S.init_paged_state(cfg, slots, self.MAX_LEN, total_pages,
+                                self.PAGE)
+        return st, pool
+
+    def test_paged_decode_bit_identical_to_contiguous(self, setup):
+        """Mixed-length admissions, decode across page boundaries:
+        first tokens and every chunk emission match the contiguous
+        server exactly."""
+        cfg, params, _ = setup
+        key = jax.random.PRNGKey(80)
+        prompts = [jax.random.randint(jax.random.fold_in(key, i),
+                                      (lp,), 0, cfg.vocab_size)
+                   for i, lp in enumerate((3, 6, 11))]
+
+        st_r = S.init_server_state(cfg, 3, self.MAX_LEN)
+        st_p, pool = self._paged(cfg, 3, total_pages=24)
+        for i, p in enumerate(prompts):
+            st_r = S.admit(params, st_r, p, jnp.int32(i))
+            st_p = S.admit_paged(params, st_p, pool, p, i)
+            assert int(st_p["pos"][i]) == int(st_r["pos"][i])
+            assert int(st_p["token"][i]) == int(st_r["token"][i])
+        for _ in range(3):  # 15 steps: every stream crosses pages
+            st_r, em_r = S.serve_chunk(params, st_r, 5)
+            st_p, em_p = S.serve_chunk_paged(params, st_p, pool, 5)
+            assert (jax.device_get(em_r) == jax.device_get(em_p)).all()
+
+    def test_prefix_shared_stream_bit_identical(self, setup):
+        """A second same-tenant stream reusing prefix pages (never
+        re-prefilled) still emits the identical stream — shared pages
+        hold bit-equal K/V by the chain-hash contract."""
+        cfg, params, _ = setup
+        prompt = jax.random.randint(jax.random.PRNGKey(81), (9,), 0,
+                                    cfg.vocab_size)
+        st, pool = self._paged(cfg, 2)
+        st = S.admit_paged(params, st, pool, prompt, 0, tenant="t")
+        st = S.admit_paged(params, st, pool, prompt, 1, tenant="t")
+        assert pool.stats()["prefixHits"] == paging.shareable_pages(
+            9, self.PAGE) > 0
+        assert int(st["token"][0]) == int(st["token"][1])
+        st, em = S.serve_chunk_paged(params, st, pool, 6)
+        em = jax.device_get(em)
+        assert (em[:, 0] == em[:, 1]).all()
+        # and both match the solo contiguous run
+        out = S.generate(params, prompt[None, :], cfg, n_new=7,
+                         max_len=self.MAX_LEN)
+        want = [int(t) for t in out[0, 9:]]
+        assert [int(st["token"][0])] + [int(t) for t in em[:, 0]] == want
+
+    def test_cross_tenant_isolation(self, setup):
+        """Byte-identical prompts under DIFFERENT tenants share zero
+        pages — the prefix index is tenant-scoped end to end."""
+        cfg, params, _ = setup
+        prompt = jax.random.randint(jax.random.PRNGKey(82), (9,), 0,
+                                    cfg.vocab_size)
+        st, pool = self._paged(cfg, 2)
+        st = S.admit_paged(params, st, pool, prompt, 0, tenant="a")
+        st = S.admit_paged(params, st, pool, prompt, 1, tenant="b")
+        assert not set(pool.held("slot0")) & set(pool.held("slot1"))
+        assert pool.stats()["prefixHits"] == 0
+        assert pool.stats()["sharedPages"] == 0
+
+    def test_page_lifecycle_no_leak(self, setup):
+        """admit -> decode growth across a page boundary -> release,
+        repeated: the pool ends every cycle with all pages free and
+        the table row unmapped."""
+        cfg, params, _ = setup
+        st, pool = self._paged(cfg, 1)
+        total = pool.total_pages
+        prompt = jax.random.randint(jax.random.PRNGKey(83), (6,), 0,
+                                    cfg.vocab_size)
+        for cycle in range(3):
+            st = S.admit_paged(params, st, pool, prompt, 0)
+            held0 = len(pool.held("slot0"))
+            assert held0 == paging.pages_for(6, self.PAGE) == 2
+            st, _ = S.serve_chunk_paged(params, st, pool, 5)
+            # pos 11 needs 3 pages: decode growth allocated one
+            assert len(pool.held("slot0")) == 3, cycle
+            assert int((st["table"][0] >= 0).sum()) == 3
+            st = S.release_paged(st, pool, 0)
+            assert pool.pages_free() == total, cycle
+            assert int((st["table"][0] >= 0).sum()) == 0
+            assert not bool(st["active"][0])
+
+    def test_admit_paged_failure_releases_lease(self, setup):
+        """A prompt too long for the cache fails validation AFTER the
+        lease exists — the lease must be rolled back, not leaked."""
+        cfg, params, _ = setup
+        st, pool = self._paged(cfg, 1)
+        with pytest.raises(ValueError):
+            S.admit_paged(params, st, pool,
+                          jnp.arange(self.MAX_LEN, dtype=jnp.int32), 0)
+        assert pool.pages_free() == pool.total_pages
+        # exhaustion surfaces as PoolExhausted, nothing allocated
+        tiny = paging.PagePool(1, page_tokens=self.PAGE)
+        st2 = S.init_paged_state(cfg, 1, self.MAX_LEN, 1, self.PAGE)
+        with pytest.raises(paging.PoolExhausted):
+            S.admit_paged(params, st2, tiny,
+                          jnp.arange(9, dtype=jnp.int32), 0)
+        assert tiny.pages_free() == 1
+
+    def test_pool_state_mismatch_rejected(self, setup):
+        cfg, params, _ = setup
+        st, _ = self._paged(cfg, 1)
+        other = paging.PagePool(16, page_tokens=self.PAGE * 2)
+        with pytest.raises(ValueError, match="page_tokens"):
+            S.admit_paged(params, st, other,
+                          jnp.arange(5, dtype=jnp.int32), 0)
+        with pytest.raises(ValueError, match="multiple"):
+            S.init_paged_state(cfg, 1, 30, 8, self.PAGE)
+
+    def test_pages_for_grant_arithmetic(self, setup):
+        """The paged twin prices the same post-weights budget in pages:
+        at least rows * (max_len/page) pages, plus the remainder a
+        whole-row split strands."""
+        cfg, _, _ = setup
+        grant = 0.001  # ~1 MiB: tiny config weights fit well under
+        rows = S.max_batch_for_grant(cfg, grant, self.MAX_LEN)
+        pages = S.pages_for_grant(cfg, grant, self.PAGE)
+        assert rows > 0
+        row_pages = self.MAX_LEN // self.PAGE
+        assert pages >= rows * row_pages
+        assert pages < (rows + 1) * row_pages + row_pages
+        # no grant -> no pages, same contract as the row helper
+        assert S.pages_for_grant(cfg, 0.0, self.PAGE) == 0
+        with pytest.raises(ValueError, match="page_tokens"):
+            S.pages_for_grant(cfg, 1.0, 0)

@@ -881,6 +881,14 @@ def render_serving(doc: dict) -> str:
         f"{doc.get('fleetTokensPerS', 0.0):g} tok/s, "
         f"TTFT p50/p99 {pctl(doc.get('ttft'))}",
     ]
+    if "fleetPages" in doc:
+        prefix = doc.get("prefix") or {}
+        rate = prefix.get("hitRate")
+        lines.append(
+            f"kv pages: {doc.get('fleetPagesFree', 0)}/"
+            f"{doc['fleetPages']} free, prefix hits "
+            f"{prefix.get('hits', 0)}/misses {prefix.get('misses', 0)}"
+            + (f" (hit rate {rate:.0%})" if rate is not None else ""))
     tenants = doc.get("tenants") or {}
     if tenants:
         rows = [["TENANT", "REQS", "INFLIGHT", "QUEUED", "SHED",
@@ -900,11 +908,16 @@ def render_serving(doc: dict) -> str:
     if reps:
         lines.append("")
         rows = [["REPLICA", "NODE", "SLOTS", "IN USE", "HBM GiB",
-                 "DECODE tok/s"]]
+                 "DECODE tok/s", "PAGES FREE"]]
         for r in reps:
+            total = r.get("pagesTotal")
+            pages = (f"{r.get('pagesFree', 0)}/{total}"
+                     + ("" if r.get("paged") else " (rows)")
+                     if total is not None else "-")
             rows.append([r["name"], r.get("node") or "-",
                          str(r["slots"]), str(r["inUse"]),
-                         f"{r['hbmGiB']:g}", f"{r['decodeTokS']:g}"])
+                         f"{r['hbmGiB']:g}", f"{r['decodeTokS']:g}",
+                         pages])
         widths = [max(len(row[i]) for row in rows)
                   for i in range(len(rows[0]))]
         lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip()
@@ -913,10 +926,14 @@ def render_serving(doc: dict) -> str:
     lines.append("")
     state = "WANTED" if so.get("wanted") else "quiet"
     spec = so.get("spec") or {}
+    shape = (f"next replica shape: {spec.get('hbmGiB', '?')} GiB, "
+             f"max_len {spec.get('maxLen', '?')}")
+    if spec.get("pagesTotal") is not None:
+        shape += (f", {spec['pagesTotal']} pages of "
+                  f"{spec.get('pageTokens', '?')} tokens")
     lines.append(
         f"scale-out: {state}, {so.get('signals', 0)} signal(s) raised "
-        f"(next replica shape: {spec.get('hbmGiB', '?')} GiB, "
-        f"max_len {spec.get('maxLen', '?')})")
+        f"({shape})")
     lines.append("")
     lines.append("SHED = requests refused (429): over quota standing on "
                  "a saturated fleet, or the fleet queue is full. A "

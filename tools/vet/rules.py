@@ -118,6 +118,12 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
     # lock-free — GIL-atomic deque appends on the gated hot path.)
     "TimelineRecorder": ("_series", "_sources"),
     "AnomalyEngine": ("_fired", "_event_at"),
+    # The paged-KV allocator (tpushare/workload/paging.py): admissions
+    # and releases come from serving/router threads while the stats
+    # snapshot is read by the scrape — free list, refcounts, and the
+    # prefix index move together under one lock.
+    "PagePool": ("_free", "_refs", "_index", "_page_key", "_leases",
+                 "_hits", "_misses"),
 }
 
 #: Method calls that mutate a dict/set/list in place.

@@ -24,7 +24,8 @@ CORE_PACKAGES = ("tpushare/cache/", "tpushare/scheduler/",
                  "tpushare/slo/", "tpushare/defrag/",
                  "tpushare/autoscale/", "tpushare/profiling/",
                  "tpushare/router/", "tpushare/topology/",
-                 "tpushare/obs/", "tpushare/k8s/eviction.py")
+                 "tpushare/obs/", "tpushare/k8s/eviction.py",
+                 "tpushare/workload/paging.py")
 
 #: Parameter names exempt from annotation (bound implicitly).
 _IMPLICIT = {"self", "cls"}

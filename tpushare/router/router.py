@@ -5,11 +5,13 @@ The decode fleet is the co-tenancy payoff: many low-HBM slot servers
 by its scheduler grant (``max_batch_for_grant``). This module is the
 front door that makes those servers a SERVICE:
 
-* **Routing** — a request lands on the replica with the most free slots
-  (= the most KV-cache HBM headroom: a replica's slot count IS its
-  grant divided by the per-sequence cache cost, see
-  :meth:`DecodeReplica.from_grant`), queue depth breaking ties. A full
-  fleet queues the request on the shortest queue.
+* **Routing** — a request lands on the replica with the most free KV
+  PAGES that can hold its whole reservation (paged replicas track a
+  real page pool — ``serving.pages_for_grant`` over the grant — and a
+  live shared prefix discounts the charge; rows-mode replicas derive
+  pages from free slots, so mixed fleets rank in one unit), free
+  slots then name breaking ties. A full fleet queues the request on
+  the fleet-wide FIFO.
 * **Shedding** — when the fleet is saturated, tenants holding more than
   their quota-derived share of the fleet's slots are shed (HTTP-429
   semantics), everyone else queues. Standing comes from the SAME
@@ -50,16 +52,17 @@ from typing import TYPE_CHECKING, Callable, Deque, Iterable
 
 from tpushare import obs
 from tpushare.utils import locks, stats
+from tpushare.workload.paging import PROMPT_BUCKETS, pages_for
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from tpushare.quota.manager import QuotaManager
     from tpushare.runtime.jaxenv import ShareGrant
 
-#: Mirror of ``serving.PROMPT_BUCKETS`` — the router pads prompt
-#: lengths to the same admission buckets the slot server compiles for,
-#: without importing the jax-heavy workload module into the control
-#: plane (tests cross-check the two stay equal).
-PROMPT_BUCKETS: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048)
+# PROMPT_BUCKETS is imported from tpushare.workload.paging — the
+# jax-free single source the slot server re-exports — so the router
+# pads prompt lengths to the exact admission buckets the server
+# compiles for without importing the jax-heavy workload module into
+# the control plane (the cross-check test stays as the tripwire).
 
 #: Rolling-window sizes.
 TTFT_WINDOW = 512          #: TTFT samples kept per tenant and fleet-wide
@@ -95,6 +98,17 @@ class Request:
     prefill_remaining: float = 0.0
     #: Decode progress in tokens (float: rate-integrated).
     progress: float = 0.0
+    #: KV pages charged to THIS request on its replica (private tail;
+    #: shared prefix pages are charged to the prefix entry once).
+    pages: int = 0
+    #: Opaque caller-declared prompt-prefix identity (e.g. the chain
+    #: hash of the system prompt). Empty = no sharing.
+    prefix_key: str = ""
+    #: Token length of the declared shared prefix.
+    prefix_len: int = 0
+    #: Whether this request holds a refcount on its replica's live
+    #: prefix entry (set at admit, consumed at retire).
+    holds_prefix: bool = False
 
     @property
     def ttft(self) -> float | None:
@@ -132,9 +146,17 @@ class DecodeReplica:
                  hbm_gib: float = 0.0, max_len: int = 2048,
                  decode_tok_s: float = 8400.0,
                  prefill_tok_s: float = 200_000.0,
-                 admission_overhead: float = 0.10) -> None:
+                 admission_overhead: float = 0.10,
+                 page_tokens: int = 64,
+                 pages_total: int | None = None) -> None:
         if slots <= 0:
             raise ValueError(f"replica {name}: slots must be > 0")
+        if page_tokens <= 0:
+            raise ValueError(
+                f"replica {name}: page_tokens must be > 0")
+        if pages_total is not None and pages_total <= 0:
+            raise ValueError(
+                f"replica {name}: pages_total must be > 0 when paged")
         self.name = name
         self.node = node
         self.slots = slots
@@ -143,20 +165,38 @@ class DecodeReplica:
         self.decode_tok_s = decode_tok_s
         self.prefill_tok_s = prefill_tok_s
         self.admission_overhead = min(max(admission_overhead, 0.0), 1.0)
+        #: Paged-KV capacity: ``pages_total`` not None means the pod
+        #: runs the paged server (``serving.init_paged_state``) and
+        #: HBM buys PAGES (``serving.pages_for_grant``); ``slots`` is
+        #: then only the compiled batch ceiling. None = rows mode:
+        #: every stream costs a whole [max_len] row and page figures
+        #: are derived so mixed fleets compare in one unit.
+        self.page_tokens = page_tokens
+        self.pages_total = pages_total
         #: Owned by the Router (mutated only under its lock).
         self.inflight: list[Request] = []
         self._now: float | None = None
+        self._pages_used = 0
+        #: (tenant, prefix_key) -> [holders, shared pages] for live
+        #: shared prefixes (charged once, refcounted by holders).
+        self._prefix_live: dict[tuple[str, str], list[int]] = {}
 
     @classmethod
     def from_grant(cls, name: str, grant: "ShareGrant", *,
                    node: str = "", max_len: int = 2048,
-                   cfg: object | None = None,
+                   cfg: object | None = None, paged: bool = False,
+                   page_tokens: int = 64,
                    **kw: float) -> "DecodeReplica":
         """Size a replica from its scheduler HBM grant: slots =
         ``serving.max_batch_for_grant`` (weights once, then one KV-cache
-        row per concurrent sequence). Imports the jax-backed workload
-        module lazily — control-plane callers that already know their
-        slot count use the constructor directly."""
+        row per concurrent sequence). ``paged=True`` sizes the same
+        grant in PAGES instead (``serving.pages_for_grant``) and doubles
+        the slot ceiling — pages are then the binding capacity, and the
+        extra slots are what lets a mixed-length trace actually use
+        them (bench_workload's ``paged_decode`` density gate). Imports
+        the jax-backed workload module lazily — control-plane callers
+        that already know their capacity use the constructor
+        directly."""
         from tpushare.workload import model as M
         from tpushare.workload import serving as S
 
@@ -168,6 +208,14 @@ class DecodeReplica:
                 f"replica {name}: grant {grant.hbm_pod_gib} GiB cannot "
                 "hold the model weights — ask the scheduler for a "
                 "bigger slice")
+        if paged:
+            pages = S.pages_for_grant(model_cfg, grant.hbm_pod_gib,
+                                      page_tokens=page_tokens)
+            return cls(name, slots=2 * slots, node=node,
+                       hbm_gib=float(grant.hbm_pod_gib),
+                       max_len=max_len, page_tokens=page_tokens,
+                       pages_total=pages,
+                       **kw)  # type: ignore[arg-type]
         return cls(name, slots=slots, node=node,
                    hbm_gib=float(grant.hbm_pod_gib), max_len=max_len,
                    **kw)  # type: ignore[arg-type]
@@ -177,16 +225,119 @@ class DecodeReplica:
     def free_slots(self) -> int:
         return self.slots - len(self.inflight)
 
-    def admit(self, req: Request, now: float) -> None:
+    def _row_pages(self) -> int:
+        """Pages one whole [max_len] row is worth (the rows-mode
+        exchange rate, so mixed fleets compare in one unit)."""
+        return pages_for(self.max_len, self.page_tokens)
+
+    def pages_total_effective(self) -> int:
+        if self.pages_total is not None:
+            return self.pages_total
+        return self.slots * self._row_pages()
+
+    def pages_free(self) -> int:
+        """The routing signal: KV pages this replica can still grant.
+        Rows mode derives it from free slots (a free slot IS a free
+        row of pages), so pages-first routing ranks a mixed fleet
+        consistently."""
+        if self.pages_total is None:
+            return self.free_slots() * self._row_pages()
+        return self.pages_total - self._pages_used
+
+    def _page_need(self, req: Request) -> int:
+        """Pages admitting ``req`` would charge: the full reservation
+        ``prompt + max_new`` (capped at the cache) minus any live
+        same-tenant shared prefix — no preemption mid-stream, so the
+        reservation is up-front."""
+        if self.pages_total is None:
+            return self._row_pages()
+        need = pages_for(min(req.prompt_len + req.max_new,
+                             self.max_len), self.page_tokens)
+        need = max(need, 1)
+        if req.prefix_key:
+            live = self._prefix_live.get((req.tenant, req.prefix_key))
+            if live is not None:
+                need = max(need - live[1], 1)
+        return need
+
+    def can_admit(self, req: Request) -> bool:
+        """A slot below the compiled ceiling AND pages for the full
+        reservation (rows mode: the page check is trivially the slot
+        check)."""
+        if self.free_slots() <= 0:
+            return False
+        if self.pages_total is None:
+            return True
+        return self._page_need(req) <= self.pages_free()
+
+    def admit(self, req: Request, now: float) -> bool:
         """Place ``req`` into a free slot; its prefill starts queueing
-        behind earlier admissions (serial FIFO, like the slot server)."""
+        behind earlier admissions (serial FIFO, like the slot server).
+        Returns True when the admission reused a live shared prefix
+        (the router's prefix-hit counter)."""
         req.replica = self.name
         req.admitted_at = now
         req.prefill_remaining = float(req.bucket)
         req.progress = 0.0
+        hit = self._charge_pages(req)
         self.inflight.append(req)
         if self._now is None:
             self._now = now
+        return hit
+
+    def _charge_pages(self, req: Request) -> bool:
+        """Page accounting at admit: shared prefix pages are charged
+        ONCE to the live prefix entry (holders refcounted, the
+        PagePool's model); the private tail is charged to the
+        request."""
+        if self.pages_total is None:
+            req.pages = 0
+            return False
+        need_total = max(pages_for(min(req.prompt_len + req.max_new,
+                                       self.max_len),
+                                   self.page_tokens), 1)
+        hit = False
+        if req.prefix_key:
+            # Shareable = FULL pages strictly below the last prompt
+            # token (paging.shareable_pages semantics).
+            shared = min(req.prefix_len,
+                         max(req.prompt_len - 1, 0)) // self.page_tokens
+            shared = min(shared, need_total - 1)
+            key = (req.tenant, req.prefix_key)
+            live = self._prefix_live.get(key)
+            if shared > 0 and live is not None:
+                live[0] += 1
+                req.pages = need_total - min(shared, live[1])
+                req.holds_prefix = True
+                hit = True
+            elif shared > 0:
+                self._prefix_live[key] = [1, shared]
+                self._pages_used += shared
+                req.pages = need_total - shared
+                req.holds_prefix = True
+            else:
+                req.pages = need_total
+        else:
+            req.pages = need_total
+        self._pages_used += req.pages
+        return hit
+
+    def _retire_pages(self, req: Request) -> None:
+        """Return a retiring request's page charge; the last holder of
+        a shared prefix returns the prefix pages too."""
+        if self.pages_total is None:
+            return
+        self._pages_used -= req.pages
+        req.pages = 0
+        if req.holds_prefix:
+            req.holds_prefix = False
+            key = (req.tenant, req.prefix_key)
+            live = self._prefix_live.get(key)
+            if live is not None:
+                live[0] -= 1
+                if live[0] <= 0:
+                    self._pages_used -= live[1]
+                    del self._prefix_live[key]
 
     def advance(self, now: float) -> tuple[list[ReplicaEvent], float]:
         """Integrate the service model up to ``now``. Returns (events,
@@ -268,6 +419,9 @@ class DecodeReplica:
                         r.done_at = t_next
                         events.append(ReplicaEvent("complete", r.rid,
                                                    t_next))
+            for r in self.inflight:
+                if r.done_at is not None:
+                    self._retire_pages(r)
             self.inflight = [r for r in self.inflight
                              if r.done_at is None]
             self._now = t_next
@@ -330,6 +484,11 @@ class Router:
         self._scaleout_signals = 0
         self._scaleout_last = 0.0
         self._scaleout_wanted = False
+        #: Prefix-reuse outcome counters (paged replicas, requests
+        #: declaring a prefix_key): hit = admitted onto a replica
+        #: already holding the prefix's pages.
+        self._prefix_hits = 0
+        self._prefix_misses = 0
 
     # -- fleet membership --------------------------------------------------
 
@@ -354,9 +513,17 @@ class Router:
     # -- request path ------------------------------------------------------
 
     def submit(self, tenant: str, prompt_len: int, max_new: int,
-               now: float | None = None) -> dict:
+               now: float | None = None, *, prefix_key: str = "",
+               prefix_len: int = 0) -> dict:
         """Route one request. Returns the decision document:
-        ``{"outcome": "assigned"|"queued"|"shed", "rid", ...}``."""
+        ``{"outcome": "assigned"|"queued"|"shed", "rid", ...}``.
+
+        ``prefix_key``/``prefix_len`` declare a shareable prompt
+        prefix (e.g. the tenant's system prompt): a paged replica
+        already holding those pages charges only the private tail, so
+        routing prefers it via ``pages_free`` and the fleet records a
+        prefix hit. Sharing is per-tenant by construction — the key is
+        scoped (tenant, prefix_key) end to end."""
         if now is None:
             now = self.clock()
         with self._lock:
@@ -370,7 +537,9 @@ class Router:
                           prompt_len=prompt_len, max_new=max_new,
                           arrival=now,
                           bucket=_bucket(prompt_len, self.buckets,
-                                         max_len))
+                                         max_len),
+                          prefix_key=prefix_key,
+                          prefix_len=max(int(prefix_len), 0))
             if not self._replicas:
                 ts.shed += 1
                 return {"outcome": "shed", "rid": rid,
@@ -391,14 +560,19 @@ class Router:
             # saturation (a queue lingering beside a free slot would
             # fire the scale-out signal on an idle fleet).
             self._drain_locked(now)
-            # Most KV headroom first (free slots ARE free cache rows
-            # under the replica's grant), name breaking ties.
-            best = max(
-                self._replicas.values(),
-                key=lambda r: (r.free_slots(), r.name))
-            if best.free_slots() > 0 and not self._queue:
+            # Most KV headroom first — in PAGES (free slots times the
+            # row's pages for rows-mode replicas, the pool balance for
+            # paged ones), free slots then name breaking ties. Only
+            # replicas that can actually hold the reservation compete:
+            # a paged replica with a free slot but an exhausted pool
+            # must not win the max and strand the request.
+            fits = [r for r in self._replicas.values()
+                    if r.can_admit(req)]
+            if fits and not self._queue:
+                best = max(fits, key=lambda r: (r.pages_free(),
+                                                r.free_slots(), r.name))
                 self._requests[rid] = req
-                best.admit(req, now)
+                self._note_prefix(req, best.admit(req, now))
                 return {"outcome": "assigned", "rid": rid,
                         "replica": best.name}
             # Saturated: shed over-standing tenants, queue the rest.
@@ -414,6 +588,17 @@ class Router:
             self._queue.append(req)
             return {"outcome": "queued", "rid": rid,
                     "depth": len(self._queue)}
+
+    def _note_prefix(self, req: Request, hit: bool) -> None:
+        """Fold one admission's prefix outcome into the fleet counters
+        (paged replicas only — rows mode has no pages to share).
+        Callers hold the lock."""
+        if not req.prefix_key or not req.holds_prefix:
+            return
+        if hit:
+            self._prefix_hits += 1
+        else:
+            self._prefix_misses += 1
 
     def _active_tenants(self) -> set[str]:
         """Tenants currently holding slots or waiting in the queue.
@@ -553,19 +738,39 @@ class Router:
                         if r.free_slots() > 0]
                 if not free:
                     return
-                picked = 0
+                # Candidate must FIT somewhere (pages for its whole
+                # reservation, not just a slot): a paged fleet can
+                # have free slots a long request's pages don't fit —
+                # a shorter queued request behind it still drains
+                # (rows mode: fit == free slot, identical to the old
+                # policy).
+                picked = None
                 for idx, cand in enumerate(self._queue):
                     ent = entitled.get(cand.tenant)
                     if ent is None:
                         ent = entitled[cand.tenant] = self._entitled(
                             cand.tenant)
-                    if held.get(cand.tenant, 0) <= ent:
+                    if held.get(cand.tenant, 0) <= ent and any(
+                            r.can_admit(cand) for r in free):
                         picked = idx
                         break
+                if picked is None:
+                    # Work-conserving fallback: first FIFO entry that
+                    # fits anywhere (idle capacity is what borrowing
+                    # is for).
+                    for idx, cand in enumerate(self._queue):
+                        if any(r.can_admit(cand) for r in free):
+                            picked = idx
+                            break
+                if picked is None:
+                    return
                 nxt = self._queue[picked]
                 del self._queue[picked]
-                best = max(free, key=lambda r: (r.free_slots(), r.name))
-                best.admit(nxt, now)
+                fitting = [r for r in free if r.can_admit(nxt)]
+                best = max(fitting, key=lambda r: (r.pages_free(),
+                                                   r.free_slots(),
+                                                   r.name))
+                self._note_prefix(nxt, best.admit(nxt, now))
                 held[nxt.tenant] = held.get(nxt.tenant, 0) + 1
 
     def scaleout_spec(self) -> dict:
@@ -575,8 +780,14 @@ class Router:
         if not reps:
             return {"hbmGiB": 8, "maxLen": 2048, "reason": "cold-start"}
         best = max(reps, key=lambda r: r.slots)
-        return {"hbmGiB": best.hbm_gib or 8, "maxLen": best.max_len,
+        spec = {"hbmGiB": best.hbm_gib or 8, "maxLen": best.max_len,
                 "reason": "queue-depth"}
+        if best.pages_total is not None:
+            # Provision the paged shape: the new pod's capacity is a
+            # page pool, not a row count.
+            spec["pageTokens"] = best.page_tokens
+            spec["pagesTotal"] = best.pages_total
+        return spec
 
     # -- views -------------------------------------------------------------
 
@@ -623,15 +834,30 @@ class Router:
                 "hbmGiB": r.hbm_gib, "maxLen": r.max_len,
                 "decodeTokS": r.decode_tok_s,
                 "admissionOverhead": r.admission_overhead,
+                "paged": r.pages_total is not None,
+                "pageTokens": r.page_tokens,
+                "pagesTotal": r.pages_total_effective(),
+                "pagesFree": r.pages_free(),
             } for r in sorted(self._replicas.values(),
                               key=lambda r: r.name)]
+            looked = self._prefix_hits + self._prefix_misses
             return {
                 "fleetSlots": fleet_slots,
                 "slotsInUse": in_use,
+                "fleetPages": sum(r.pages_total_effective()
+                                  for r in self._replicas.values()),
+                "fleetPagesFree": sum(r.pages_free()
+                                      for r in self._replicas.values()),
                 "queuedTotal": len(self._queue),
                 "fleetTokensPerS": round(
                     self._fleet_tokens_per_s(now), 1),
                 "ttft": self._percentiles(self._ttft),
+                "prefix": {
+                    "hits": self._prefix_hits,
+                    "misses": self._prefix_misses,
+                    "hitRate": (round(self._prefix_hits / looked, 4)
+                                if looked else None),
+                },
                 "tenants": tenants,
                 "replicas": replicas,
                 "scaleOut": {

@@ -418,6 +418,43 @@ ROUTER_REPLICAS = Gauge(
     "Decode replicas currently registered with the router",
     registry=REGISTRY,
 )
+ROUTER_PAGES_TOTAL = Gauge(
+    "tpushare_router_pages_total",
+    "Fleet KV-cache pages across registered replicas (paged replicas "
+    "report their pool — serving.pages_for_grant over the HBM grant; "
+    "rows-mode replicas convert slots at max_len/page so mixed fleets "
+    "sum in one unit)",
+    registry=REGISTRY,
+)
+ROUTER_PAGES_FREE = Gauge(
+    "tpushare_router_pages_free",
+    "Unallocated KV-cache pages across the fleet — the routing "
+    "signal (admission reserves pages_for(prompt + max_new) minus "
+    "any live shared prefix). Exhaustion with queue depth is the "
+    "paged scale-out story",
+    registry=REGISTRY,
+)
+ROUTER_PREFIX_HITS = Gauge(
+    "tpushare_router_prefix_hits_total",
+    "Admissions that reused a live same-tenant prompt-prefix "
+    "(charged only their private tail pages). Monotonic; set at "
+    "scrape time from the router ledger",
+    registry=REGISTRY,
+)
+ROUTER_PREFIX_MISSES = Gauge(
+    "tpushare_router_prefix_misses_total",
+    "Admissions that declared a shareable prefix but found no live "
+    "copy on their replica (registered it for followers). Monotonic; "
+    "set at scrape time",
+    registry=REGISTRY,
+)
+ROUTER_PREFIX_HIT_RATE = Gauge(
+    "tpushare_router_prefix_hit_rate",
+    "prefix hits / (hits + misses) over the router's lifetime — the "
+    "share of prefix-declaring admissions that paid only their "
+    "private tail",
+    registry=REGISTRY,
+)
 
 TELEMETRY_ERRORS = Counter(
     "tpushare_telemetry_errors_total",
@@ -932,6 +969,12 @@ def observe_router(router) -> None:
                 ROUTER_TTFT.labels(quantile=q).set(snap["ttft"][q])
         ROUTER_SCALEOUT_SIGNALS.set(snap["scaleOut"]["signals"])
         ROUTER_REPLICAS.set(len(snap["replicas"]))
+        ROUTER_PAGES_TOTAL.set(snap["fleetPages"])
+        ROUTER_PAGES_FREE.set(snap["fleetPagesFree"])
+        ROUTER_PREFIX_HITS.set(snap["prefix"]["hits"])
+        ROUTER_PREFIX_MISSES.set(snap["prefix"]["misses"])
+        if snap["prefix"]["hitRate"] is not None:
+            ROUTER_PREFIX_HIT_RATE.set(snap["prefix"]["hitRate"])
 
 
 def observe_profiling() -> None:

@@ -1,0 +1,272 @@
+"""Paged KV-cache bookkeeping: the host-side allocator behind
+:mod:`tpushare.workload.serving`'s paged decode path.
+
+PagedAttention's memory model (vLLM, SOSP '23) split from its kernel:
+the cache is a pool of fixed-size pages (``TPUSHARE_KV_PAGE`` tokens
+each, default 64) and a stream holds exactly the pages its true length
+needs, not a whole ``max_len`` row. This module owns everything that is
+NOT jax about that design — the free list, refcounts, the per-tenant
+prefix index — so the router and the scheduler can import it without
+pulling jax into the control plane (the same discipline that keeps
+:mod:`tpushare.router.router` import-light). The device-side half
+(page-table gather, page-granular flush) lives in ``serving.py``.
+
+Prefix reuse is SGLang's radix-cache idea reduced to its sound core:
+a page is shareable only when it is (a) FULL — every one of its
+positions holds committed prompt K/V — and (b) strictly below the page
+containing the prompt's last real token (that page is re-run so the
+admission recomputes the first-token hidden state). Page identity is a
+per-tenant CHAIN hash over token ids: position ``p``'s K/V depend on
+every token at positions ``<= p`` (the residual stream mixes the whole
+prefix through attention), so the hash for page ``j`` folds in the
+hash of page ``j - 1`` — equal chain hashes mean equal (tenant, token
+prefix), which under fixed params means bit-equal page contents.
+Sharing is copy-on-write in the degenerate-safe sense: shared pages
+are immutable by construction (decode writes land at positions
+``>= true_len``, which live in the stream's PRIVATE tail pages), so
+the write that would trigger a copy never happens — zero copies, zero
+aliasing hazards. Hashes are seeded by tenant and the index is keyed
+by tenant: two tenants sending byte-identical prompts share nothing
+(isolation is pinned by test, not just intended).
+
+Thread-safety: every mutation happens under ``self._lock``
+(vet's GUARDED_FIELDS rule enforces the lexical ``with self._lock:``),
+because admissions arrive from the serving front door while the
+metrics scrape reads pool stats.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+from typing import Sequence
+
+from tpushare.utils import locks
+
+#: Tokens per KV-cache page. Env-tunable: smaller pages waste less on
+#: the last partial page but grow the page table and the scatter count;
+#: 64 matches the chunked-prefill piece size, so one prefill piece
+#: fills exactly one page.
+PAGE_TOKENS: int = int(os.environ.get("TPUSHARE_KV_PAGE", "64"))
+
+#: Default admission buckets: distinct prompt lengths each compile the
+#: slot server's ``_admit`` once; padding up to a bucket makes every
+#: prompt <= 2048 reuse one of these 7 shapes. THE single source — the
+#: serving runtime re-exports it and the router imports it (this module
+#: is jax-free, so the control plane can share the constant instead of
+#: hand-maintaining a mirror).
+PROMPT_BUCKETS: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048)
+
+
+def pages_for(tokens: int, page_tokens: int = PAGE_TOKENS) -> int:
+    """Pages needed to hold ``tokens`` KV rows (ceil division)."""
+    if page_tokens <= 0:
+        raise ValueError(f"page_tokens must be > 0, got {page_tokens}")
+    if tokens <= 0:
+        return 0
+    return -(-tokens // page_tokens)
+
+
+def shareable_pages(true_len: int, page_tokens: int = PAGE_TOKENS) -> int:
+    """How many leading pages of a ``true_len``-token prompt are
+    prefix-shareable: full pages strictly below the page holding the
+    last real token (that page is always re-run, see module doc)."""
+    if true_len <= 0:
+        return 0
+    return (true_len - 1) // page_tokens
+
+
+def prefix_hashes(tenant: str, tokens: Sequence[int], true_len: int,
+                  page_tokens: int = PAGE_TOKENS) -> tuple[str, ...]:
+    """Chain hashes for the shareable pages of ``tokens[:true_len]``.
+
+    ``hashes[j]`` identifies (tenant, tokens[: (j+1) * page_tokens]) —
+    exactly the dependency set of every K/V value in page ``j`` — so an
+    index hit means the resident page's contents are bit-equal to what
+    a fresh prefill would write."""
+    n = shareable_pages(true_len, page_tokens)
+    chain = hashlib.sha256(
+        b"tpushare-kv-prefix\x00" + tenant.encode()).hexdigest()
+    out: list[str] = []
+    for j in range(n):
+        h = hashlib.sha256()
+        h.update(chain.encode())
+        page = tokens[j * page_tokens:(j + 1) * page_tokens]
+        h.update(",".join(str(int(t)) for t in page).encode())
+        chain = h.hexdigest()
+        out.append(chain)
+    return tuple(out)
+
+
+class PoolExhausted(RuntimeError):
+    """The free list cannot cover an allocation — admission control
+    should have sized the reservation (router ``pages_free``)."""
+
+
+@dataclass(frozen=True)
+class PageLease:
+    """One stream's page allocation: physical ids in logical order.
+    ``shared`` leading pages came from the prefix index (refcounted,
+    NOT re-prefilled); the rest are private and writable."""
+
+    owner: str
+    pages: tuple[int, ...]
+    shared: int
+
+
+class PagePool:
+    """Refcounted free-page pool with a per-tenant prefix index.
+
+    The pool tracks bookkeeping only — page CONTENTS live in the
+    serving state's device arrays; physical ids issued here are row
+    indices into that pool buffer. ``pages_free`` is the router's
+    capacity signal (the paged replacement for the slot counter)."""
+
+    def __init__(self, total_pages: int, *,
+                 page_tokens: int = PAGE_TOKENS) -> None:
+        if total_pages <= 0:
+            raise ValueError(
+                f"total_pages must be > 0, got {total_pages}")
+        if page_tokens <= 0:
+            raise ValueError(
+                f"page_tokens must be > 0, got {page_tokens}")
+        self.total_pages = total_pages
+        self.page_tokens = page_tokens
+        self._lock = locks.TracingRLock("workload/page-pool")
+        #: LIFO free list — a just-released page is the warmest.
+        self._free: list[int] = list(range(total_pages - 1, -1, -1))
+        self._refs: dict[int, int] = {}
+        #: (tenant, chain hash) -> resident physical page.
+        self._index: dict[tuple[str, str], int] = {}
+        #: Reverse map for index eviction at refcount zero.
+        self._page_key: dict[int, tuple[str, str]] = {}
+        self._leases: dict[str, list[int]] = {}
+        self._hits = 0
+        self._misses = 0
+
+    # -- capacity ----------------------------------------------------------
+
+    def pages_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def held(self, owner: str) -> tuple[int, ...]:
+        with self._lock:
+            return tuple(self._leases.get(owner, ()))
+
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._refs.get(page, 0)
+
+    # -- lease lifecycle ---------------------------------------------------
+
+    def admit(self, owner: str, tenant: str, tokens: Sequence[int],
+              true_len: int) -> PageLease:
+        """Allocate pages for a ``true_len``-token prompt, reusing
+        resident same-tenant prefix pages where the chain hashes match.
+        Raises :class:`PoolExhausted` (allocating nothing) when the
+        private tail cannot be covered."""
+        if true_len <= 0:
+            raise ValueError(f"true_len must be > 0, got {true_len}")
+        if len(tokens) < true_len:
+            raise ValueError(
+                f"tokens ({len(tokens)}) shorter than true_len "
+                f"{true_len}")
+        n_pages = pages_for(true_len, self.page_tokens)
+        hashes = prefix_hashes(tenant, tokens, true_len,
+                               self.page_tokens)
+        with self._lock:
+            if owner in self._leases:
+                raise ValueError(
+                    f"owner {owner!r} already holds a lease — release "
+                    "it first (a silent re-admit would leak its pages)")
+            shared: list[int] = []
+            for h in hashes:
+                pid = self._index.get((tenant, h))
+                if pid is None:
+                    break  # chain broken: nothing further can match
+                shared.append(pid)
+            n_new = n_pages - len(shared)
+            if n_new > len(self._free):
+                raise PoolExhausted(
+                    f"need {n_new} pages, {len(self._free)} free "
+                    f"(of {self.total_pages}) — admission control "
+                    "should gate on pages_free")
+            for pid in shared:
+                self._refs[pid] += 1
+            fresh = [self._free.pop() for _ in range(n_new)]
+            for pid in fresh:
+                self._refs[pid] = 1
+            pages = shared + fresh
+            # Publish this stream's own full prefix pages so followers
+            # with the same (tenant, token prefix) share them.
+            for j in range(len(shared), len(hashes)):
+                key = (tenant, hashes[j])
+                if key not in self._index:
+                    self._index[key] = pages[j]
+                    self._page_key[pages[j]] = key
+            self._hits += len(shared)
+            self._misses += len(hashes) - len(shared)
+            self._leases[owner] = list(pages)
+            return PageLease(owner, tuple(pages), len(shared))
+
+    def grow(self, owner: str, n_more: int) -> tuple[int, ...]:
+        """Extend a lease with ``n_more`` private pages (decode growth
+        across a page boundary). Raises :class:`PoolExhausted` without
+        allocating when the pool cannot cover it."""
+        if n_more <= 0:
+            return ()
+        with self._lock:
+            lease = self._leases.get(owner)
+            if lease is None:
+                raise ValueError(f"owner {owner!r} holds no lease")
+            if n_more > len(self._free):
+                raise PoolExhausted(
+                    f"need {n_more} pages, {len(self._free)} free "
+                    f"(of {self.total_pages})")
+            fresh = [self._free.pop() for _ in range(n_more)]
+            for pid in fresh:
+                self._refs[pid] = 1
+            lease.extend(fresh)
+            return tuple(fresh)
+
+    def release(self, owner: str) -> int:
+        """Drop a lease: decref every page, return fully-released ones
+        to the free list (and evict their index entries). Returns the
+        number of pages freed; unknown owners are a no-op (release is
+        idempotent, like the slot server's)."""
+        freed = 0
+        with self._lock:
+            for pid in self._leases.pop(owner, []):
+                self._refs[pid] -= 1
+                if self._refs[pid] > 0:
+                    continue  # still shared by another stream
+                del self._refs[pid]
+                key = self._page_key.pop(pid, None)
+                if key is not None:
+                    self._index.pop(key, None)
+                self._free.append(pid)
+                freed += 1
+        return freed
+
+    # -- telemetry ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Pool state for ``/debug`` surfaces and the benches."""
+        with self._lock:
+            hits, misses = self._hits, self._misses
+            looked = hits + misses
+            return {
+                "pagesTotal": self.total_pages,
+                "pagesFree": len(self._free),
+                "pageTokens": self.page_tokens,
+                "leases": len(self._leases),
+                "indexedPages": len(self._index),
+                "sharedPages": sum(
+                    1 for c in self._refs.values() if c > 1),
+                "prefixHits": hits,
+                "prefixMisses": misses,
+                "prefixHitRate": (round(hits / looked, 4)
+                                  if looked else None),
+            }

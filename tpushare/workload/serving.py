@@ -32,6 +32,9 @@ import jax
 import jax.numpy as jnp
 
 from tpushare.workload import model as M
+from tpushare.workload import paging
+from tpushare.workload.paging import (PAGE_TOKENS, PROMPT_BUCKETS,
+                                      pages_for)
 
 
 def init_cache(cfg: M.ModelConfig, batch: int, max_len: int) -> list[dict]:
@@ -784,11 +787,12 @@ def admit_interleaved(params: dict, state: dict, prompt: jax.Array,
 # Bucketed admission (+ jit-cache accounting)
 # --------------------------------------------------------------------------
 
-#: Default admission buckets: distinct prompt lengths each compile
-#: ``_admit`` once; padding up to a bucket makes every prompt <= 2048
-#: reuse one of these 7 shapes. Powers of two keep the padded-FLOPs
-#: waste under 2x while the compile count stays O(len(buckets)).
-PROMPT_BUCKETS: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048)
+# PROMPT_BUCKETS (re-exported above from tpushare.workload.paging, the
+# jax-free single source the router shares): distinct prompt lengths
+# each compile ``_admit`` once; padding up to a bucket makes every
+# prompt <= 2048 reuse one of 7 shapes. Powers of two keep the
+# padded-FLOPs waste under 2x while the compile count stays
+# O(len(buckets)).
 
 #: bucket length -> {"admits": n, "jitMisses": n} — the proof the
 #: bucketing works: after warmup every admission is a jit cache HIT
@@ -802,16 +806,24 @@ def bucket_len(n: int, buckets: tuple[int, ...] = PROMPT_BUCKETS,
     """Smallest bucket >= ``n`` (the compiled shape the admission will
     reuse), capped at ``max_len`` when given — padding past the cache
     is illegal, but padding TO it is fine (admit's true_len contract),
-    so a prompt whose bucket overshoots the cache pads to max_len
-    exactly. Raises when the prompt exceeds every bucket or the cache
-    itself (capping would return a bucket SMALLER than the prompt and
-    hand pad_to_bucket a negative pad width)."""
+    so a prompt whose bucket overshoots the cache — or that outgrows
+    the bucket table entirely while still fitting the cache — pads to
+    max_len exactly. Raises when the prompt exceeds the cache, or
+    exceeds every bucket with no max_len to fall back on (capping
+    would return a bucket SMALLER than the prompt and hand
+    pad_to_bucket a negative pad width)."""
     if max_len is not None and n > max_len:
         raise ValueError(
             f"prompt length {n} exceeds cache max_len {max_len}")
     for b in sorted(buckets):
         if b >= n:
             return b if max_len is None else min(b, max_len)
+    if max_len is not None:
+        # Past every bucket but within the cache (n <= max_len held
+        # above): the cache itself is the final bucket — padding TO it
+        # is legal (admit's true_len contract), so a prompt of exactly
+        # max_len admits instead of raising on a bucket-table gap.
+        return max_len
     raise ValueError(
         f"prompt length {n} exceeds the largest admission bucket "
         f"{max(buckets)}")
@@ -888,3 +900,352 @@ def max_batch_for_grant(cfg: M.ModelConfig, grant_hbm_gib: float,
         return 0
     per_seq = cache_hbm_bytes(cfg, batch=1, max_len=max_len)
     return int((budget - params_bytes) // per_seq)
+
+
+def pages_for_grant(cfg: M.ModelConfig, grant_hbm_gib: float,
+                    page_tokens: int = PAGE_TOKENS,
+                    headroom: float = 0.8) -> int:
+    """``max_batch_for_grant``'s paged twin: KV-cache PAGES that fit
+    the grant after the weights. Capacity in pages instead of rows is
+    the density win — a stream costs ``pages_for(true_len + decode)``
+    pages, not a whole ``max_len`` row, so the same grant serves a
+    mixed-length trace with ~2x the concurrent streams
+    (bench_workload's ``paged_decode`` section measures it)."""
+    if page_tokens <= 0:
+        raise ValueError(
+            f"page_tokens must be > 0, got {page_tokens}")
+    budget = grant_hbm_gib * (1 << 30) * headroom
+    abstract = jax.eval_shape(
+        lambda: M.init_params(jax.random.PRNGKey(0), cfg))
+    params_bytes = sum(l.size * l.dtype.itemsize
+                       for l in jax.tree_util.tree_leaves(abstract))
+    if params_bytes >= budget:
+        return 0
+    per_page = cache_hbm_bytes(cfg, batch=1, max_len=page_tokens)
+    return int((budget - params_bytes) // per_page)
+
+
+# --------------------------------------------------------------------------
+# Paged KV cache (PagedAttention memory model, bit-identical decode)
+# --------------------------------------------------------------------------
+#
+# The slot server above charges every stream a full [max_len] cache
+# row. The paged server replaces the per-slot rows with a POOL of
+# [page_tokens] blocks and a per-slot page table:
+#
+# * ``init_paged_state``: per-layer page pools [P, page, H, D] plus a
+#   [SLOTS, max_len/page] int32 table (-1 = unmapped). A slot's
+#   logical cache is the gather ``pool[table[slot]]`` — built once per
+#   chunk as a scan invariant, so the fused chunk step's math (and
+#   therefore every emitted token) is bit-identical to the contiguous
+#   path: the gathered view holds exactly the same (position, K/V)
+#   values the contiguous cache would.
+# * ``admit_paged`` allocates pages for the prompt's TRUE length from a
+#   host-side :class:`tpushare.workload.paging.PagePool`, reuses
+#   same-tenant prefix pages (chain-hash index; shared pages are
+#   refcounted and never re-prefilled), and prefills only the private
+#   tail — one page-sized piece per call of ONE compiled function (the
+#   chunked-prefill design with chunk == page).
+# * ``serve_chunk_paged`` runs the SAME ``_fused_chunk_step`` over the
+#   gathered view; the once-per-chunk flush becomes a page-granular
+#   scatter through the table into the flat pool. Decode writes land
+#   at positions >= true_len — always in the stream's PRIVATE tail
+#   pages — so shared prefix pages are immutable by construction
+#   (copy-on-write whose copy never fires).
+# * ``release_paged`` retires the slot and refcount-releases its lease;
+#   fully-released pages return to the pool (tests pin no-leak over
+#   admit/retire cycles).
+
+
+def init_paged_state(cfg: M.ModelConfig, slots: int, max_len: int,
+                     total_pages: int,
+                     page_tokens: int = PAGE_TOKENS) -> dict:
+    """Fresh paged server state: page pools + an unmapped table.
+
+    ``max_len`` must be a multiple of ``page_tokens`` (the table is
+    dense: ``max_len / page_tokens`` entries per slot). ``total_pages``
+    comes from :func:`pages_for_grant` — HBM now buys pages, and slots
+    are just the compiled batch ceiling."""
+    if page_tokens <= 0 or max_len % page_tokens != 0:
+        raise ValueError(
+            f"max_len {max_len} must be a positive multiple of "
+            f"page_tokens {page_tokens} (dense page table)")
+    if total_pages <= 0:
+        raise ValueError(f"total_pages must be > 0, got {total_pages}")
+    shape = (total_pages, page_tokens, cfg.n_heads, cfg.head_dim)
+    zeros = jnp.zeros(shape, dtype=cfg.dtype)
+    return {
+        "pages": [{"k": zeros, "v": zeros}
+                  for _ in range(cfg.n_layers)],
+        "table": jnp.full((slots, max_len // page_tokens), -1,
+                          jnp.int32),
+        "pos": jnp.zeros((slots,), jnp.int32),
+        "active": jnp.zeros((slots,), bool),
+        "token": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def _paged_dims(state: dict) -> tuple[int, int, int, int]:
+    """(total_pages, page_tokens, table_len, max_len) of a paged
+    state."""
+    P, page = state["pages"][0]["k"].shape[:2]
+    MP = state["table"].shape[1]
+    return P, page, MP, MP * page
+
+
+@partial(jax.jit, donate_argnums=())
+def _prefill_paged_piece(params: dict, state: dict,
+                         chunk_tokens: jax.Array, slot: jax.Array,
+                         piece: jax.Array, true_len: jax.Array,
+                         carry_h: jax.Array) -> tuple[dict, jax.Array]:
+    """``_prefill_chunk`` for the paged cache: prefill ONE page-sized
+    piece (logical page index ``piece``, traced) into the physical page
+    the slot's table maps it to. The piece attends the slot's gathered
+    view with its own K/V spliced in — the identical math to the
+    contiguous piece, so the admitted stream is bit-identical. One
+    compilation serves every piece of every prompt (the page size is
+    the only static shape)."""
+    C = chunk_tokens.shape[0]
+    P, page, MP, max_len = _paged_dims(state)
+    slot = jnp.clip(jnp.asarray(slot, jnp.int32), 0,
+                    state["pos"].shape[0] - 1)
+    piece = jnp.clip(jnp.asarray(piece, jnp.int32), 0, MP - 1)
+    offset = piece * page
+    # Unmapped entries (-1) clamp to page 0: their rows are masked off
+    # by causality / true_len, and a correctly-driven admission never
+    # reads them (the host wrapper maps every page before prefilling).
+    row = jnp.clip(state["table"][slot], 0, P - 1)        # [MP]
+    pid = row[piece]
+    positions = (offset + jnp.arange(C))[None, :]
+    x = params["embed"][chunk_tokens][None, :]
+    new_pages = []
+    for block, pg in zip(params["blocks"], state["pages"]):
+        q, k, v = M.qkv_proj(block, x, positions)
+        # This piece's K/V go to ONE physical page — a plain
+        # dynamic_update_slice, no scatter.
+        pk = jax.lax.dynamic_update_slice(pg["k"], k, (pid, 0, 0, 0))
+        pv = jax.lax.dynamic_update_slice(pg["v"], v, (pid, 0, 0, 0))
+        new_pages.append({"k": pk, "v": pv})
+        # Attention runs over the slot's contiguous VIEW (gather via
+        # the table) with the piece spliced in at its offset — exactly
+        # the rows _prefill_chunk sees, so the math is unchanged.
+        # Gathering pg (pre-write) then splicing avoids ordering on
+        # the pool write.
+        ck = pg["k"][row].reshape(1, max_len, *pg["k"].shape[2:])
+        cv = pg["v"][row].reshape(1, max_len, *pg["v"].shape[2:])
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, offset, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, offset, 0, 0))
+        out = M.causal_attention(q, ck, cv, q_offset=offset)
+        x = x + M.out_proj(block, out)
+        x = M.ffn_block(block, x)
+    idx = true_len - 1 - offset
+    inside = (idx >= 0) & (idx < C)
+    h = jax.lax.dynamic_index_in_dim(x[0], jnp.clip(idx, 0, C - 1),
+                                     axis=0, keepdims=False)
+    carry_h = jnp.where(inside, h, carry_h)
+    return dict(state, pages=new_pages), carry_h
+
+
+@jax.jit
+def _finalize_admit_paged(params: dict, state: dict, slot: jax.Array,
+                          true_len: jax.Array, carry_h: jax.Array,
+                          temperature: jax.Array,
+                          key: jax.Array) -> dict:
+    """``_finalize_admit`` over paged state: first token from the
+    carried hidden state, slot bookkeeping flipped active. Same
+    traced-input defenses (slot clamped, no-decode-room admits
+    INERT)."""
+    _, _, _, max_len = _paged_dims(state)
+    slot = jnp.clip(jnp.asarray(slot, jnp.int32), 0,
+                    state["pos"].shape[0] - 1)
+    true_len = jnp.clip(true_len, 1, max_len)
+    has_room = true_len < max_len
+    h = M.rms_norm(carry_h[None, :], params["final_norm"])
+    logits = (h @ params["embed"].T).astype(jnp.float32)
+    greedy = jnp.argmax(logits[0], axis=-1)
+    sampled = jax.random.categorical(
+        key, logits[0] / jnp.maximum(temperature, 1e-6), axis=-1)
+    first = jnp.where(temperature > 0, sampled,
+                      greedy).astype(state["token"].dtype)
+    return dict(
+        state,
+        pos=state["pos"].at[slot].set(true_len),
+        active=state["active"].at[slot].set(has_room),
+        token=state["token"].at[slot].set(first),
+    )
+
+
+def admit_paged(params: dict, state: dict, pool: paging.PagePool,
+                prompt: jax.Array, slot: int, *,
+                tenant: str = "default",
+                true_len: jax.Array | None = None,
+                temperature: float = 0.0,
+                key: jax.Array | None = None) -> dict:
+    """Admit ``prompt`` into ``slot`` of a PAGED server: allocate pages
+    for the prompt's true length from ``pool`` (reusing same-tenant
+    prefix pages), prefill ONLY the private tail in page-sized pieces,
+    and finalize. The slot's subsequent stream is bit-identical to the
+    contiguous ``admit`` paths (tests pin it).
+
+    Host-driven by design: the page-table edit and the pool lease are
+    Python-side bookkeeping, so ``slot`` must be concrete (admission
+    already crosses the host boundary per piece). Prefix sharing never
+    crosses tenants — the pool's index is tenant-keyed and the chain
+    hashes are tenant-seeded."""
+    P, page, MP, max_len = _paged_dims(state)
+    if pool.page_tokens != page:
+        raise ValueError(
+            f"pool page_tokens {pool.page_tokens} != state page size "
+            f"{page} — one pool per paged server")
+    slots = state["pos"].shape[0]
+    s = int(slot)  # host bookkeeping: traced slots are a TypeError here
+    padded, tl, _, key = _chunk_plan(prompt, page, max_len, slots,
+                                     s, true_len, temperature, key)
+    tl_i = int(tl)
+    n_pages = pages_for(tl_i, page)
+    host_tokens = [int(t) for t in jax.device_get(prompt[:tl_i])]
+    lease = pool.admit(f"slot{s}", tenant, host_tokens, tl_i)
+    try:
+        row = jnp.full((MP,), -1, jnp.int32).at[:n_pages].set(
+            jnp.asarray(lease.pages, jnp.int32))
+        state = dict(state, table=state["table"].at[s].set(row))
+        carry = jnp.zeros((params["embed"].shape[1],),
+                          params["embed"].dtype)
+        # Shared pages hold bit-equal K/V already (chain-hash match) —
+        # skip their pieces. The piece holding position true_len - 1 is
+        # never shared (paging.shareable_pages), so carry_h is always
+        # computed by a re-run piece.
+        for i in range(lease.shared, n_pages):
+            state, carry = _prefill_paged_piece(
+                params, state, padded[i * page:(i + 1) * page],
+                jnp.int32(s), jnp.int32(i), tl, carry)
+        return _finalize_admit_paged(params, state, jnp.int32(s), tl,
+                                     carry, jnp.float32(temperature),
+                                     key)
+    except BaseException:
+        pool.release(f"slot{s}")
+        raise
+
+
+def ensure_chunk_pages(state: dict, pool: paging.PagePool,
+                       n_steps: int) -> dict:
+    """Map pages ahead of a decode chunk: every active slot gets table
+    entries covering ``pos + n_steps`` (capped at max_len). Host-side
+    and off the compiled path — the chunk itself never allocates.
+    Raises :class:`tpushare.workload.paging.PoolExhausted` when the
+    pool cannot cover the growth (admission control should have gated
+    on ``pages_free``)."""
+    P, page, MP, max_len = _paged_dims(state)
+    pos = jax.device_get(state["pos"])
+    active = jax.device_get(state["active"])
+    table = state["table"]
+    mapped = jax.device_get((table >= 0).sum(axis=1))
+    for s in range(state["pos"].shape[0]):
+        if not bool(active[s]):
+            continue
+        upto = min(int(pos[s]) + n_steps, max_len)
+        need = pages_for(upto, page)
+        have = int(mapped[s])
+        if need > have:
+            fresh = pool.grow(f"slot{s}", need - have)
+            table = table.at[s, have:need].set(
+                jnp.asarray(fresh, jnp.int32))
+    return dict(state, table=table)
+
+
+def serve_chunk_paged(params: dict, state: dict,
+                      pool: paging.PagePool, n_steps: int,
+                      temperature: jax.Array | None = None,
+                      key: jax.Array | None = None
+                      ) -> tuple[dict, jax.Array]:
+    """``serve_chunk`` over the paged cache: grow page tables to cover
+    the chunk (host-side), then advance every active slot ``n_steps``
+    tokens in the same compiled scan as the contiguous path — the
+    gathered view feeds the identical ``_fused_chunk_step``, so
+    emitted streams are bit-identical. Same temperature/key contract
+    as ``serve_chunk``."""
+    if temperature is not None:
+        if key is None:
+            raise ValueError("temperature requires an explicit PRNG key")
+        slots = state["pos"].shape[0]
+        temperature = jnp.asarray(temperature, jnp.float32)
+        if temperature.shape != (slots,):
+            raise ValueError(
+                f"temperature must be a per-slot [{slots}] vector "
+                f"(0 entries stay greedy), got shape "
+                f"{temperature.shape}")
+        if not isinstance(temperature, jax.core.Tracer) and bool(
+                (temperature < 0).any()):
+            raise ValueError(
+                "negative temperature entries would silently mean "
+                "greedy; use 0 for greedy slots")
+    state = ensure_chunk_pages(state, pool, n_steps)
+    return _serve_chunk_paged(params, state, n_steps, temperature, key)
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def _serve_chunk_paged(params: dict, state: dict, n_steps: int,
+                       temperature: jax.Array | None,
+                       key: jax.Array | None) -> tuple[dict, jax.Array]:
+    P, page, MP, max_len = _paged_dims(state)
+    start_pos = state["pos"]
+    B = state["token"].shape[0]
+    H, D = state["pages"][0]["k"].shape[2:]
+    # The slot-contiguous view: pool[table] gathered ONCE per chunk, a
+    # read-only scan invariant exactly like the contiguous cache.
+    # Unmapped entries clamp to page 0 — those rows sit beyond every
+    # mapped position, so base_mask (rows < pos) masks them off.
+    phys = jnp.clip(state["table"], 0, P - 1)             # [B, MP]
+    cache = [{"k": pg["k"][phys].reshape(B, max_len, H, D),
+              "v": pg["v"][phys].reshape(B, max_len, H, D)}
+             for pg in state["pages"]]
+    base_mask = jnp.arange(max_len)[None, :] < start_pos[:, None]
+    zeros = jnp.zeros((B, n_steps, H, D), cache[0]["k"].dtype)
+    ring0 = [{"k": zeros, "v": zeros} for _ in cache]
+
+    def step(carry, t):
+        pos, active, token, ring, k = carry
+        return _fused_chunk_step(params, cache, base_mask, n_steps,
+                                 pos, active, token, ring, t,
+                                 temperature, k)
+
+    carry0 = (start_pos, state["active"], state["token"], ring0, key)
+    (pos, active, token, ring, _), emitted = jax.lax.scan(
+        step, carry0, jnp.arange(n_steps))
+
+    # Page-granular flush: the contiguous path's once-per-chunk scatter
+    # routed through the page table into the FLAT pool. Decode rows
+    # are >= true_len, i.e. always in the stream's private tail pages —
+    # shared prefix pages are never written (the COW copy never
+    # fires). Inactive steps point past the pool and drop.
+    valid = (emitted >= 0).T                              # [B, C]
+    rows = start_pos[:, None] + jnp.arange(n_steps)[None, :]
+    logical = jnp.clip(rows // page, 0, MP - 1)
+    ppage = jnp.take_along_axis(phys, logical, axis=1)    # [B, C]
+    flat = jnp.where(valid, ppage * page + rows % page, P * page)
+    new_pages = [
+        {"k": pg["k"].reshape(P * page, H, D)
+              .at[flat].set(rg["k"], mode="drop")
+              .reshape(P, page, H, D),
+         "v": pg["v"].reshape(P * page, H, D)
+              .at[flat].set(rg["v"], mode="drop")
+              .reshape(P, page, H, D)}
+        for pg, rg in zip(state["pages"], ring)]
+    return (dict(state, pages=new_pages, pos=pos, active=active,
+                 token=token), emitted)
+
+
+def release_paged(state: dict, pool: paging.PagePool,
+                  slot: int) -> dict:
+    """Retire ``slot`` and refcount-release its page lease; pages no
+    stream still shares return to the pool. The table row resets to
+    unmapped so a recycled physical page can never be read through a
+    stale mapping."""
+    s = int(slot)
+    pool.release(f"slot{s}")
+    return dict(
+        state,
+        table=state["table"].at[s].set(-1),
+        active=state["active"].at[s].set(False),
+        pos=state["pos"].at[s].set(0),
+    )
