@@ -9,12 +9,16 @@ test:
 # Static-analysis hard gate: tools/vet (annotation-key lint, lock
 # discipline, raw-lock ban, sleep-in-handler, bare-except, strict
 # typing) + the whole-program flow layer (--flow: static lock-order
-# cycles, blocking-under-lock, hot-path fleet-scan budget; call-graph
-# cache under .vet_cache/ keeps the pass sub-second) + mypy --strict
-# on the core packages where mypy exists. tools/vet is stdlib-only so
-# the gate itself needs no extra deps.
+# cycles, blocking-under-lock, hot-path fleet-scan budget) + the
+# resource-protocol layer (--protocol: leak-on-path, double-release,
+# commit-without-precondition against the shrink-only
+# tools/vet/commit_budget.json ratchet; both whole-program passes
+# share the call-graph cache under .vet_cache/, keeping the pass
+# sub-second warm) + mypy --strict on the core packages where mypy
+# exists. tools/vet is stdlib-only so the gate itself needs no extra
+# deps.
 lint:
-	python -m tools.vet --flow
+	python -m tools.vet --flow --protocol
 	@if python -c "import mypy" >/dev/null 2>&1; then \
 		python -m mypy --config-file pyproject.toml; \
 	else \
@@ -88,19 +92,25 @@ bench-workload:
 bench-router:
 	python bench_router.py --gate
 
-# Drift check: re-run the scale + wire + workload smokes and diff
-# their gated stats against the committed contracts (>10% unfavorable
-# drift exits nonzero). Smoke scenarios are smaller than the committed
-# runs, so treat failures as a prompt to re-run the full bench. The
-# workload row drift-checks the paged-KV density scalar (grant
-# arithmetic — gated even on the CPU smoke artifact).
+# Drift check: re-run the scale + wire + autoscale + topology +
+# router + workload smokes and diff their gated stats against the
+# committed contracts (>10% unfavorable drift exits nonzero; boolean
+# gates like the router fairness/shed/drain proofs must simply still
+# pass). Smoke scenarios are smaller than the committed runs, so treat
+# failures as a prompt to re-run the full bench. The workload row
+# drift-checks the paged-KV density scalar (grant arithmetic — gated
+# even on the CPU smoke artifact).
 bench-diff:
 	python bench.py --scale --smoke > /tmp/tpushare-bench-scale.json
 	python bench.py --wire --smoke > /tmp/tpushare-bench-wire.json
 	python bench.py --autoscale --smoke > /tmp/tpushare-bench-autoscale.json
+	python bench.py --topology --smoke > /tmp/tpushare-bench-topo.json
+	python bench_router.py --smoke > /tmp/tpushare-bench-router.json
 	python tools/bench_diff.py BENCH_SCALE.json /tmp/tpushare-bench-scale.json
 	python tools/bench_diff.py BENCH_WIRE_r01.json /tmp/tpushare-bench-wire.json
 	python tools/bench_diff.py BENCH_AUTOSCALE.json /tmp/tpushare-bench-autoscale.json
+	python tools/bench_diff.py BENCH_TOPO_r01.json /tmp/tpushare-bench-topo.json
+	python tools/bench_diff.py BENCH_ROUTER_r02.json /tmp/tpushare-bench-router.json
 	python bench_workload.py --allow-cpu > /tmp/tpushare-bench-workload.json
 	python tools/bench_diff.py BENCH_WORKLOAD_r09.json /tmp/tpushare-bench-workload.json
 

@@ -248,6 +248,39 @@ def test_vet_doc_covers_the_flow_layer():
     assert not missing, f"flow rules absent from docs/vet.md: {missing}"
 
 
+def test_vet_doc_covers_the_protocol_layer():
+    """docs/vet.md must keep documenting engine 5: the PROTOCOLS
+    declaration schema, the three protocol rules, the commit-budget
+    ratchet with its precondition helper, and the leak runbook."""
+    with open(VET_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("--protocol", "PROTOCOLS", "acquire", "transfer",
+                   "handle", "truthy", "can_raise",
+                   "commit_budget.json", "committed_update_pod",
+                   "committed_update_node", "resourceVersion",
+                   "may only shrink", "page-lease", "gang-reservation",
+                   "eviction-slot", "chip-charge", "drain-cordon",
+                   "page-charge", "witness",
+                   "Runbook: a new `leak-on-path` finding"):
+        assert needle in doc, needle
+    # Every protocol rule id the analyzer exposes is documented.
+    import ast as _ast
+    proto_src = os.path.join(REPO_ROOT, "tools", "vet", "protocol",
+                             "analysis.py")
+    with open(proto_src, encoding="utf-8") as f:
+        tree = _ast.parse(f.read())
+    ids = []
+    for node in _ast.walk(tree):
+        if (isinstance(node, _ast.Assign)
+                and any(getattr(t, "id", "") == "PROTOCOL_RULE_IDS"
+                        for t in node.targets)):
+            ids = [c.value for c in node.value.elts]
+    assert ids, "PROTOCOL_RULE_IDS literal not found"
+    missing = [i for i in ids if f"`{i}`" not in doc]
+    assert not missing, (
+        f"protocol rules absent from docs/vet.md: {missing}")
+
+
 def test_perf_doc_covers_the_contract():
     """docs/perf.md is the profiling + hot-path-budget contract: it
     must keep naming the three engines, the env knobs, every surface,
@@ -379,6 +412,7 @@ if __name__ == "__main__":
                   test_perf_doc_covers_the_contract,
                   test_perf_doc_is_linked,
                   test_vet_doc_covers_the_flow_layer,
+                  test_vet_doc_covers_the_protocol_layer,
                   test_vet_doc_is_linked):
         try:
             check()

@@ -690,3 +690,44 @@ class TestGangLockDiscipline:
         # Exactly ONE reservation's chips charged, on host-0 only.
         assert len(cache.get_node_info("host-0").get_free_chips()) == 0
         assert len(cache.get_node_info("host-1").get_free_chips()) == 4
+
+
+class TestReservationRollback:
+    class FlakyCache:
+        """Wraps the scheduler cache, failing the reservation-table
+        insert (add_or_update_pod) a chosen number of times."""
+
+        def __init__(self, cache, failures=1):
+            self._cache = cache
+            self.failures = failures
+
+        def __getattr__(self, name):
+            return getattr(self._cache, name)
+
+        def add_or_update_pod(self, pod):
+            if self.failures:
+                self.failures -= 1
+                raise RuntimeError("injected ledger insert failure")
+            return self._cache.add_or_update_pod(pod)
+
+    def test_failed_table_insert_rolls_back_hold_and_annotations(
+            self, api):
+        """Regression: a failure between allocate() and the
+        reservation-table insert used to strand the chip hold plus the
+        persisted assume-annotations — the reservation never made the
+        table, so no TTL sweep would ever find either. The handler
+        must undo both and propagate the original error."""
+        cache = make_cluster(api)
+        flaky = self.FlakyCache(cache)
+        planner = GangPlanner(flaky, api, ttl=60)
+        p0 = api.create_pod(make_pod("w0", chips=4, annotations=ANN))
+        with pytest.raises(RuntimeError, match="injected"):
+            planner.bind_member(p0, "host-0")
+        # The apiserver copy lost its assume-annotations...
+        assert not podutils.is_assumed(api.get_pod(p0.namespace, "w0"))
+        # ...and the chip hold is gone: the whole-node retry fits.
+        assert len(cache.get_node_info("host-0").get_free_chips()) == 4
+        with pytest.raises(GangPending):
+            planner.bind_member(api.get_pod(p0.namespace, "w0"),
+                                "host-0")
+        assert len(cache.get_node_info("host-0").get_free_chips()) == 0

@@ -117,3 +117,42 @@ def test_admit_validation():
         pool.grow("ghost", 1)
     with pytest.raises(ValueError, match="total_pages"):
         P.PagePool(0, page_tokens=4)
+
+
+def test_shrink_gives_back_exactly_the_grown_pages():
+    """shrink() is grow()'s partial rollback: it returns the named
+    pages only, leaves the admit-time lease intact, and stays
+    idempotent for pages already given back or never held."""
+    pool = P.PagePool(8, page_tokens=4)
+    lease = pool.admit("s0", "t", list(range(10)), 10)  # 3 pages
+    fresh = pool.grow("s0", 3)
+    assert pool.pages_free() == 2
+    assert pool.shrink("s0", fresh) == 3
+    assert pool.pages_free() == 5
+    assert pool.held("s0") == lease.pages
+    # idempotent: the same pages again (and foreign pages) are no-ops
+    assert pool.shrink("s0", fresh) == 0
+    assert pool.shrink("s0", [10 ** 6]) == 0
+    assert pool.shrink("ghost", fresh) == 0
+    assert pool.pages_free() == 5
+    # the lease still releases every remaining page cleanly
+    assert pool.release("s0") == 3
+    assert pool.pages_free() == 8
+
+
+def test_shrink_respects_shared_refcounts():
+    """Giving back a shared prefix page decrefs it without freeing it
+    out from under the co-tenant stream."""
+    toks = list(range(10))
+    pool = P.PagePool(8, page_tokens=4)
+    pool.admit("s0", "t", toks, 10)
+    b = pool.admit("s1", "t", toks, 10)
+    assert b.shared > 0
+    shared_page = b.pages[0]
+    assert pool.refcount(shared_page) == 2
+    free = pool.pages_free()
+    assert pool.shrink("s1", [shared_page]) == 0  # decref, not freed
+    assert pool.refcount(shared_page) == 1
+    assert pool.pages_free() == free
+    assert shared_page not in pool.held("s1")
+    assert shared_page in pool.held("s0")

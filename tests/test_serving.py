@@ -862,3 +862,34 @@ class TestPagedKV:
         assert S.pages_for_grant(cfg, 0.0, self.PAGE) == 0
         with pytest.raises(ValueError, match="page_tokens"):
             S.pages_for_grant(cfg, 1.0, 0)
+
+    def test_chunk_growth_partial_failure_rolls_back(self, setup):
+        """Regression: a later slot's grow raising PoolExhausted
+        mid-batch used to strand the earlier slots' fresh pages — the
+        updated table never reaches the caller, so the retry would grow
+        them again. ensure_chunk_pages must shrink back exactly what
+        the failed call added."""
+        cfg, params, _ = setup
+        st = S.init_paged_state(cfg, 2, self.MAX_LEN, 5, self.PAGE)
+        pool = paging.PagePool(5, page_tokens=self.PAGE)
+        prompt = jax.random.randint(jax.random.PRNGKey(84), (6,), 0,
+                                    cfg.vocab_size)
+        # Different tenants: no prefix sharing, 2 private pages each.
+        st = S.admit_paged(params, st, pool, prompt, 0, tenant="a")
+        st = S.admit_paged(params, st, pool, prompt, 1, tenant="b")
+        assert pool.pages_free() == 1
+        held = {s: pool.held(f"slot{s}") for s in (0, 1)}
+        # Covering pos 6 + 5 needs 3 pages per slot: slot0's grow
+        # takes the last free page, slot1's raises.
+        with pytest.raises(paging.PoolExhausted):
+            S.ensure_chunk_pages(st, pool, 5)
+        assert pool.pages_free() == 1
+        assert pool.held("slot0") == held[0]
+        assert pool.held("slot1") == held[1]
+        # the caller's state is untouched: retry after capacity frees
+        # up grows cleanly.
+        assert int((st["table"][0] >= 0).sum()) == 2
+        st2 = S.release_paged(st, pool, 1)
+        st2 = S.ensure_chunk_pages(st2, pool, 5)
+        assert len(pool.held("slot0")) == 3
+        assert int((st2["table"][0] >= 0).sum()) == 3

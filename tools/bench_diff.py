@@ -71,6 +71,23 @@ def diff_gates(base: dict, fresh: dict,
     base_gates = base.get("gates") or {}
     fresh_gates = fresh.get("gates") or {}
     for name, bg in sorted(base_gates.items()):
+        if isinstance(bg, bool):
+            # Boolean gate (e.g. bench_router fairness/shed/drain
+            # proofs): no drift band — the fresh run must still pass.
+            if not bg:
+                rows.append([name, "False", "-", "-", "skip (ungated)"])
+                continue
+            fg = fresh_gates.get(name)
+            if fg is True:
+                rows.append([name, "True", "True", "-", "ok"])
+            elif fg is None:
+                rows.append([name, "True", "-", "-",
+                             "WARN (missing in fresh run)"])
+            else:
+                regressed = True
+                rows.append([name, "True", str(fg), "-",
+                             "REGRESSED (gate no longer passes)"])
+            continue
         if bg.get("gated") is False or bg.get("limit") is None:
             rows.append([name, "-", "-", "-", "skip (ungated)"])
             continue

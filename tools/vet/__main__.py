@@ -9,7 +9,13 @@ from any CWD). Exit 1 on any violation — this is the hard-gate half of
 (:mod:`tools.vet.flow`): static lock-order cycles, blocking ops
 reachable from lock scopes, and the hot-path fleet-scan budget. Its
 call-graph summaries are cached under ``.vet_cache/`` keyed on file
-mtime+size, so the pass stays sub-second on a warm tree.
+mtime+size plus a tool digest, so the pass stays sub-second on a warm
+tree.
+
+``--protocol`` runs the resource-protocol engine
+(:mod:`tools.vet.protocol`) over the same cached call graph: declared
+acquire/release state machines checked across every exception path
+(leak-on-path, double-release) and the commit-precondition budget.
 
 ``--list-pragmas`` inventories every ``# vet: ignore[...]`` pragma in
 the tree with its file:line, rule ids, and trailing justification —
@@ -37,8 +43,10 @@ FLOW_CACHE_PATH = os.path.join(REPO_ROOT, ".vet_cache", "flow.json")
 
 def _list_pragmas(roots: list[str]) -> int:
     from tools.vet.flow import FLOW_RULE_IDS
+    from tools.vet.protocol import PROTOCOL_RULE_IDS
 
-    known = {r.rule_id for r in ALL_RULES} | set(FLOW_RULE_IDS)
+    known = ({r.rule_id for r in ALL_RULES} | set(FLOW_RULE_IDS)
+             | set(PROTOCOL_RULE_IDS))
     count = 0
     missing = 0
     for path in iter_py_files(roots):
@@ -91,6 +99,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="also run the whole-program flow analysis "
                              "(lock order, blocking-under-lock, "
                              "hot-path budget)")
+    parser.add_argument("--protocol", action="store_true",
+                        help="also run the resource-protocol engine "
+                             "(leak-on-path, double-release, "
+                             "commit-precondition budget)")
     parser.add_argument("--no-flow-cache", action="store_true",
                         help="ignore and do not write the flow "
                              "call-graph cache")
@@ -98,6 +110,7 @@ def main(argv: list[str] | None = None) -> int:
 
     if opts.list_rules:
         from tools.vet.flow import FLOW_RULE_IDS
+        from tools.vet.protocol import PROTOCOL_RULE_IDS
 
         for rule in ALL_RULES:
             doc = ((rule.__doc__ or "").strip().splitlines() or [""])[0]
@@ -105,6 +118,9 @@ def main(argv: list[str] | None = None) -> int:
         for rule_id in FLOW_RULE_IDS:
             print(f"{rule_id:20s} whole-program flow rule "
                   "(--flow; see docs/vet.md)")
+        for rule_id in PROTOCOL_RULE_IDS:
+            print(f"{rule_id:27s} whole-program protocol rule "
+                  "(--protocol; see docs/vet.md)")
         return 0
 
     roots = opts.paths or [os.path.join(REPO_ROOT, "tpushare"),
@@ -117,9 +133,11 @@ def main(argv: list[str] | None = None) -> int:
     if opts.rule:
         # Import lazily: plain per-file runs never load the flow layer.
         from tools.vet.flow import FLOW_RULE_IDS
+        from tools.vet.protocol import PROTOCOL_RULE_IDS
 
         known = {r.rule_id for r in ALL_RULES}
-        unknown = set(opts.rule) - known - set(FLOW_RULE_IDS)
+        unknown = (set(opts.rule) - known - set(FLOW_RULE_IDS)
+                   - set(PROTOCOL_RULE_IDS))
         if unknown:
             print(f"unknown rule(s): {', '.join(sorted(unknown))}",
                   file=sys.stderr)
@@ -128,28 +146,47 @@ def main(argv: list[str] | None = None) -> int:
             # Asking for a flow rule IS asking for the flow pass —
             # silently running zero rules would report a false "clean".
             opts.flow = True
+        if set(opts.rule) & set(PROTOCOL_RULE_IDS):
+            opts.protocol = True
         rules = tuple(r for r in ALL_RULES if r.rule_id in opts.rule)
 
     violations = list(check_tree(roots, rules))
+    cache_path = None if opts.no_flow_cache else FLOW_CACHE_PATH
+    program = None
+    if opts.flow or opts.protocol:
+        # Both whole-program passes walk the same call graph; build it
+        # (or load its cache) once.
+        from tools.vet.flow.analysis import build_program
+
+        program = build_program(REPO_ROOT, cache_path=cache_path)
     if opts.flow:
         from tools.vet.flow import analyze
 
         # The flow pass is whole-program by nature (its call graph must
         # see every module), but its FINDINGS are scoped to the paths
         # the user asked about.
-        flow = analyze(cache_path=None if opts.no_flow_cache
-                       else FLOW_CACHE_PATH)
+        flow = analyze(program=program)
         if opts.paths:
             flow = _scope_violations(flow, opts.paths)
         if opts.rule:
             flow = [v for v in flow if v.rule in opts.rule]
         violations.extend(flow)
+    if opts.protocol:
+        from tools.vet.protocol import analyze as protocol_analyze
+
+        proto = protocol_analyze(program=program)
+        if opts.paths:
+            proto = _scope_violations(proto, opts.paths)
+        if opts.rule:
+            proto = [v for v in proto if v.rule in opts.rule]
+        violations.extend(proto)
     for v in violations:
         print(v.render())
     if violations:
         print(f"tools.vet: {len(violations)} violation(s)", file=sys.stderr)
         return 1
-    suffix = " + flow" if opts.flow else ""
+    suffix = ("" + (" + flow" if opts.flow else "")
+              + (" + protocol" if opts.protocol else ""))
     print(f"tools.vet: clean ({len(rules)} rules{suffix})")
     return 0
 

@@ -32,6 +32,22 @@ the union is what can happen in production. Container/logging method
 names are excluded so dict/set/log traffic does not pollute the graph.
 Injected callables (``self._node_getter(...)``) are invisible to the
 static graph; the runtime race detector covers that half.
+
+Protocol facts (engine 5)
+-------------------------
+
+Each function summary additionally carries a serialized **body tree**
+(``"body"``): the statement structure — ``if``/``loop``/``try`` (with
+handler types and ``finally``)/``with``/``return``/``raise`` — plus
+every call event with its receiver text, literal argument texts, and
+assignment target, and every attribute/subscript store. That is the
+control-flow skeleton :mod:`tools.vet.protocol` walks to prove each
+declared resource acquisition reaches a release/commit/transfer on
+every path out, *including the exception edges* the lock-oriented
+summaries above deliberately flatten. Module-level ``PROTOCOLS``
+literals (the per-subsystem acquire/release declarations) are captured
+here too, via ``ast.literal_eval`` — vet never imports the code it
+checks.
 """
 
 from __future__ import annotations
@@ -114,6 +130,184 @@ def _is_raw_lock_ctor(call: ast.Call) -> bool:
     return (isinstance(fn, ast.Attribute) and fn.attr in ("Lock", "RLock")
             and isinstance(fn.value, ast.Name)
             and fn.value.id == "threading")
+
+
+# ------------------------------------------------------------------------
+# Protocol facts: the serialized body tree engine 5 walks.
+# ------------------------------------------------------------------------
+
+
+def _recv_text(node: ast.expr) -> str | None:
+    """Dotted receiver text for matching (``self.client``, ``pool``);
+    subscripts collapse their index (``self.chips[cid]`` →
+    ``self.chips[*]``); anything else is unidentifiable."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _recv_text(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Subscript):
+        base = _recv_text(node.value)
+        return f"{base}[*]" if base else None
+    return None
+
+
+def _arg_text(node: ast.expr) -> str:
+    """Matchable text of one call argument: literals verbatim
+    (``repr``), names/attributes dotted, f-strings with fields
+    collapsed (``f"slot{s}"`` → ``slot*``), everything else ``?``."""
+    if isinstance(node, ast.Constant):
+        return repr(node.value)
+    if isinstance(node, ast.JoinedStr):
+        return normalize_site(node) or "?"
+    text = _recv_text(node)
+    return text if text is not None else "?"
+
+
+def _call_event(call: ast.Call, assign: str | None = None) -> dict:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+        recv = _recv_text(fn.value) or "?"
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+        recv = ""
+    else:
+        name = "?"
+        recv = "?"
+    ev: dict[str, Any] = {"k": "call", "line": call.lineno,
+                          "name": name, "recv": recv,
+                          "args": [_arg_text(a) for a in call.args
+                                   if not isinstance(a, ast.Starred)]}
+    kw = {k.arg: _arg_text(k.value) for k in call.keywords
+          if k.arg is not None}
+    if kw:
+        ev["kw"] = kw
+    if assign is not None:
+        ev["assign"] = assign
+    return ev
+
+
+def _calls_in(expr: ast.expr | None, assign: str | None = None) -> list[dict]:
+    """Every call event inside ``expr``; ``assign`` attaches to the
+    top-level call only (``x = pool.admit(...)``)."""
+    if expr is None:
+        return []
+    out = []
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            out.append(_call_event(
+                sub, assign if sub is expr else None))
+        elif isinstance(sub, (ast.Lambda, ast.ListComp, ast.SetComp,
+                              ast.DictComp, ast.GeneratorExp)):
+            pass  # deferred bodies: walked where they run, best-effort
+    return out
+
+
+def _handler_types(handler: ast.ExceptHandler) -> list[str]:
+    t = handler.type
+    if t is None:
+        return [""]  # bare except
+    items = t.elts if isinstance(t, ast.Tuple) else [t]
+    out = []
+    for item in items:
+        if isinstance(item, ast.Name):
+            out.append(item.id)
+        elif isinstance(item, ast.Attribute):
+            out.append(item.attr)
+        else:
+            out.append("?")
+    return out
+
+
+def _proto_test(test: ast.expr) -> dict:
+    """The matchable shape of an ``if`` test: a call, a negated call,
+    a plain variable, or opaque (plus any embedded call events)."""
+    neg = False
+    inner = test
+    if isinstance(inner, ast.UnaryOp) and isinstance(inner.op, ast.Not):
+        neg = True
+        inner = inner.operand
+    if isinstance(inner, ast.Call):
+        doc: dict[str, Any] = {"call": _call_event(inner)}
+        if neg:
+            doc["not"] = True
+        return doc
+    if isinstance(inner, ast.Name):
+        doc = {"var": inner.id}
+        if neg:
+            doc["not"] = True
+        return doc
+    return {"events": _calls_in(test)}
+
+
+def _proto_stmt(s: ast.stmt) -> list[dict]:
+    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                      ast.ClassDef)):
+        return []  # defining is not running; nested defs walk alone
+    if isinstance(s, ast.Return):
+        return _calls_in(s.value) + [{"k": "return", "line": s.lineno}]
+    if isinstance(s, ast.Raise):
+        return _calls_in(s.exc) + [{"k": "raise", "line": s.lineno}]
+    if isinstance(s, ast.Break):
+        return [{"k": "break"}]
+    if isinstance(s, ast.Continue):
+        return [{"k": "continue"}]
+    if isinstance(s, ast.If):
+        return [{"k": "if", "line": s.lineno, "test": _proto_test(s.test),
+                 "body": _proto_stmts(s.body),
+                 "orelse": _proto_stmts(s.orelse)}]
+    if isinstance(s, (ast.For, ast.AsyncFor)):
+        return _calls_in(s.iter) + [
+            {"k": "loop", "line": s.lineno, "body": _proto_stmts(s.body),
+             "orelse": _proto_stmts(s.orelse)}]
+    if isinstance(s, ast.While):
+        return _calls_in(s.test) + [
+            {"k": "loop", "line": s.lineno, "body": _proto_stmts(s.body),
+             "orelse": _proto_stmts(s.orelse)}]
+    if isinstance(s, ast.Try):
+        return [{"k": "try",
+                 "body": _proto_stmts(s.body),
+                 "handlers": [{"types": _handler_types(h),
+                               "body": _proto_stmts(h.body)}
+                              for h in s.handlers],
+                 "orelse": _proto_stmts(s.orelse),
+                 "final": _proto_stmts(s.finalbody)}]
+    if isinstance(s, (ast.With, ast.AsyncWith)):
+        pre: list[dict] = []
+        for item in s.items:
+            pre.extend(_calls_in(item.context_expr))
+        return pre + [{"k": "with", "body": _proto_stmts(s.body)}]
+    if isinstance(s, ast.Assign):
+        assign = (s.targets[0].id
+                  if len(s.targets) == 1
+                  and isinstance(s.targets[0], ast.Name) else None)
+        events = _calls_in(s.value, assign)
+        for t in s.targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                target = _recv_text(t)
+                if target:
+                    events.append({"k": "store", "line": s.lineno,
+                                   "target": target})
+        return events
+    if isinstance(s, (ast.AugAssign, ast.AnnAssign)):
+        return _calls_in(s.value)
+    if isinstance(s, ast.Expr):
+        return _calls_in(s.value)
+    # Anything else (assert, delete, global, pass...): surface its
+    # call events so can-raise ordering stays faithful.
+    out: list[dict] = []
+    for sub in ast.walk(s):
+        if isinstance(sub, ast.Call):
+            out.append(_call_event(sub))
+    return out
+
+
+def _proto_stmts(stmts: list[ast.stmt]) -> list[dict]:
+    out: list[dict] = []
+    for s in stmts:
+        out.extend(_proto_stmt(s))
+    return out
 
 
 class _FuncVisitor(ast.NodeVisitor):
@@ -328,6 +522,8 @@ class ModuleCollector:
         self.module_locks: dict[str, str] = {}
         #: function key ("fn" / "Cls.meth" / "outer.inner") -> summary.
         self.functions: dict[str, dict[str, Any]] = {}
+        #: the module's PROTOCOLS declarations (engine 5), if any.
+        self.protocols: list[dict[str, Any]] = []
         self._module_sleep_aliases: set[str] = set()
         self._collect(tree)
 
@@ -376,6 +572,15 @@ class ModuleCollector:
             return
         name = node.targets[0].id
         value = node.value
+        if name == "PROTOCOLS":
+            try:
+                declared = ast.literal_eval(value)
+            except (ValueError, SyntaxError):
+                declared = None
+            if isinstance(declared, list):
+                self.protocols = [d for d in declared
+                                  if isinstance(d, dict)]
+            return
         if isinstance(value, ast.Call):
             if _is_tracing_rlock_ctor(value) and value.args:
                 site = normalize_site(value.args[0])
@@ -454,6 +659,7 @@ class ModuleCollector:
             "blocking": visitor.blocking,
             "scans": visitor.scans,
             "calls": visitor.calls,
+            "body": _proto_stmts(node.body),
         }
         # Nested defs get their own (sub-keyed) summaries.
         for stmt in ast.walk(node):
@@ -470,6 +676,7 @@ class ModuleCollector:
                     "blocking": sub.blocking,
                     "scans": sub.scans,
                     "calls": sub.calls,
+                    "body": _proto_stmts(stmt.body),
                 })
 
     def to_json(self) -> dict[str, Any]:
@@ -485,6 +692,7 @@ class ModuleCollector:
                               for k, v in self.class_methods.items()},
             "module_locks": self.module_locks,
             "functions": self.functions,
+            "protocols": self.protocols,
         }
 
 

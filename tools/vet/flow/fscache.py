@@ -8,26 +8,72 @@ and always runs fresh, so a cache hit can never make the analysis
 stale across files (a change in file A re-parses only A, and the
 propagation re-reads every summary).
 
-``VERSION`` invalidates the whole cache whenever the summary format
-(or rule semantics encoded into summaries) changes. The cache file
-lives under ``.vet_cache/`` at the repo root (gitignored); passing
-``cache_path=None`` disables persistence entirely (tests, one-shot
-runs on copies).
+Two invalidation layers:
+
+* ``VERSION`` invalidates the whole cache whenever the summary schema
+  changes by deliberate bump;
+* the **tool digest** (a hash over every ``tools/vet/**/*.py`` source)
+  invalidates it whenever the analyzer itself changes — editing a rule
+  table or the collector must never reuse summaries produced by the
+  old code, even when nobody remembered to bump ``VERSION``.
+
+The cache file lives under ``.vet_cache/`` at the repo root
+(gitignored); passing ``cache_path=None`` disables persistence
+entirely (tests, one-shot runs on copies).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from typing import Any
 
 #: Bump when the summary schema or the facts collected change.
-VERSION = 1
+#: (2: per-function protocol facts — body trees, PROTOCOLS tables.)
+VERSION = 2
+
+_VET_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_digest_memo: dict[str, str] = {}
 
 
-def load(cache_path: str | None) -> dict[str, Any]:
-    """The cache document: {"version": N, "files": {path: entry}}."""
-    doc: dict[str, Any] = {"version": VERSION, "files": {}}
+def tool_digest(tool_dir: str | None = None) -> str:
+    """Hash of every analyzer source file under ``tools/vet/``. Folded
+    into the cache document so editing the analyzer (a rule table, the
+    collector, this file) discards every cached summary instead of
+    reusing facts the old code produced — the staleness hole a pure
+    (mtime, size) key on the *analyzed* files cannot see."""
+    root = tool_dir or _VET_DIR
+    memo = _digest_memo.get(root)
+    if memo is not None:
+        return memo
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(path, root).encode())
+            try:
+                with open(path, "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                continue
+    digest = h.hexdigest()
+    _digest_memo[root] = digest
+    return digest
+
+
+def load(cache_path: str | None,
+         digest: str | None = None) -> dict[str, Any]:
+    """The cache document:
+    {"version": N, "tool": digest, "files": {path: entry}}."""
+    if digest is None:
+        digest = tool_digest()
+    doc: dict[str, Any] = {"version": VERSION, "tool": digest,
+                           "files": {}}
     if cache_path is None:
         return doc
     try:
@@ -37,6 +83,8 @@ def load(cache_path: str | None) -> dict[str, Any]:
         return doc
     if loaded.get("version") != VERSION:
         return doc
+    if loaded.get("tool") != digest:
+        return doc  # the analyzer changed: every summary is suspect
     if isinstance(loaded.get("files"), dict):
         doc["files"] = loaded["files"]
     return doc

@@ -1,0 +1,17 @@
+"""vet engine 5: whole-program resource-protocol analysis.
+
+``python -m tools.vet --protocol`` walks the call-graph body trees
+(:mod:`tools.vet.flow.callgraph`) against the ``PROTOCOLS`` state
+machines declared next to the code they govern, and proves three
+invariants the runtime tests can only sample: every acquisition
+reaches a release/commit/transfer on every exception path
+(``leak-on-path``), no path releases one handle twice
+(``double-release``), and every apiserver commit of scheduler truth
+flows through the resourceVersion/uid precondition helper or a
+shrink-only budget entry (``commit-without-precondition``).
+See docs/vet.md, Engine 5.
+"""
+
+from tools.vet.protocol.analysis import PROTOCOL_RULE_IDS, analyze
+
+__all__ = ["PROTOCOL_RULE_IDS", "analyze"]

@@ -1,0 +1,701 @@
+"""Path-sensitive resource-protocol walker + the commit ratchet.
+
+The callgraph layer serializes every function body into a small
+statement tree (calls with receiver/argument text, stores, returns,
+raises, if/loop/try/with structure — see ``_proto_stmt`` in
+:mod:`tools.vet.flow.callgraph`). This module walks those trees
+against the ``PROTOCOLS`` state machines declared next to the code
+they govern and reports:
+
+* **leak-on-path** — an acquisition whose obligation is still live on
+  some ``raise`` exit: an exception between the acquire and its
+  release/commit/transfer escapes without the rollback running.
+* **double-release** — some path releases one (callable, handle) pair
+  twice; loop repetition is deliberately exempt (releasing a fresh
+  handle each iteration is the normal shape).
+* **commit-without-precondition** — ``update_pod``/``update_node``
+  called outside ``tpushare/k8s/`` commits scheduler truth without the
+  resourceVersion/uid precondition helper; every such site must either
+  migrate to :mod:`tpushare.k8s.commit` or carry a justified entry in
+  ``tools/vet/commit_budget.json`` (shrink-only, the hotpath-budget
+  ratchet pattern).
+
+Declaration schema (a module-level ``PROTOCOLS`` literal)::
+
+    PROTOCOLS = [{
+        "protocol": "page-lease",
+        "acquire": [{"call": "admit", "recv": ["pool", "self._pool"]}],
+        "release": [{"call": "release", "recv": ["pool", "self._pool"]}],
+        # optional:
+        "commit":   [{"call": "update_pod", "recv": ["client"]}],
+        "transfer": [{"store": "self._draining"}],
+        "doc": "why this protocol exists",
+    }]
+
+Matcher entry fields: ``call`` (attribute/function name, required);
+``recv`` (receiver-text allowlist; omitted = any receiver); ``args``
+(``{"0": "text"}`` positional-literal constraints); ``kw``
+(keyword-literal constraints); ``handle`` (``"arg0"`` default — the
+first positional argument identifies the resource; ``"result"`` — the
+assigned variable does; ``"none"`` — wildcard); ``truthy``
+(``"acquired"`` / ``"denied"`` — the call's truthiness reports the
+named outcome, modelled through ``if``); ``can_raise`` (``False``
+asserts the callable cannot raise, e.g. pure ledger bookkeeping —
+without it every matched call is a potential exception edge).
+
+Path model: states are (obligations, released, pending) triples of
+frozensets; every statement maps a state set to a state set plus exit
+records ``(kind, state, witness)`` with kind in fall/return/raise/
+break/continue. ``try`` routes raise exits through each handler (and
+onward when no handler catches broadly); ``finally`` re-walks the
+final block for every pre-final exit and preserves the exit kind;
+loops walk their body twice (second iteration from first-iteration
+fall states) so a leak that needs two iterations to manifest — grow
+in iteration two raising while iteration one's lease is live — is
+still on some walked path. Returns transfer ownership to the caller:
+only raise exits leak. A release may also happen through a call to a
+function that itself discharges the protocol on every normal exit
+(a small fixpoint computes that set interprocedurally).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterable, Iterator
+
+from tools.vet.engine import Violation
+from tools.vet.flow.analysis import (
+    REPO_ROOT, Program, _apply_pragmas, build_program)
+from tools.vet.flow.callgraph import EXCLUDED_ATTR_CALLS
+
+PROTOCOL_RULE_IDS = ("leak-on-path", "double-release",
+                     "commit-without-precondition")
+
+DEFAULT_COMMIT_BUDGET_PATH = os.path.join(
+    REPO_ROOT, "tools", "vet", "commit_budget.json")
+
+#: Apiserver calls that commit scheduler truth (annotation PUT).
+#: The status subresource (``update_node_status`` etc.) is telemetry,
+#: not truth, and keeps its last-write-wins semantics.
+_COMMIT_VERBS = frozenset({"update_pod", "update_node"})
+
+#: Receivers whose calls are fire-and-forget by project convention
+#: (metrics sinks swallow their own errors) — never exception edges.
+_NO_RAISE_RECV = frozenset({"log", "logger", "logging", "obs",
+                            "metrics"})
+
+#: obligation: (protocol, handle, acquire line)
+#: released:   (callable name, handle, release line)
+#: pending:    (var, protocol, handle, line, truthy mode)
+_State = tuple[frozenset, frozenset, frozenset]
+_EMPTY: _State = (frozenset(), frozenset(), frozenset())
+
+#: Pseudo-handle matching any concrete handle.
+_ANY = "*"
+
+
+def _handles_match(a: str, b: str) -> bool:
+    return a == b or a == _ANY or b == _ANY
+
+
+# -------------------------------------------------------------------------
+# Declarations → matcher
+# -------------------------------------------------------------------------
+
+
+class Matcher:
+    """All declared protocols, indexed by callable name."""
+
+    def __init__(self, protocols: list[dict[str, Any]]) -> None:
+        self.protocols = protocols
+        #: call name -> [(kind, protocol, entry)]
+        self.by_name: dict[str, list[tuple[str, str, dict]]] = {}
+        #: store-target text -> {protocols transferred}
+        self.transfers: dict[str, set[str]] = {}
+        for p in protocols:
+            proto = p.get("protocol")
+            if not isinstance(proto, str):
+                continue
+            for kind in ("acquire", "release", "commit"):
+                for entry in p.get(kind, ()):
+                    name = entry.get("call")
+                    if isinstance(name, str):
+                        self.by_name.setdefault(name, []).append(
+                            (kind, proto, entry))
+            for t in p.get("transfer", ()):
+                tgt = t.get("store")
+                if isinstance(tgt, str):
+                    self.transfers.setdefault(tgt, set()).add(proto)
+
+    def classify(self, ev: dict) -> list[tuple[str, str, dict]]:
+        out = []
+        for kind, proto, entry in self.by_name.get(ev.get("name"), ()):
+            if _entry_matches(entry, ev):
+                out.append((kind, proto, entry))
+        return out
+
+    def release_names(self) -> set[str]:
+        """Callable names that appear in any release entry."""
+        return {name for name, rows in self.by_name.items()
+                if any(kind == "release" for kind, _p, _e in rows)}
+
+
+def _entry_matches(entry: dict, ev: dict) -> bool:
+    recv = entry.get("recv")
+    if recv is not None and ev.get("recv") not in recv:
+        return False
+    args = ev.get("args", [])
+    for idx, want in entry.get("args", {}).items():
+        i = int(idx)
+        if i >= len(args) or args[i] != want:
+            return False
+    kw = ev.get("kw", {})
+    for key, want in entry.get("kw", {}).items():
+        if kw.get(key) != want:
+            return False
+    return True
+
+
+def _handle_of(entry: dict, ev: dict) -> str:
+    mode = entry.get("handle", "arg0")
+    if mode == "result":
+        return ev.get("assign") or _ANY
+    if mode == "arg0":
+        args = ev.get("args", [])
+        return args[0] if args else _ANY
+    return _ANY
+
+
+def collect_protocols(program: Program) -> list[dict[str, Any]]:
+    decls: list[dict[str, Any]] = []
+    for mod in sorted(program.modules):
+        decls.extend(program.modules[mod].get("protocols") or [])
+    return decls
+
+
+# -------------------------------------------------------------------------
+# Event iteration / call resolution
+# -------------------------------------------------------------------------
+
+
+def iter_events(body: list[dict]) -> Iterator[dict]:
+    """Every call/store event anywhere in a body tree, in document
+    order (branch structure flattened)."""
+    for node in body:
+        k = node.get("k")
+        if k in ("call", "store"):
+            yield node
+        elif k == "if":
+            test = node.get("test", {})
+            if "call" in test:
+                yield test["call"]
+            for ev in test.get("events", ()):
+                yield ev
+            yield from iter_events(node.get("body", []))
+            yield from iter_events(node.get("orelse", []))
+        elif k in ("loop", "with"):
+            yield from iter_events(node.get("body", []))
+            yield from iter_events(node.get("orelse", []))
+        elif k == "try":
+            yield from iter_events(node.get("body", []))
+            for h in node.get("handlers", ()):
+                yield from iter_events(h.get("body", []))
+            yield from iter_events(node.get("orelse", []))
+            yield from iter_events(node.get("final", []))
+
+
+def _event_spec(ev: dict, import_aliases: dict[str, str]) -> list[Any]:
+    """Map a protocol-facts call event back to a resolvable call spec
+    for :meth:`Program.resolve_call`."""
+    recv = ev.get("recv", "?")
+    name = ev.get("name", "?")
+    if recv == "":
+        return ["local", name]
+    if recv == "self":
+        return ["self", name]
+    if recv in import_aliases:
+        return ["mod", recv, name]
+    return ["attr", name]
+
+
+# -------------------------------------------------------------------------
+# The walker
+# -------------------------------------------------------------------------
+
+
+class _Walker:
+    """Walks one function's body tree; accumulates findings."""
+
+    def __init__(self, matcher: Matcher,
+                 release_effects: dict[str, set[str]],
+                 program: Program, qual: str) -> None:
+        self.matcher = matcher
+        self.release_effects = release_effects
+        self.program = program
+        self.qual = qual
+        _path, mod = program.location[qual]
+        self.import_aliases = program.modules[mod].get(
+            "import_aliases", {})
+        #: (line, name, handle, first release line) double releases.
+        self.doubles: list[tuple[int, str, str, int]] = []
+
+    # -- state helpers ---------------------------------------------------- #
+
+    def _resolved_releases(self, ev: dict) -> set[str]:
+        """Protocols discharged by calling through to a function with
+        a whole-function release effect."""
+        if not self.release_effects:
+            return set()
+        spec = _event_spec(ev, self.import_aliases)
+        targets = self.program.resolve_call(self.qual, spec)
+        out: set[str] | None = None
+        for t in targets:
+            eff = self.release_effects.get(t)
+            if eff is None:
+                return set()  # some candidate lacks the effect: unsafe
+            out = eff if out is None else (out & eff)
+        return out or set()
+
+    def _apply_event(self, ev: dict, matches, state: _State,
+                     through: set[str] | None = None) -> _State:
+        obligations, released, pending = state
+        line = ev.get("line", 0)
+        for kind, proto, entry in matches:
+            if kind == "release":
+                handle = _handle_of(entry, ev)
+                hit = {o for o in obligations
+                       if o[0] == proto and _handles_match(o[1], handle)}
+                obligations = obligations - hit
+                key = [(n, h, ln) for (n, h, ln) in released
+                       if n == ev["name"] and _handles_match(h, handle)]
+                if key and not hit:
+                    self.doubles.append(
+                        (line, ev["name"], handle, key[0][2]))
+                released = released | {(ev["name"], handle, line)}
+            elif kind == "commit":
+                obligations = frozenset(
+                    o for o in obligations if o[0] != proto)
+            elif kind == "acquire":
+                handle = _handle_of(entry, ev)
+                truthy = entry.get("truthy")
+                if truthy and ev.get("assign"):
+                    pending = frozenset(
+                        p for p in pending if p[0] != ev["assign"])
+                    pending = pending | {(ev["assign"], proto, handle,
+                                          line, truthy)}
+                else:
+                    obligations = obligations | {(proto, handle, line)}
+                released = frozenset(
+                    r for r in released
+                    if not _handles_match(r[1], handle))
+        if through:
+            obligations = frozenset(
+                o for o in obligations if o[0] not in through)
+        return (obligations, released, pending)
+
+    def _can_raise(self, ev: dict, matches) -> bool:
+        recv = ev.get("recv", "")
+        if recv in _NO_RAISE_RECV:
+            return False
+        if recv and ev.get("name") in EXCLUDED_ATTR_CALLS:
+            return False
+        for kind, _proto, entry in matches:
+            if entry.get("can_raise") is False:
+                return False
+            if kind in ("release", "commit"):
+                # Rollback/commit operations are assumed not to fail:
+                # modelling "the release itself raised" would flag
+                # every canonical except-rollback-raise handler.
+                return False
+        return True
+
+    @staticmethod
+    def _witness(ev: dict) -> tuple[int, str]:
+        recv = ev.get("recv", "")
+        label = f"{recv}.{ev['name']}()" if recv else f"{ev['name']}()"
+        return (ev.get("line", 0), label)
+
+    # -- traversal -------------------------------------------------------- #
+
+    def walk(self, stmts: list[dict],
+             states: set[_State]) -> set[tuple]:
+        """-> set of (kind, state, witness) exits, ``fall`` included."""
+        exits: set[tuple] = set()
+        cur = set(states)
+        for node in stmts:
+            if not cur:
+                break
+            step = self._step(node, cur)
+            cur = {s for k, s, _w in step if k == "fall"}
+            exits |= {e for e in step if e[0] != "fall"}
+        exits |= {("fall", s, None) for s in cur}
+        return exits
+
+    def _step(self, node: dict, states: set[_State]) -> set[tuple]:
+        k = node["k"]
+        if k == "call":
+            return self._call(node, states)
+        if k == "store":
+            protos = self.matcher.transfers.get(node.get("target", ""))
+            if protos:
+                states = {
+                    (frozenset(o for o in ob if o[0] not in protos),
+                     rel, pend)
+                    for (ob, rel, pend) in states}
+            return {("fall", s, None) for s in states}
+        if k == "return":
+            return {("return", s, None) for s in states}
+        if k == "raise":
+            w = (node.get("line", 0), "raise")
+            return {("raise", s, w) for s in states}
+        if k == "break":
+            return {("break", s, None) for s in states}
+        if k == "continue":
+            return {("continue", s, None) for s in states}
+        if k == "if":
+            return self._if(node, states)
+        if k == "loop":
+            return self._loop(node, states)
+        if k == "with":
+            return self.walk(node["body"], states)
+        if k == "try":
+            return self._try(node, states)
+        return {("fall", s, None) for s in states}
+
+    def _call(self, ev: dict, states: set[_State]) -> set[tuple]:
+        matches = self.matcher.classify(ev)
+        through = self._resolved_releases(ev) if not matches else set()
+        out: set[tuple] = set()
+        if not through and self._can_raise(ev, matches):
+            w = self._witness(ev)
+            # The exception edge fires BEFORE the effect: an acquire
+            # that raises allocates nothing; a release (direct, or a
+            # call into a release-effect function) is assumed not to
+            # fail — see ``_can_raise``.
+            out |= {("raise", s, w) for s in states}
+        out |= {("fall", self._apply_event(ev, matches, s, through),
+                 None) for s in states}
+        return out
+
+    def _if(self, node: dict, states: set[_State]) -> set[tuple]:
+        test = node.get("test", {})
+        exits: set[tuple] = set()
+        then_states: set[_State] = set()
+        else_states: set[_State] = set()
+        if "call" in test:
+            ev = test["call"]
+            matches = self.matcher.classify(ev)
+            if self._can_raise(ev, matches):
+                w = self._witness(ev)
+                exits |= {("raise", s, w) for s in states}
+            acq = next(((p, e) for k, p, e in matches
+                        if k == "acquire" and e.get("truthy")), None)
+            if acq is not None:
+                proto, entry = acq
+                handle = _handle_of(entry, ev)
+                mode = entry["truthy"]
+                neg = bool(test.get("not"))
+                for s in states:
+                    ob, rel, pend = s
+                    got = (ob | {(proto, handle, ev.get("line", 0))},
+                           frozenset(r for r in rel
+                                     if not _handles_match(r[1], handle)),
+                           pend)
+                    t_s, f_s = (got, s) if mode == "acquired" \
+                        else (s, got)
+                    if neg:
+                        t_s, f_s = f_s, t_s
+                    then_states.add(t_s)
+                    else_states.add(f_s)
+            else:
+                nxt = {self._apply_event(ev, matches, s)
+                       for s in states}
+                then_states = else_states = nxt
+        elif "var" in test:
+            var, neg = test["var"], bool(test.get("not"))
+            for s in states:
+                ob, rel, pend = s
+                row = next((p for p in pend if p[0] == var), None)
+                if row is None:
+                    then_states.add(s)
+                    else_states.add(s)
+                    continue
+                _v, proto, handle, line, mode = row
+                base_pend = frozenset(p for p in pend if p[0] != var)
+                got = (ob | {(proto, handle, line)}, rel, base_pend)
+                plain = (ob, rel, base_pend)
+                t_s, f_s = (got, plain) if mode == "acquired" \
+                    else (plain, got)
+                if neg:
+                    t_s, f_s = f_s, t_s
+                then_states.add(t_s)
+                else_states.add(f_s)
+        else:
+            cur = set(states)
+            for ev in test.get("events", ()):
+                step = self._call(ev, cur)
+                cur = {s for k, s, _w in step if k == "fall"}
+                exits |= {e for e in step if e[0] != "fall"}
+            then_states = else_states = cur
+        exits |= self.walk(node.get("body", []), then_states)
+        exits |= self.walk(node.get("orelse", []), else_states)
+        return exits
+
+
+    def _loop(self, node: dict, states: set[_State]) -> set[tuple]:
+        body = node.get("body", [])
+        it1 = self.walk(body, states)
+        exits = {e for e in it1 if e[0] in ("return", "raise")}
+        falls1 = {s for k, s, _w in it1 if k in ("fall", "continue")}
+        breaks1 = {s for k, s, _w in it1 if k == "break"}
+        # Second iteration from first-iteration fall states, with the
+        # released-set cleared: releasing a fresh handle per iteration
+        # is the normal shape, not a double-release; what we are after
+        # is an iteration-two acquire raising over iteration-one's
+        # live obligation.
+        carry = {(ob, frozenset(), pend)
+                 for (ob, rel, pend) in falls1} - states
+        if carry:
+            it2 = self.walk(body, carry)
+            exits |= {e for e in it2 if e[0] in ("return", "raise")}
+            falls1 |= {s for k, s, _w in it2 if k in ("fall", "continue")}
+            breaks1 |= {s for k, s, _w in it2 if k == "break"}
+        # One or two iterations — deliberately NOT zero: a rollback
+        # loop iterates exactly the set that was acquired, and the
+        # zero-trip path (empty collection ⇒ nothing was acquired
+        # either) is correlated in a way path-insensitive states
+        # cannot express; including it would flag every
+        # collect-and-roll-back handler.
+        after = set(falls1)
+        oexits = self.walk(node.get("orelse", []), after)
+        exits |= {e for e in oexits if e[0] != "fall"}
+        exits |= {("fall", s, None)
+                  for k, s, _w in oexits if k == "fall"}
+        exits |= {("fall", s, None) for s in breaks1}
+        return exits
+
+    def _try(self, node: dict, states: set[_State]) -> set[tuple]:
+        body_exits = self.walk(node.get("body", []), states)
+        falls = {s for k, s, _w in body_exits if k == "fall"}
+        raised = {(s, w) for k, s, w in body_exits if k == "raise"}
+        pre = {e for e in body_exits
+               if e[0] in ("return", "break", "continue")}
+        if node.get("orelse"):
+            # orelse raises bypass this try's own handlers.
+            pre |= self.walk(node["orelse"], falls)
+        else:
+            pre |= {("fall", s, None) for s in falls}
+        handlers = node.get("handlers", ())
+        catches_broadly = any(
+            set(h.get("types", ())) & {"", "BaseException", "Exception"}
+            for h in handlers)
+        if raised:
+            raised_states = {s for s, _w in raised}
+            for h in handlers:
+                pre |= self.walk(h.get("body", []), raised_states)
+            if not handlers or not catches_broadly:
+                pre |= {("raise", s, w) for s, w in raised}
+        final = node.get("final", ())
+        if final:
+            wrapped: set[tuple] = set()
+            for k, s, w in pre:
+                for fk, fs, fw in self.walk(list(final), {s}):
+                    if fk == "fall":
+                        wrapped.add((k, fs, w))
+                    else:
+                        wrapped.add((fk, fs, fw))
+            pre = wrapped
+        return pre
+
+
+# -------------------------------------------------------------------------
+# Interesting functions / release-effect fixpoint
+# -------------------------------------------------------------------------
+
+
+def _interesting(fn: dict, matcher: Matcher) -> bool:
+    body = fn.get("body")
+    if not body:
+        return False
+    stores = matcher.transfers
+    for ev in iter_events(body):
+        if ev.get("k") == "store":
+            if ev.get("target") in stores:
+                return True
+        elif matcher.classify(ev):
+            return True
+    return False
+
+
+def _release_effects(program: Program,
+                     matcher: Matcher) -> dict[str, set[str]]:
+    """qual -> protocols the function discharges on EVERY normal
+    (fall/return) exit when entered holding one wildcard obligation —
+    calling such a function counts as a release at the call site."""
+    release_names = matcher.release_names()
+    candidates: dict[str, set[str]] = {}
+    for qual, fn in program.functions.items():
+        body = fn.get("body")
+        if not body:
+            continue
+        protos = set()
+        for ev in iter_events(body):
+            if ev.get("k") != "call":
+                continue
+            for kind, proto, _e in matcher.classify(ev):
+                if kind in ("release", "commit"):
+                    protos.add(proto)
+        if protos:
+            candidates[qual] = protos
+    effects: dict[str, set[str]] = {}
+    changed = True
+    while changed:
+        changed = False
+        for qual, protos in candidates.items():
+            todo = protos - effects.get(qual, set())
+            if not todo:
+                continue
+            walker = _Walker(matcher, effects, program, qual)
+            body = program.functions[qual]["body"]
+            got = set()
+            for proto in todo:
+                seed = (frozenset({(proto, _ANY, 0)}),
+                        frozenset(), frozenset())
+                exits = walker.walk(body, {seed})
+                normal = [(k, s) for k, s, _w in exits
+                          if k in ("fall", "return")]
+                if normal and all(
+                        not any(o[0] == proto for o in s[0])
+                        for _k, s in normal):
+                    got.add(proto)
+            if got:
+                effects.setdefault(qual, set()).update(got)
+                changed = True
+    return effects
+
+
+# -------------------------------------------------------------------------
+# Rules
+# -------------------------------------------------------------------------
+
+
+def _lifecycle_violations(program: Program,
+                          matcher: Matcher) -> list[Violation]:
+    effects = _release_effects(program, matcher)
+    out: list[Violation] = []
+    for qual in sorted(program.functions):
+        fn = program.functions[qual]
+        if not _interesting(fn, matcher):
+            continue
+        path, _mod = program.location[qual]
+        walker = _Walker(matcher, effects, program, qual)
+        exits = walker.walk(fn["body"], {_EMPTY})
+        leaks: dict[tuple[str, int], tuple[int, str]] = {}
+        for k, s, w in exits:
+            if k != "raise":
+                continue
+            for proto, _handle, line in s[0]:
+                leaks.setdefault((proto, line), w or (0, "?"))
+        for (proto, line), (wline, wlabel) in sorted(leaks.items()):
+            out.append(Violation(
+                path, line, 0, "leak-on-path",
+                f"resource protocol {proto!r}: this acquisition can "
+                f"leak — {wlabel} at line {wline} can raise before "
+                "any release/commit/rollback runs; wrap the span in "
+                "try/except rollback or transfer ownership first"))
+        seen_d: set[tuple[int, str, str]] = set()
+        for line, name, handle, first in sorted(walker.doubles):
+            key = (line, name, handle)
+            if key in seen_d:
+                continue
+            seen_d.add(key)
+            out.append(Violation(
+                path, line, 0, "double-release",
+                f"handle {handle!r} is released twice on one path "
+                f"({name}() here and at line {first}) — the second "
+                "release frees another owner's resource"))
+    return out
+
+
+def _commit_violations(program: Program, budget: dict[str, Any],
+                       base: str, budget_path: str) -> list[Violation]:
+    entries = {e["id"]: e for e in budget.get("entries", [])}
+    live_ids: set[str] = set()
+    out: list[Violation] = []
+    for qual in sorted(program.functions):
+        fn = program.functions[qual]
+        body = fn.get("body")
+        if not body:
+            continue
+        path, mod = program.location[qual]
+        rel = os.path.relpath(path, base).replace(os.sep, "/")
+        if not rel.startswith("tpushare/") \
+                or rel.startswith("tpushare/k8s/"):
+            continue  # the client layer implements commits, not policy
+        func_key = qual[len(mod) + 1:]
+        reported: set[str] = set()
+        for ev in iter_events(body):
+            if ev.get("k") != "call" or ev["name"] not in _COMMIT_VERBS:
+                continue
+            site_id = f"{rel}::{func_key}::{ev['name']}"
+            live_ids.add(site_id)
+            if site_id in entries or site_id in reported:
+                continue
+            reported.add(site_id)
+            out.append(Violation(
+                path, ev.get("line", 0), 0,
+                "commit-without-precondition",
+                f"{ev['name']} commits scheduler truth without "
+                "resourceVersion/uid preconditions — route it through "
+                "tpushare/k8s/commit.py, or justify it with a budget "
+                f"entry {site_id!r} in tools/vet/commit_budget.json"))
+    # The ratchet: stale or unjustified manifest entries fail too.
+    for site_id, entry in sorted(entries.items()):
+        if site_id not in live_ids:
+            out.append(Violation(
+                budget_path, 1, 0, "commit-without-precondition",
+                f"stale budget entry {site_id!r}: no live commit site "
+                "matches it — delete the entry (the manifest may only "
+                "shrink)"))
+        elif not str(entry.get("justification", "")).strip():
+            out.append(Violation(
+                budget_path, 1, 0, "commit-without-precondition",
+                f"budget entry {site_id!r} carries no justification — "
+                "every unconditional commit kept must name the "
+                "follow-up that retires it"))
+    return out
+
+
+# -------------------------------------------------------------------------
+# Entry point
+# -------------------------------------------------------------------------
+
+
+def analyze(root: str | None = None, *,
+            budget: dict[str, Any] | None = None,
+            budget_path: str | None = None,
+            cache_path: str | None = None,
+            program: Program | None = None) -> list[Violation]:
+    """Run the protocol pass; returns pragma-filtered violations.
+
+    ``root`` is a directory containing ``tpushare/`` (defaults to the
+    repo root); the program (and its fscache) is shared with the flow
+    pass when the caller passes one in. ``budget`` overrides the
+    commit manifest inline (tests); otherwise ``budget_path``
+    (default: the checked-in manifest) is loaded."""
+    base = root or REPO_ROOT
+    if program is None:
+        program = build_program(base, cache_path=cache_path)
+    bpath = budget_path or DEFAULT_COMMIT_BUDGET_PATH
+    if budget is None:
+        try:
+            with open(bpath, encoding="utf-8") as f:
+                budget = json.load(f)
+        except OSError:
+            budget = {"entries": []}
+    matcher = Matcher(collect_protocols(program))
+    violations = []
+    violations += _lifecycle_violations(program, matcher)
+    violations += _commit_violations(program, budget, base, bpath)
+    return _apply_pragmas(violations)

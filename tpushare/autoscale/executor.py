@@ -70,7 +70,7 @@ from tpushare.cache.cache import SchedulerCache
 from tpushare.defrag import frag
 from tpushare.defrag.executor import _env_float, _env_int
 from tpushare.defrag.planner import RebalancePlanner, WhatIf
-from tpushare.k8s import builders, eviction
+from tpushare.k8s import builders, commit, eviction
 from tpushare.k8s.errors import ApiError
 from tpushare.quota.manager import QuotaManager
 from tpushare.utils import locks
@@ -84,6 +84,36 @@ MODES = ("off", "dry-run", "active")
 #: Seconds between TPUShareAutoscaleAborted Events per reason: the
 #: abort counter carries the rate, the Event is the operator page.
 ABORT_EVENT_INTERVAL_S = 600.0
+
+#: vet engine-5 state machine (docs/vet.md): a successful cordon
+#: (``_set_cordon(name, True)``) takes a node out of service; until
+#: the drain record is published (``self._draining = ...``, the
+#: ``transfer`` — from there the tick loop owns the uncordon-or-
+#: delete), every raising path must uncordon (``_set_cordon(name,
+#: False)``) or the node is stranded unschedulable with no drain
+#: driving it. ``_set_cordon`` reports failure as False and swallows
+#: its own ApiErrors (``can_raise: false``); the True/False literal
+#: pins acquire vs release.
+PROTOCOLS = [
+    {
+        "protocol": "drain-cordon",
+        "acquire": [
+            {"call": "_set_cordon", "recv": ["self"],
+             "args": {"1": "True"}, "truthy": "acquired",
+             "can_raise": False},
+        ],
+        "release": [
+            {"call": "_set_cordon", "recv": ["self"],
+             "args": {"1": "False"}},
+            {"call": "delete_node", "recv": ["self.client"]},
+        ],
+        "transfer": [
+            {"store": "self._draining"},
+        ],
+        "doc": "Autoscale drain cordons: an acquired cordon is owned "
+               "by the published drain record or rolled back.",
+    },
+]
 
 
 class AutoscaleExecutor:
@@ -600,7 +630,7 @@ class AutoscaleExecutor:
                 raw.setdefault("spec", {})["unschedulable"] = True
             else:
                 raw.setdefault("spec", {}).pop("unschedulable", None)
-            self.client.update_node(Node(raw))
+            commit.committed_update_node(self.client, Node(raw))
             return True
         # Counted: the caller records the failed action via _count;
         # the log line carries the API detail.

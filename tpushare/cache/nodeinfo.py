@@ -42,6 +42,34 @@ class AllocationError(Exception):
 #: Bound on the per-node verb memos (distinct request shapes cached).
 MEMO_CAP = 64
 
+#: vet engine-5 state machine (docs/vet.md): ``allocate``'s
+#: provisional HBM charge (``self.chips[cid].add_pod``) must reach a
+#: rollback (``remove_pod``) or an apiserver commit
+#: (``update_pod``/``bind_pod``) on every raising path — a leaked
+#: charge blocks its chips forever (nothing ever frees a hold with no
+#: persisted grant). ``add_pod`` is pure ledger bookkeeping under the
+#: node lock (``can_raise: false``); the receiver allowlist pins the
+#: machine to the provisional-charge sites, not the informer's
+#: steady-state ``add_or_update_pod`` traffic.
+PROTOCOLS = [
+    {
+        "protocol": "chip-charge",
+        "acquire": [
+            {"call": "add_pod", "recv": ["self.chips[*]"],
+             "can_raise": False},
+        ],
+        "release": [
+            {"call": "remove_pod", "recv": ["self.chips[*]"]},
+        ],
+        "commit": [
+            {"call": "update_pod", "recv": ["client", "self.client"]},
+            {"call": "bind_pod", "recv": ["client", "self.client"]},
+        ],
+        "doc": "NodeInfo.allocate provisional chip charges: roll back "
+               "on write failure, commit on the accepted grant.",
+    },
+]
+
 
 class NodeSummary(NamedTuple):
     """Immutable free-capacity digest of one node's ledger — the unit of
@@ -544,10 +572,14 @@ class NodeInfo:
                 )
                 for cid in chip_ids:
                     self.chips[cid].add_pod(provisional)
-            trace.note("chips", list(chip_ids))
-            trace.note("hbmGiB", hbm_pod)
 
             try:
+                # Inside the try: the provisional charge is live from
+                # here on, and even telemetry failures must roll it
+                # back (engine 5's leak-on-path would flag these notes
+                # between the charge and the try as an escape hatch).
+                trace.note("chips", list(chip_ids))
+                trace.note("hbmGiB", hbm_pod)
                 try:
                     new_pod = client.update_pod(provisional)
                 except ConflictError:
@@ -578,6 +610,7 @@ class NodeInfo:
                 if any(provisional.uid in self.chips[c].pods
                        for c in chip_ids):
                     for cid in chip_ids:
+                        # vet: ignore[leak-on-path] - re-price, not a new charge: same uid replaces the provisional hold the commit above already persisted; the informer's delete is the release
                         self.chips[cid].add_pod(new_pod)
             # Rebuild the admission summary on the bind path's own
             # thread (~µs) so the next filter reads it for free.

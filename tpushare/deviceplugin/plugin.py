@@ -33,6 +33,7 @@ import time
 
 from tpushare.api.objects import Pod
 from tpushare.deviceplugin.discovery import HostInventory
+from tpushare.k8s import commit
 from tpushare.k8s.errors import ConflictError
 from tpushare.utils import const, locks, pod as podutils
 
@@ -155,7 +156,7 @@ class TPUSharePlugin:
         slice_id = os.environ.get("TPUSHARE_SLICE_ID", "")
         if slice_id:
             ann[const.ANN_NODE_SLICE] = slice_id
-        self.client.update_node(node)
+        commit.committed_update_node(self.client, node)
 
     # ------------------------------------------------------------------ #
     # Allocate (reference designs.md:92-104)
@@ -483,7 +484,7 @@ class TPUSharePlugin:
                 "annotations", {})
             ann[const.ANN_ASSIGNED] = const.ASSIGNED_TRUE
             try:
-                self.client.update_pod(fresh)
+                commit.committed_update_pod(self.client, fresh)
                 return
             except ConflictError:
                 if attempt == retries - 1:

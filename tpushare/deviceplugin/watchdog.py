@@ -45,7 +45,7 @@ import time
 from prometheus_client import CollectorRegistry, Gauge, generate_latest
 
 from tpushare.api.objects import Pod
-from tpushare.k8s import events, eviction
+from tpushare.k8s import commit, events, eviction
 from tpushare.k8s.errors import ApiError, ConflictError, NotFoundError
 from tpushare.utils import const, pod as podutils
 
@@ -271,7 +271,7 @@ class GrantWatchdog:
                 ann[const.ANN_OVERRUN] = const.ASSIGNED_TRUE
             else:
                 ann.pop(const.ANN_OVERRUN, None)
-            self.client.update_pod(fresh)
+            commit.committed_update_pod(self.client, fresh)
         except ConflictError:
             pass  # next sweep retries with a fresh read
         except Exception:  # noqa: BLE001 - telemetry never breaks the node
@@ -291,7 +291,7 @@ class GrantWatchdog:
                 "annotations", {})
             ann.pop(const.ANN_HBM_USED, None)
             ann.pop(const.ANN_OVERRUN, None)
-            self.client.update_pod(fresh)
+            commit.committed_update_pod(self.client, fresh)
         except ConflictError:
             pass  # next sweep retries
         except Exception:  # noqa: BLE001 - telemetry never breaks the node

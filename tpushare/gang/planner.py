@@ -40,13 +40,40 @@ from tpushare import obs, trace
 from tpushare.utils import locks
 from tpushare.api.objects import Pod, binding_doc
 from tpushare.cache.nodeinfo import AllocationError
-from tpushare.k8s import events
+from tpushare.k8s import commit, events
 from tpushare.k8s.errors import ApiError, NotFoundError
 from tpushare.utils import node as nodeutils
 from tpushare.utils import const
 from tpushare.utils import pod as podutils
 
 log = logging.getLogger(__name__)
+
+#: vet engine-5 state machine (docs/vet.md): an unbound allocation
+#: (``info.allocate(..., bind=False)``) holds a ledger charge plus
+#: persisted grant annotations that only the TTL sweep can reclaim —
+#: and only if the reservation reached the group table. Until that
+#: handoff (``group.reservations[uid] = ...``, the ``transfer``),
+#: every raising path must undo both (``cache.remove_pod`` +
+#: annotation strip). The ``bind=False`` keyword pins the machine to
+#: reservation allocates; the bind verb's ``allocate`` commits
+#: immediately inside NodeInfo and is covered by ``chip-charge``.
+PROTOCOLS = [
+    {
+        "protocol": "gang-reservation",
+        "acquire": [
+            {"call": "allocate", "recv": ["info"],
+             "kw": {"bind": "False"}, "handle": "result"},
+        ],
+        "release": [
+            {"call": "remove_pod", "recv": ["self.cache"]},
+        ],
+        "transfer": [
+            {"store": "group.reservations[*]"},
+        ],
+        "doc": "Gang TTL reservations: roll back the ledger hold when "
+               "the reservation cannot reach the group table.",
+    },
+]
 
 
 #: Substring every GangPending message carries. The wire format has no
@@ -649,17 +676,27 @@ class GangPlanner:
         if info is None:
             raise AllocationError(f"unknown node {node_name}")
         reserved = info.allocate(self.client, pod, bind=False)
-        self.cache.add_or_update_pod(reserved)
-        with group.lock:
-            with self._table_lock:
-                live = (self._groups.get(key) is group
-                        and not group.rolled_back)
-            if live:
-                group.reservations[pod.uid] = (reserved, node_name)
-                log.info("gang %s/%s: reserved member %s on %s (%d/%d)",
-                         pod.namespace, group.name, pod.name, node_name,
-                         len(group.reservations), group.minimum)
-                return
+        try:
+            self.cache.add_or_update_pod(reserved)
+            with group.lock:
+                with self._table_lock:
+                    live = (self._groups.get(key) is group
+                            and not group.rolled_back)
+                if live:
+                    group.reservations[pod.uid] = (reserved, node_name)
+                    log.info("gang %s/%s: reserved member %s on %s "
+                             "(%d/%d)", pod.namespace, group.name,
+                             pod.name, node_name,
+                             len(group.reservations), group.minimum)
+                    return
+        except BaseException:
+            # Anything failing between the allocate and the table
+            # insert leaves a ledger hold plus persisted annotations
+            # that no TTL sweep would ever find (the reservation never
+            # made the table) — undo both before propagating.
+            self.cache.remove_pod(reserved)
+            self._strip_annotations(reserved)
+            raise
         # The group was rolled back (TTL expiry) while our allocate was
         # in flight: undo the ledger hold and the annotations, then let
         # the scheduler retry into a fresh group.
@@ -896,7 +933,7 @@ class GangPlanner:
             for k in const.GRANT_ANNOTATIONS:
                 ann.pop(k, None)
             fresh.raw.setdefault("spec", {}).pop("nodeName", None)
-            self.client.update_pod(fresh)
+            commit.committed_update_pod(self.client, fresh)
         except ApiError as e:
             log.debug("gang rollback: annotation strip for %s failed (%s); "
                       "sync will reconcile", pod.key(), e)

@@ -1,0 +1,61 @@
+"""Precondition-carrying commits of scheduler truth.
+
+All durable scheduler state lives in pod/node annotations (PAPER.md
+§durable-state), so an annotation PUT *is* a state-machine commit.
+Under a single active scheduler, last-write-wins updates are merely
+risky; under the active-active HA follow-up (ROADMAP item 1) they are
+wrong — two schedulers both get their blind write in and the second
+silently erases the first grant. The fix is the standard kubernetes
+optimistic-concurrency discipline: every commit must carry the
+``resourceVersion`` it read (so a concurrent writer turns the PUT
+into a typed :class:`~tpushare.k8s.errors.ConflictError` the caller
+retries) and, for pods, the ``uid`` (so a delete-and-recreate under
+the same name cannot absorb a stale grant).
+
+These helpers enforce that discipline at the seam. vet's
+``commit-without-precondition`` rule (engine 5, docs/vet.md) requires
+every ``update_pod``/``update_node`` outside ``tpushare/k8s/`` to
+flow through here or carry a justified ``tools/vet/commit_budget.json``
+entry — so blind commits are named debts, not silent passes.
+
+Nodes carry no uid requirement: node identity is stable by name
+(kubelet re-registration reuses it), and the fake apiserver — like a
+real one for objects created before uid plumbing — stamps
+``resourceVersion`` on every write but not necessarily ``uid``.
+"""
+
+from __future__ import annotations
+
+from tpushare.api.objects import Node, Pod
+
+
+class PreconditionError(ValueError):
+    """The object offered for commit carries no optimistic-concurrency
+    preconditions — committing it would be a blind last-write-wins
+    PUT. Re-read the object (``get_pod``/``get_node``) and re-apply
+    the mutation to the fresh copy."""
+
+
+def committed_update_pod(client, pod: Pod) -> Pod:
+    """PUT ``pod`` with resourceVersion+uid preconditions enforced."""
+    if not pod.resource_version:
+        raise PreconditionError(
+            f"refusing blind pod commit for {pod.key()}: no "
+            "resourceVersion — mutate a freshly read copy, not a "
+            "locally built one")
+    if not pod.uid:
+        raise PreconditionError(
+            f"refusing blind pod commit for {pod.key()}: no uid — a "
+            "delete-and-recreate under the same name could absorb "
+            "this stale grant")
+    return client.update_pod(pod)
+
+
+def committed_update_node(client, node: Node) -> Node:
+    """PUT ``node`` with a resourceVersion precondition enforced."""
+    if not node.resource_version:
+        raise PreconditionError(
+            f"refusing blind node commit for {node.name}: no "
+            "resourceVersion — mutate a freshly read copy, not a "
+            "locally built one")
+    return client.update_node(node)

@@ -43,6 +43,29 @@ REASON_NODE_COOLDOWN = "node-cooldown"
 
 _HOUR_S = 3600.0
 
+#: vet engine-5 state machine (docs/vet.md): an admitted
+#: ``budget.acquire`` holds an in-flight slot until ``release`` on
+#: EVERY path — a leaked slot permanently shrinks ``max_concurrent``.
+#: The call's truthiness reports *denial* (it returns the reason
+#: string, "" when admitted), hence ``truthy: denied``; it mutates
+#: nothing before its own return, hence ``can_raise: false``.
+PROTOCOLS = [
+    {
+        "protocol": "eviction-slot",
+        "acquire": [
+            {"call": "acquire",
+             "recv": ["budget", "self.budget", "self._budget"],
+             "truthy": "denied", "can_raise": False},
+        ],
+        "release": [
+            {"call": "release",
+             "recv": ["budget", "self.budget", "self._budget"]},
+        ],
+        "doc": "EvictionBudget in-flight slots: an admitted acquire "
+               "must be paired with release in a finally.",
+    },
+]
+
 
 class EvictionBudget:
     """Hard caps every eviction must pass through. A zero limit means

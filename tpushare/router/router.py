@@ -68,6 +68,26 @@ if TYPE_CHECKING:  # pragma: no cover - typing only
 TTFT_WINDOW = 512          #: TTFT samples kept per tenant and fleet-wide
 TOKENS_WINDOW_S = 10.0     #: horizon for the fleet tokens/s figure
 
+#: vet engine-5 state machine (docs/vet.md): a replica's
+#: ``_charge_pages`` debits ``_pages_used`` (the capacity signal
+#: ``can_admit`` gates on); the charge is owned by the inflight list
+#: from admission until ``_retire_pages`` credits it back. A charge
+#: leaked on a raising path inflates ``_pages_used`` forever and the
+#: replica slowly stops admitting.
+PROTOCOLS = [
+    {
+        "protocol": "page-charge",
+        "acquire": [
+            {"call": "_charge_pages", "recv": ["self"]},
+        ],
+        "release": [
+            {"call": "_retire_pages", "recv": ["self"]},
+        ],
+        "doc": "Router replica page accounting: every charge retires "
+               "with its request.",
+    },
+]
+
 
 def _bucket(n: int, buckets: tuple[int, ...], max_len: int) -> int:
     """Padded admission length for an ``n``-token prompt (the compiled

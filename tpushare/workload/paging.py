@@ -104,6 +104,33 @@ class PoolExhausted(RuntimeError):
     should have sized the reservation (router ``pages_free``)."""
 
 
+#: vet engine-5 state machine (docs/vet.md): every ``pool.admit`` /
+#: ``pool.grow`` must reach a ``release``/``shrink`` on every raising
+#: path, or the pool's free list drifts down until admission starves.
+#: Both acquire calls raise :class:`PoolExhausted` *allocating
+#: nothing*, so their own failure is not a leak.
+PROTOCOLS = [
+    {
+        "protocol": "page-lease",
+        "acquire": [
+            {"call": "admit", "recv": ["pool", "self.pool", "self._pool"]},
+            {"call": "grow", "recv": ["pool", "self.pool", "self._pool"]},
+        ],
+        "release": [
+            {"call": "release",
+             "recv": ["pool", "self.pool", "self._pool"]},
+            # The batch-rollback verb: its owner argument is loop-bound
+            # over whatever was collected, so the handle is wildcard.
+            {"call": "shrink",
+             "recv": ["pool", "self.pool", "self._pool"],
+             "handle": "none"},
+        ],
+        "doc": "PagePool leases: admit/grow charge the free list; "
+               "release/shrink give it back.",
+    },
+]
+
+
 @dataclass(frozen=True)
 class PageLease:
     """One stream's page allocation: physical ids in logical order.
@@ -239,16 +266,44 @@ class PagePool:
         freed = 0
         with self._lock:
             for pid in self._leases.pop(owner, []):
-                self._refs[pid] -= 1
-                if self._refs[pid] > 0:
-                    continue  # still shared by another stream
-                del self._refs[pid]
-                key = self._page_key.pop(pid, None)
-                if key is not None:
-                    self._index.pop(key, None)
-                self._free.append(pid)
-                freed += 1
+                freed += self._drop_ref(pid)
         return freed
+
+    def shrink(self, owner: str, pages: Sequence[int]) -> int:
+        """Give back specific pages from a live lease — the partial
+        rollback of :meth:`grow` when the caller failed to install the
+        grown pages (e.g. a later slot's grow raised mid-batch).
+        Pages not held by the lease are ignored (idempotent, like
+        :meth:`release`). Returns the number of pages freed."""
+        freed = 0
+        give = list(pages)
+        with self._lock:
+            lease = self._leases.get(owner)
+            if lease is None:
+                return 0
+            for pid in give:
+                try:
+                    lease.remove(pid)
+                except ValueError:
+                    continue  # not (or no longer) part of the lease
+                freed += self._drop_ref(pid)
+        return freed
+
+    def _drop_ref(self, pid: int) -> int:
+        """Decref one page; free it (and evict its index entry) at
+        zero. Callers already hold the (reentrant) lock; re-acquiring
+        keeps the guarded mutations lexically inside it. Returns 1
+        when freed."""
+        with self._lock:
+            self._refs[pid] -= 1
+            if self._refs[pid] > 0:
+                return 0  # still shared by another stream
+            del self._refs[pid]
+            key = self._page_key.pop(pid, None)
+            if key is not None:
+                self._index.pop(key, None)
+            self._free.append(pid)
+            return 1
 
     # -- telemetry ---------------------------------------------------------
 

@@ -1140,17 +1140,30 @@ def ensure_chunk_pages(state: dict, pool: paging.PagePool,
     active = jax.device_get(state["active"])
     table = state["table"]
     mapped = jax.device_get((table >= 0).sum(axis=1))
-    for s in range(state["pos"].shape[0]):
-        if not bool(active[s]):
-            continue
-        upto = min(int(pos[s]) + n_steps, max_len)
-        need = pages_for(upto, page)
-        have = int(mapped[s])
-        if need > have:
-            fresh = pool.grow(f"slot{s}", need - have)
-            table = table.at[s, have:need].set(
-                jnp.asarray(fresh, jnp.int32))
-    return dict(state, table=table)
+    grown: list[tuple[str, tuple[int, ...]]] = []
+    try:
+        for s in range(state["pos"].shape[0]):
+            if not bool(active[s]):
+                continue
+            upto = min(int(pos[s]) + n_steps, max_len)
+            need = pages_for(upto, page)
+            have = int(mapped[s])
+            if need > have:
+                fresh = pool.grow(f"slot{s}", need - have)
+                grown.append((f"slot{s}", fresh))
+                table = table.at[s, have:need].set(
+                    jnp.asarray(fresh, jnp.int32))
+        out = dict(state, table=table)
+    except BaseException:
+        # A later slot's grow (or table edit) failed after earlier
+        # slots already grew: the updated table never reaches the
+        # caller, so those leases would keep pages no table row maps —
+        # and the caller's retry would grow them AGAIN. Shrink back
+        # exactly what this call added, then let the failure propagate.
+        for owner, pages in grown:
+            pool.shrink(owner, pages)
+        raise
+    return out
 
 
 def serve_chunk_paged(params: dict, state: dict,
