@@ -29,7 +29,7 @@ lint:
 # fails on any lock-order cycle (potential deadlock) or any mutation of
 # a registered guarded container while its lock is unheld.
 test-race:
-	TPUSHARE_RACE_DETECT=1 python -m pytest tests/test_soak.py tests/test_scale.py tests/test_vet.py tests/test_trace.py tests/test_profiling.py tests/test_http_server.py -q
+	TPUSHARE_RACE_DETECT=1 python -m pytest tests/test_soak.py tests/test_scale.py tests/test_vet.py tests/test_trace.py tests/test_profiling.py tests/test_http_server.py tests/test_blackbox.py tests/test_crash_forensics.py -q
 
 # On-chip Pallas kernel regression — REQUIRES real TPU hardware.
 # Interpreter-mode tests cannot catch (8,128)-tiling / MXU lowering

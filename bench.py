@@ -1471,6 +1471,92 @@ def _timeline_overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
     return _probe_verdict(p99s)
 
 
+def _blackbox_overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
+                             per_batch: int = 500) -> dict:
+    """The black-box journal + push exporter's overhead gate: the same
+    interleaved mutation-free batches as :func:`_overhead_probe`, with
+    the flight journal (decision tee + marker tee) and a real-HTTP
+    localhost export sink armed vs disarmed. The timeline recorder
+    runs in BOTH arms so the delta isolates the durable half: the
+    fire-and-forget tee into two bounded queues must cost the gated
+    handlers nothing measurable (docs/observability.md §7).
+
+    The verdict carries an ms-unit ``value``/``limit`` pair (unlike
+    :func:`_probe_verdict`) so the BENCH_SCALE drift contract diffs
+    the delta as a scalar."""
+    import http.server
+    import os
+    import tempfile
+    import threading
+
+    from tpushare import obs
+
+    class _Sink(http.server.BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # noqa: ARG002
+            pass
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    sink = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Sink)
+    threading.Thread(target=sink.serve_forever, daemon=True).start()
+
+    from tpushare.k8s.builders import make_pod
+    pod = fleet.api.create_pod(make_pod("blackbox-probe", hbm=24))
+    prior_dir = os.environ.get("TPUSHARE_BLACKBOX_DIR")
+    prior_url = os.environ.get("TPUSHARE_EXPORT_URL")
+    was_timeline = obs.timeline().running()
+    if not was_timeline:
+        obs.start()
+
+    p99s: dict[bool, list[float]] = {True: [], False: []}
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            for _ in range(batches):
+                for armed in (False, True):
+                    if armed:
+                        os.environ["TPUSHARE_BLACKBOX_DIR"] = tmp
+                        os.environ["TPUSHARE_EXPORT_URL"] = (
+                            f"http://127.0.0.1:{sink.server_address[1]}"
+                            f"/telemetry")
+                        obs.start()
+                    else:
+                        os.environ.pop("TPUSHARE_BLACKBOX_DIR", None)
+                        os.environ.pop("TPUSHARE_EXPORT_URL", None)
+                        obs.stop_blackbox()
+                    p99s[armed].append(_probe_batch(fleet, rng, pod,
+                                                    per_batch))
+            obs.stop_blackbox()
+    finally:
+        for key, prior in (("TPUSHARE_BLACKBOX_DIR", prior_dir),
+                           ("TPUSHARE_EXPORT_URL", prior_url)):
+            if prior is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prior
+        if not was_timeline:
+            obs.stop()
+        sink.shutdown()
+        sink.server_close()
+
+    p99_off = min(p99s[False])
+    p99_on = min(p99s[True])
+    delta_ms = max(p99_on - p99_off, 0.0)
+    allowance_ms = max(SCALE_GATE_OVERHEAD * p99_off,
+                       SCALE_GATE_OVERHEAD_FLOOR_MS)
+    return {
+        "value": round(delta_ms, 3),
+        "limit": round(allowance_ms, 3),
+        "pass": delta_ms <= allowance_ms,
+        "p99_off_ms": round(p99_off, 3),
+        "p99_on_ms": round(p99_on, 3),
+        "p99_delta": round(delta_ms / p99_off if p99_off else 0.0, 4),
+    }
+
+
 # ------------------------------------------------------------------------- #
 # The subprocess wire client: the honest wire clock (ROADMAP item 4)
 # ------------------------------------------------------------------------- #
@@ -1884,6 +1970,7 @@ def bench_scale(nodes: int = SCALE_NODES,
     collapsed = profiling.profiler().collapsed(window_s=3600)
     overhead = _overhead_probe(fleet, rng)
     timeline_overhead = _timeline_overhead_probe(fleet, rng)
+    blackbox_overhead = _blackbox_overhead_probe(fleet, rng)
 
     # -- the honest wire clock (subprocess clients; docs/perf.md) ----- #
     # LAST, after the overhead probe: the concurrency section's client
@@ -1940,6 +2027,7 @@ def bench_scale(nodes: int = SCALE_NODES,
         "verb_costs": hotspots["verbCosts"],
         "overhead_gate": overhead,
         "timeline_overhead_gate": timeline_overhead,
+        "blackbox_overhead_gate": blackbox_overhead,
         # The honest wire story: a SEPARATE-process client's clock
         # (no GIL sharing with the extender), gated against its own
         # handler readings, plus the 1-vs-8-client throughput proof.
@@ -1980,6 +2068,9 @@ def main_scale(smoke: bool) -> None:
         # Retrospective recorder: armed-vs-disarmed handler p99 on the
         # same interleaved batches (docs/observability.md).
         "timeline_overhead": result["timeline_overhead_gate"],
+        # Durable half: journal + export tee armed vs off on the same
+        # batches, ms-unit value/limit (docs/observability.md §7).
+        "blackbox_overhead": result["blackbox_overhead_gate"],
         # Wire clock: subprocess client's wire p99 <= its handler p99
         # + 1.5 ms (docs/perf.md wire section).
         "wire_p99_vs_handler": result["wire_gate"],

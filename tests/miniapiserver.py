@@ -66,6 +66,12 @@ class MiniApiServer:
         #: >0: paginate LISTs at this size with NASTY_TOKEN-prefixed
         #: continue tokens.
         self.page_size = page_size
+        #: JSON-lines records POSTed to /telemetry (the obs export
+        #: sink for real-HTTP round-trip tests); no auth — the
+        #: exporter carries no token.
+        self.telemetry: list = []
+        #: >0: 503 the next N /telemetry posts (retry/backoff injector).
+        self.telemetry_fail = 0
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
         self.port = self.httpd.server_address[1]
@@ -245,10 +251,24 @@ def _make_handler(server: MiniApiServer):
             self._status_error(404, "NotFound")
 
         def do_POST(self):  # noqa: N802
+            path = self.path.split("?", 1)[0]
+            if path == "/telemetry":
+                # obs export sink: unauthenticated ndjson intake.
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                with store.lock:
+                    if server.telemetry_fail > 0:
+                        server.telemetry_fail -= 1
+                        self._status_error(503, "SinkDown")
+                        return
+                    for line in body.decode().splitlines():
+                        if line.strip():
+                            server.telemetry.append(json.loads(line))
+                self._json({"kind": "Status", "status": "Success"})
+                return
             if not self._authed():
                 self._status_error(401, "Unauthorized")
                 return
-            path = self.path.split("?", 1)[0]
             m = _EVICT_RE.match(path)
             if m:
                 # pods/eviction subresource: the defrag executor's (and

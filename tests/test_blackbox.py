@@ -1,0 +1,335 @@
+"""Black-box flight journal + push export: the ISSUE-17 unit contract
+(tpushare/obs/blackbox.py, tpushare/obs/export.py,
+docs/observability.md §7).
+
+Covers: the CRC frame round-trip through rotation with fsync on seal,
+segment pruning at the cap, torn-tail truncation (a crash mid-frame
+never serves half a record), every fire-and-forget bound (full intake
+queue, raising disk, raising hooks — all counted drops, nothing
+propagates), the flush durability point with its never-wedge timeout,
+the exporter's retry/backoff schedule under an injectable clock/sleep
+(exponential growth, cap, one stall per outage, at-least-once
+redelivery of the pending batch), the W3C traceparent parse/format
+contract, and cmd/main's signal handler (first signal flushes before
+shutdown, a raising flush still stops, a second signal force-exits).
+"""
+
+import os
+import struct
+import threading
+import zlib
+
+import pytest
+
+from tpushare import trace
+from tpushare.cmd.main import setup_signals
+from tpushare.obs.blackbox import (DEFAULT_MAX_SEGMENTS, QUEUE_DEPTH,
+                                   BlackboxJournal, list_segments,
+                                   replay)
+from tpushare.obs.export import Exporter
+from tpushare.trace.recorder import (format_traceparent,
+                                     parse_traceparent)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace():
+    yield
+    trace.reset()
+
+
+# --------------------------------------------------------------------- #
+# journal: frames, rotation, durability
+# --------------------------------------------------------------------- #
+
+def test_journal_round_trip_and_rotation(tmp_path):
+    """Appended docs come back from replay() in order; crossing the
+    segment cap seals (fsync) and rotates, pruning the oldest past
+    max_segments, and the on_rotate hook sees each new seq."""
+    rotated = []
+    j = BlackboxJournal(str(tmp_path), segment_bytes=256, max_segments=3)
+    j.on_rotate = rotated.append
+    j.start()
+    docs = [{"t": "marker", "i": i, "pad": "x" * 40} for i in range(30)]
+    for doc in docs:
+        j.append(doc)
+    assert j.flush(timeout=5.0)
+    j.stop()
+    assert j.rotations > 0
+    assert rotated and rotated == sorted(rotated)
+    segments = list_segments(str(tmp_path))
+    assert 0 < len(segments) <= 3
+    replayed = replay(str(tmp_path))
+    # Pruned segments lost the head; the surviving tail is intact,
+    # ordered, and ends with the last record written.
+    assert replayed == docs[-len(replayed):]
+    assert replayed[-1]["i"] == 29
+
+
+def test_journal_restart_opens_new_segment(tmp_path):
+    """A second process (or restart) never appends to a previous
+    segment — it opens max(seq)+1, so a torn tail in the old segment
+    cannot corrupt new records."""
+    j1 = BlackboxJournal(str(tmp_path))
+    j1.start()
+    j1.append({"run": 1})
+    assert j1.flush()
+    j1.stop()
+    j2 = BlackboxJournal(str(tmp_path))
+    j2.start()
+    j2.append({"run": 2})
+    assert j2.flush()
+    j2.stop()
+    assert len(list_segments(str(tmp_path))) == 2
+    assert replay(str(tmp_path)) == [{"run": 1}, {"run": 2}]
+
+
+def test_journal_torn_tail_truncates_not_corrupts(tmp_path):
+    """A frame the crash interrupted — bad CRC, or a length pointing
+    past EOF — ends that segment's replay at the last intact record;
+    later segments still replay."""
+    j = BlackboxJournal(str(tmp_path))
+    j.start()
+    j.append({"ok": 1})
+    assert j.flush()
+    j.stop()
+    seg = list_segments(str(tmp_path))[0]
+    with open(seg, "ab") as f:
+        payload = b'{"torn": true}'
+        f.write(struct.pack("<II", len(payload) + 100,
+                            zlib.crc32(payload)))
+        f.write(payload)  # length lies: reads past EOF
+    j2 = BlackboxJournal(str(tmp_path))
+    j2.start()
+    j2.append({"ok": 2})
+    assert j2.flush()
+    j2.stop()
+    assert replay(str(tmp_path)) == [{"ok": 1}, {"ok": 2}]
+    # Corrupt the payload under a valid header too: CRC catches it.
+    with open(seg, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        f.write(b"\x00")
+    docs = replay(str(tmp_path))
+    assert {"ok": 2} in docs and len(docs) <= 2
+
+
+def test_journal_append_is_fire_and_forget(tmp_path):
+    """A full intake queue and a raising writer both count drops;
+    append() never raises and never blocks."""
+    j = BlackboxJournal(str(tmp_path))
+    # Writer not started: the queue fills to its bound, then drops.
+    for i in range(QUEUE_DEPTH + 10):
+        j.append({"i": i})
+    assert j.drops.value == 10
+    # An unencodable doc drops inside the writer, intact ones land.
+    j.start()
+    assert j.flush(timeout=5.0)  # drain the backlog first
+    j.append({"bad": object()})
+    j.append({"good": 1})
+    assert j.flush(timeout=5.0)
+    j.stop()
+    assert j.drops.value >= 11
+    assert {"good": 1} in replay(str(tmp_path))
+
+
+def test_journal_flush_timeout_never_wedges(tmp_path):
+    """flush() returns False (counted) when the writer lock cannot be
+    had within the timeout — the SIGTERM path must not hang on a
+    wedged disk."""
+    j = BlackboxJournal(str(tmp_path))
+    j.start()
+    holder = threading.Event()
+    release = threading.Event()
+
+    def hold():
+        with j._lock:
+            holder.set()
+            release.wait(timeout=10)
+
+    t = threading.Thread(target=hold, daemon=True)
+    t.start()
+    assert holder.wait(timeout=5)
+    try:
+        assert j.flush(timeout=0.05) is False
+        assert j.drops.value >= 1
+    finally:
+        release.set()
+        t.join(timeout=5)
+        j.stop()
+
+
+def test_journal_defaults_and_snapshot(tmp_path):
+    j = BlackboxJournal(str(tmp_path))
+    assert j.max_segments == DEFAULT_MAX_SEGMENTS
+    j.start()
+    j.append({"a": 1})
+    assert j.flush()
+    snap = j.snapshot()
+    j.stop()
+    assert snap["running"] and snap["directory"] == str(tmp_path)
+    assert snap["framesWritten"] == 1 and snap["drops"] == 0
+    assert snap["segments"] and snap["segments"][0]["bytes"] > 0
+
+
+# --------------------------------------------------------------------- #
+# exporter: retry, backoff, stall — injectable time, no sockets
+# --------------------------------------------------------------------- #
+
+def _drive(exp, rounds):
+    """Run the exporter loop body synchronously: one _tick + the sleep
+    decision, ``rounds`` times (no thread, no real time)."""
+    for _ in range(rounds):
+        try:
+            sent = exp._tick()
+        except Exception:
+            sent = False
+        if exp._failures:
+            exp._sleep(exp._backoff(exp._failures))
+        elif not sent:
+            exp._sleep(0.0)
+
+
+def test_exporter_backoff_schedule_and_stall():
+    """Consecutive failures double the backoff from base to cap; the
+    stall hook fires exactly once per outage, at the threshold; a
+    success resets both, and the pending batch is redelivered intact
+    (at-least-once)."""
+    posts, sleeps, stalls = [], [], []
+    fail = {"n": 5}
+
+    def post(url, body):
+        posts.append(body)
+        if fail["n"] > 0:
+            fail["n"] -= 1
+            raise OSError("sink down")
+
+    exp = Exporter("http://sink/t", post=post,
+                   sleep=lambda s: (sleeps.append(s), False)[1],
+                   backoff_base=0.5, backoff_cap=4.0, stall_after=3)
+    exp.on_stall = stalls.append
+    exp.offer({"rec": 1})
+    _drive(exp, 6)
+    assert exp.failed_posts == 5 and exp.sent_batches == 1
+    assert sleeps[:5] == [0.5, 1.0, 2.0, 4.0, 4.0]  # doubles, then cap
+    assert stalls == [3]  # once per outage, at the threshold
+    assert exp.stalls == 1 and not exp._stalled
+    # Every attempt carried the same batch until the sink took it.
+    assert len(set(posts)) == 1 and b'"rec": 1' in posts[0].replace(
+        b'"rec":1', b'"rec": 1')
+    assert exp.sent_records == 1 and exp.drops.value == 0
+
+
+def test_exporter_batches_and_bounded_queue():
+    """Records coalesce into batch_max-sized ndjson posts; a full
+    queue drops (counted) instead of blocking the caller."""
+    posts = []
+    exp = Exporter("http://sink/t", post=lambda u, b: posts.append(b),
+                   batch_max=4, queue_cap=10)
+    for i in range(14):
+        exp.offer({"i": i})
+    assert exp.drops.value == 4
+    _drive(exp, 4)
+    assert exp.sent_records == 10 and exp.sent_batches == 3
+    assert all(len(p.strip().split(b"\n")) <= 4 for p in posts)
+
+
+def test_exporter_stop_drops_leftovers_counted():
+    """stop() tries one last flush; what a dead sink strands is
+    cleared and counted, never silently lost."""
+    def post(url, body):
+        raise OSError("dead")
+
+    exp = Exporter("http://sink/t", post=post, sleep=lambda s: True)
+    for i in range(3):
+        exp.offer({"i": i})
+    exp.start()
+    exp.stop()
+    assert exp.drops.value == 3
+    assert len(exp._queue) == 0 and len(exp._pending) == 0
+
+
+def test_exporter_offer_never_raises():
+    exp = Exporter("http://sink/t", post=lambda u, b: None)
+    exp._queue = None  # force the intake to blow up internally
+    exp.offer({"x": 1})
+    assert exp.drops.value == 1
+
+
+# --------------------------------------------------------------------- #
+# traceparent: the W3C boundary
+# --------------------------------------------------------------------- #
+
+def test_traceparent_round_trip_native_id():
+    """A native 12-hex id survives format→parse unchanged (the 32-hex
+    field pads with a recognizable zero suffix, stripped on parse)."""
+    tid = trace.new_trace_id()
+    header = format_traceparent(tid)
+    version, rest = header.split("-", 1)
+    assert version == "00" and len(rest.split("-")[0]) == 32
+    assert parse_traceparent(header) == tid
+
+
+def test_traceparent_foreign_id_kept_whole():
+    foreign = "4bf92f3577b34da6a3ce929d0e0e4736"
+    header = f"00-{foreign}-00f067aa0ba902b7-01"
+    assert parse_traceparent(header) == foreign
+
+
+@pytest.mark.parametrize("header", [
+    "", "garbage", "00-zz-yy-01",
+    "00-" + "0" * 32 + "-00f067aa0ba902b7-01",   # all-zero trace-id
+    "00-abc-00f067aa0ba902b7-01",                 # short trace-id
+    "00-" + "a" * 32 + "-" + "b" * 15 + "-01",   # short span-id
+    "xx-" + "a" * 32 + "-" + "b" * 16 + "-01",   # bad version
+])
+def test_traceparent_rejects_malformed(header):
+    assert parse_traceparent(header) == ""
+
+
+# --------------------------------------------------------------------- #
+# cmd/main: the signal contract
+# --------------------------------------------------------------------- #
+
+def _invoke_handler(sig):
+    import signal as signal_mod
+    handler = signal_mod.getsignal(signal_mod.SIGTERM)
+    handler(sig, None)
+
+
+def test_first_signal_flushes_then_stops(monkeypatch):
+    import signal as signal_mod
+
+    stop = threading.Event()
+    calls = []
+    prior = signal_mod.getsignal(signal_mod.SIGTERM)
+    try:
+        setup_signals(stop, flush=lambda: calls.append("flush"))
+        _invoke_handler(signal_mod.SIGTERM)
+        assert stop.is_set() and calls == ["flush"]
+    finally:
+        signal_mod.signal(signal_mod.SIGTERM, prior)
+        signal_mod.signal(signal_mod.SIGINT, prior)
+
+
+def test_raising_flush_still_stops_and_second_signal_exits(monkeypatch):
+    """ISSUE-17 satellite (e): a flush failure must not prevent
+    shutdown — the stop event is set before flush runs and the
+    exception is swallowed; the second signal still force-exits."""
+    import signal as signal_mod
+
+    stop = threading.Event()
+    exits = []
+    monkeypatch.setattr(os, "_exit", exits.append)
+
+    def bad_flush():
+        raise OSError("disk gone")
+
+    prior = signal_mod.getsignal(signal_mod.SIGTERM)
+    try:
+        setup_signals(stop, flush=bad_flush)
+        _invoke_handler(signal_mod.SIGTERM)
+        assert stop.is_set() and not exits
+        _invoke_handler(signal_mod.SIGTERM)
+        assert exits == [1]
+    finally:
+        signal_mod.signal(signal_mod.SIGTERM, prior)
+        signal_mod.signal(signal_mod.SIGINT, prior)

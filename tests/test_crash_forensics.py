@@ -1,0 +1,280 @@
+"""Crash forensics: the ISSUE-17 acceptance round-trip.
+
+A REAL extender process (``python -m tpushare.cmd.main``) runs over
+the miniapiserver, journals to a TPUSHARE_BLACKBOX_DIR, takes a real
+bind over the wire — and is SIGKILLed. A second process over the same
+journal directory must show the pre-crash story: the first boot's
+markers and decisions replay onto ``/debug/timeline`` behind a
+``restart`` boundary marker, and ``/debug/trace?id=`` resolves the
+killed process's bind decision (tagged ``restored``).
+
+The in-process half proves the causal chain crosses the restart: a
+bind decision journaled by "process one" is restored by "process two",
+where a defrag move of the same pod resolves its ancestor walk to the
+restored bind (docs/observability.md §7).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.miniapiserver import MiniApiServer
+from tpushare import obs, trace
+from tpushare.k8s.builders import make_node, make_pod
+from tpushare.utils import const
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("TPUSHARE_BLACKBOX_DIR", raising=False)
+    monkeypatch.delenv("TPUSHARE_EXPORT_URL", raising=False)
+    yield
+    obs.reset()
+    trace.reset()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _kubeconfig(path, api_port: int) -> str:
+    cfg = f"""
+apiVersion: v1
+kind: Config
+current-context: mini
+contexts:
+- name: mini
+  context:
+    cluster: mini
+    user: mini
+clusters:
+- name: mini
+  cluster:
+    server: http://127.0.0.1:{api_port}
+users:
+- name: mini
+  user: {{}}
+"""
+    path.write_text(cfg)
+    return str(path)
+
+
+def _get(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_ready(port: int, proc, deadline_s: float = 45.0) -> None:
+    """The extender serves HTTP only after Controller.start() — which
+    includes the journal replay — so first 200 == replay done."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"extender exited early: rc={proc.returncode}\n"
+                f"{proc.stderr.read().decode(errors='replace')[-4000:]}")
+        try:
+            _get(f"http://127.0.0.1:{port}/debug/timeline")
+            return
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.2)
+    raise AssertionError("extender never became ready")
+
+
+def _spawn(port: int, kubeconfig: str, journal_dir: str):
+    env = dict(os.environ)
+    env.update({
+        "KUBECONFIG": kubeconfig,
+        "PORT": str(port),
+        "WORKERS": "2",
+        "LOG_LEVEL": "error",
+        "JAX_PLATFORMS": "cpu",
+        "TPUSHARE_BLACKBOX_DIR": journal_dir,
+        # Quiet boot: the journal + timeline are the subjects; the
+        # defrag/autoscale tickers and profiler only add noise here.
+        "TPUSHARE_PROFILE": "off",
+        "TPUSHARE_DEFRAG_MODE": "off",
+        "TPUSHARE_AUTOSCALE": "off",
+    })
+    env.pop("TPUSHARE_EXPORT_URL", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "tpushare.cmd.main"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def test_sigkill_restart_replays_precrash_story(tmp_path):
+    """SIGKILL → restart over the same journal dir: the second process
+    serves the first one's markers and bind decision."""
+    api = MiniApiServer().start()
+    api.seed_node(make_node("n1"))
+    pod = make_pod("bb-pod", hbm=8, uid="uid-bb")
+    api.seed_pod(pod)
+    kubeconfig = _kubeconfig(tmp_path / "kubeconfig", api.port)
+    journal_dir = str(tmp_path / "journal")
+    port = _free_port()
+
+    proc = _spawn(port, kubeconfig, journal_dir)
+    proc2 = None
+    try:
+        _wait_ready(port, proc)
+        base = f"http://127.0.0.1:{port}"
+
+        # A real wire sequence: filter, then bind (the decision the
+        # crash must not erase).
+        req = urllib.request.Request(
+            f"{base}/tpushare-scheduler/filter",
+            data=json.dumps({"Pod": pod,
+                             "NodeNames": ["n1"]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read())["NodeNames"] == ["n1"]
+        req = urllib.request.Request(
+            f"{base}/tpushare-scheduler/bind",
+            data=json.dumps({"PodName": "bb-pod",
+                             "PodNamespace": "default",
+                             "PodUID": "uid-bb",
+                             "Node": "n1"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read())
+            assert not body.get("Error")
+            traceparent = resp.headers.get("traceparent", "")
+        assert traceparent
+        bind_trace = trace.parse_traceparent(traceparent)
+        assert bind_trace
+
+        # Give the writer a drain cycle (page-cache flush — the
+        # SIGKILL survival boundary), then kill without ceremony.
+        time.sleep(1.5)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        port2 = _free_port()
+        proc2 = _spawn(port2, kubeconfig, journal_dir)
+        _wait_ready(port2, proc2)
+        base2 = f"http://127.0.0.1:{port2}"
+
+        bb = _get(f"{base2}/debug/blackbox")
+        assert bb["armed"] and bb["replayed"]
+        assert bb["journal"]["directory"] == journal_dir
+
+        doc = _get(f"{base2}/debug/timeline?window=3600")
+        markers = doc.get("markers") or []
+        restarts = [m for m in markers if m["kind"] == "restart"]
+        # Boot 1 stamped a restart marker too (replayed 0 records);
+        # boot 2 replayed it from the journal and stamped its own —
+        # the newest one is the boundary, everything older is the
+        # pre-crash story read from disk.
+        assert len(restarts) >= 2
+        boundary = max(m["ts"] for m in restarts)
+        assert any(m["ts"] < boundary for m in markers)
+
+        # The killed process's bind decision resolves by trace id.
+        chain = _get(f"{base2}/debug/trace?id={bind_trace}")
+        assert chain["target"]["traceId"] == bind_trace
+        assert chain["target"].get("restored") is True
+        assert chain["target"]["outcome"] == "bound"
+
+        proc2.send_signal(signal.SIGTERM)
+        assert proc2.wait(timeout=15) == 0
+        proc2 = None
+    finally:
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        api.close()
+
+
+def test_defrag_move_resolves_restored_bind_across_restart(
+        tmp_path, monkeypatch):
+    """The causal chain crosses the process boundary: a journaled bind
+    decision, restored after a simulated crash, is the ancestor a NEW
+    defrag plan's move resolves to via the pod's trace-id annotation."""
+    from tpushare.api.objects import Pod
+    from tpushare.cache.cache import SchedulerCache
+    from tpushare.defrag.planner import RebalancePlanner
+    from tpushare.k8s.fake import FakeApiServer
+
+    monkeypatch.setenv("TPUSHARE_BLACKBOX_DIR", str(tmp_path))
+    obs.reset()
+    assert obs.start()
+
+    # "Process one": the bind decision every later action descends
+    # from, completed (journaled via the completion tee).
+    with trace.phase("bind", "default", "a0", "u-a0") as dec:
+        trace.note("chips", [0])
+        trace.complete(dec, "bound", node="n1")
+    bind_id = dec.trace_id
+    assert obs.flush_blackbox()
+
+    # The crash: every in-memory recorder dies with the process.
+    obs.reset()
+    trace.reset()
+    assert trace.get_trace("default", "a0", trace_id=bind_id) is None
+
+    # "Process two": arm over the same directory and replay.
+    assert obs.start()
+    assert obs.replay_startup() > 0
+    restored = trace.get_trace("default", "a0", trace_id=bind_id)
+    assert restored is not None and restored.get("restored") is True
+
+    # A fragmented fleet where moving a0 (bound with OUR trace id in
+    # its annotations, as the real binder stamps) repairs placement.
+    api = FakeApiServer()
+    for n in ("n0", "n1", "n2"):
+        api.create_node(make_node(n))
+
+    def bound(name, node, chips, trace_id=""):
+        ann = {const.ANN_CHIP_IDX: ",".join(str(c) for c in chips),
+               const.ANN_HBM_POD: "6",
+               const.ANN_HBM_CHIP: "16",
+               const.ANN_ASSIGNED: const.ASSIGNED_TRUE,
+               const.ANN_ASSUME_TIME: "1"}
+        if trace_id:
+            ann[const.ANN_TRACE_ID] = trace_id
+        return make_pod(name, hbm=6, node_name=node, phase="Running",
+                        uid=f"u-{name}", annotations=ann)
+
+    api.create_pod(bound("s0", "n0", [0]))
+    api.create_pod(bound("s1", "n0", [1]))
+    api.create_pod(bound("a0", "n1", [0], trace_id=bind_id))
+    api.create_pod(bound("b0", "n2", [0]))
+    cache = SchedulerCache(api.get_node, api.list_pods)
+    for node in api.list_nodes():
+        cache.get_node_info(node.name)
+    cache.build()
+
+    plan = RebalancePlanner(cache).plan(
+        [Pod(make_pod("ring", chips=4, uid="u-ring"))])
+    assert plan is not None
+    moves = {m.name: m for m in plan.moves}
+    # The planner may pick a0 (n1) or b0 (n2) — both repair; force the
+    # assertion onto whichever carries our annotated pod, or assert
+    # directly when a0 was chosen.
+    if "a0" not in moves:
+        pytest.skip("planner repaired via b0; parent chain not "
+                    "exercised by this plan shape")
+    move = moves["a0"]
+    assert move.parent_id == bind_id
+
+    chain = trace.causal_chain(move.trace_id)
+    assert chain["target"]["traceId"] == move.trace_id
+    ancestors = chain["ancestors"]
+    assert ancestors, "move decision lost its parent"
+    assert ancestors[0]["traceId"] == bind_id
+    assert ancestors[0].get("restored") is True
+    # And downstream: the restored bind lists the move as descendant.
+    back = trace.causal_chain(bind_id)
+    assert any(d["traceId"] == move.trace_id
+               for d in back["descendants"])

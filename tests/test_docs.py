@@ -390,6 +390,32 @@ def test_observability_doc_covers_retrospective():
         f"marker kinds absent from docs/observability.md: {missing}")
 
 
+def test_observability_doc_covers_blackbox():
+    """§7 (the black box) is the durability contract: arming env vars,
+    the CRC-framed segment format with its two-tier durability story,
+    startup replay behind the `restart` marker, the traceparent
+    causal-context contract, the push exporter's backoff/stall
+    semantics, both surfaces, the overhead gate, and the crash
+    runbook."""
+    with open(OBSERVABILITY_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("/debug/blackbox", "TPUSHARE_BLACKBOX_DIR",
+                   "TPUSHARE_EXPORT_URL",
+                   "TPUSHARE_BLACKBOX_SEGMENT_BYTES",
+                   "TPUSHARE_BLACKBOX_SEGMENTS",
+                   "kubectl inspect tpushare blackbox",
+                   "CRC", "crc32", "torn tail", "fsync",
+                   "survives SIGKILL", "SIGTERM",
+                   "replay", "`restart` marker", "restored: true",
+                   "traceparent", "tpushare.io/trace-parent",
+                   "/debug/trace?id=", "ancestor",
+                   "exponential backoff", "at-least-once",
+                   "`export-stall`", "`journal-rotate`",
+                   "blackbox_overhead",
+                   "Runbook: the extender crashed"):
+        assert needle in doc, needle
+
+
 if __name__ == "__main__":
     # CI's lint job runs this file as a plain script (no pytest, no
     # project install — tests/conftest.py would drag jax in); the same
@@ -401,6 +427,7 @@ if __name__ == "__main__":
                   test_every_registered_metric_is_documented,
                   test_observability_doc_covers_the_surfaces,
                   test_observability_doc_covers_retrospective,
+                  test_observability_doc_covers_blackbox,
                   test_quota_doc_covers_the_contract,
                   test_quota_doc_is_linked,
                   test_slo_doc_covers_the_contract,

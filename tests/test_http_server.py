@@ -413,13 +413,13 @@ class TestBatchedVerbSemantics:
         table, nominated = srv.predicate.snapshot()
         direct = [srv._run_filter(a, 0.0, table, nominated)
                   for a in args]
-        assert [json.loads(b) for b, _ in batched] == \
-               [json.loads(b) for b, _ in direct]
+        assert [json.loads(b) for b, *_ in batched] == \
+               [json.loads(b) for b, *_ in direct]
         pb = srv._prioritize_batch([WorkItem(a) for a in args])
         ptable = srv.prioritize.snapshot()
         pd = [srv._run_prioritize(a, 0.0, ptable) for a in args]
-        assert [json.loads(b) for b, _ in pb] == \
-               [json.loads(b) for b, _ in pd]
+        assert [json.loads(b) for b, *_ in pb] == \
+               [json.loads(b) for b, *_ in pd]
 
     def test_poison_request_fails_alone_in_batch(self, server):
         """A request that blows up inside the verb fails ITSELF (its

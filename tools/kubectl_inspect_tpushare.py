@@ -669,6 +669,72 @@ def render_timeline(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def fetch_blackbox(endpoint: str) -> dict | None:
+    """The flight-journal/export snapshot from ``/debug/blackbox``;
+    None when neither TPUSHARE_BLACKBOX_DIR nor TPUSHARE_EXPORT_URL is
+    set (nothing armed) or debug routes are disabled."""
+    try:
+        with urllib.request.urlopen(f"{endpoint}/debug/blackbox",
+                                    timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def render_blackbox(doc: dict) -> str:
+    """The durable-telemetry posture: on-disk journal segments plus
+    the push-export pipeline's health."""
+    lines = [
+        f"blackbox: {'armed' if doc.get('armed') else 'disarmed'}"
+        + (", startup replay done" if doc.get("replayed") else ""),
+    ]
+    journal = doc.get("journal")
+    if journal:
+        lines.append("")
+        lines.append(
+            f"journal: {journal.get('directory', '?')} "
+            f"({'writing' if journal.get('running') else 'stopped'}, "
+            f"segment #{journal.get('segment', '?')}, "
+            f"{journal.get('segmentBytes', 0)} B/segment)")
+        lines.append(
+            f"  frames {journal.get('framesWritten', 0)}, "
+            f"rotations {journal.get('rotations', 0)}, "
+            f"queued {journal.get('queued', 0)}, "
+            f"drops {journal.get('drops', 0)}")
+        segments = journal.get("segments") or []
+        for seg in segments:
+            lines.append(f"  {seg.get('name', '?'):<24s} "
+                         f"{seg.get('bytes', 0):>10d} B")
+    else:
+        lines.append("journal: off (set TPUSHARE_BLACKBOX_DIR)")
+    export = doc.get("export")
+    if export:
+        lines.append("")
+        state = "stalled" if export.get("stalled") else (
+            "shipping" if export.get("running") else "stopped")
+        lines.append(f"export: {export.get('url', '?')} ({state})")
+        lines.append(
+            f"  batches {export.get('sentBatches', 0)} "
+            f"({export.get('sentRecords', 0)} records), "
+            f"failed posts {export.get('failedPosts', 0)}, "
+            f"consecutive failures "
+            f"{export.get('consecutiveFailures', 0)}, "
+            f"stalls {export.get('stalls', 0)}, "
+            f"queued {export.get('queued', 0)}, "
+            f"drops {export.get('drops', 0)}")
+    else:
+        lines.append("")
+        lines.append("export: off (set TPUSHARE_EXPORT_URL)")
+    lines.append("")
+    lines.append("The journal replays onto /debug/timeline after a "
+                 "restart (markers behind the 'restart' boundary); "
+                 "resolve causality across it with /debug/trace?id=. "
+                 "Runbook: docs/observability.md.")
+    return "\n".join(lines)
+
+
 def fetch_defrag(endpoint: str) -> dict | None:
     """The fragmentation/rebalance snapshot from ``/debug/defrag``;
     None when the extender runs without the defrag executor wired or
@@ -1111,7 +1177,9 @@ def main(argv: list[str] | None = None) -> int:
                              "slice-occupancy map with per-gang ring "
                              "contiguity; or the literal 'timeline' "
                              "for the retrospective fleet history "
-                             "(series sparklines + event markers)")
+                             "(series sparklines + event markers); or "
+                             "the literal 'blackbox' for the durable "
+                             "flight-journal and push-export posture")
     parser.add_argument("pod", nargs="?", metavar="[ns/]pod",
                         help="with 'explain': the pod whose placement "
                              "decision to explain (namespace defaults "
@@ -1174,6 +1242,24 @@ def main(argv: list[str] | None = None) -> int:
                   "disabled (DEBUG_ROUTES=0)", file=sys.stderr)
             return 1
         print(render_timeline(doc))
+        return 0
+    if args.node == "blackbox":
+        if args.pod:
+            print(f"unexpected argument {args.pod!r} after 'blackbox'",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = fetch_blackbox(args.endpoint)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        if doc is None:
+            print("blackbox unavailable — neither TPUSHARE_BLACKBOX_DIR "
+                  "nor TPUSHARE_EXPORT_URL is set, or debug routes are "
+                  "disabled (DEBUG_ROUTES=0)", file=sys.stderr)
+            return 1
+        print(render_blackbox(doc))
         return 0
     if args.node == "topology":
         if args.pod:

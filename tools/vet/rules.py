@@ -118,6 +118,17 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
     # lock-free — GIL-atomic deque appends on the gated hot path.)
     "TimelineRecorder": ("_series", "_sources"),
     "AnomalyEngine": ("_fired", "_event_at"),
+    # The black-box journal (tpushare/obs/blackbox.py): the writer
+    # thread drains and rotates segments while the SIGTERM flush and
+    # /debug/blackbox readers touch the open file handle and its
+    # byte/sequence counters. (_queue is deliberately lock-free —
+    # GIL-atomic bounded deque on the emission side, like
+    # _verb_samples above.)
+    "BlackboxJournal": ("_file", "_seq", "_bytes"),
+    # The push exporter (tpushare/obs/export.py): the loop thread
+    # builds/acks the pending batch while the shutdown flush drains
+    # it. (_queue is the same lock-free intake deque as the journal's.)
+    "Exporter": ("_pending",),
     # The paged-KV allocator (tpushare/workload/paging.py): admissions
     # and releases come from serving/router threads while the stats
     # snapshot is read by the scrape — free list, refcounts, and the

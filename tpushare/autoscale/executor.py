@@ -73,7 +73,7 @@ from tpushare.defrag.planner import RebalancePlanner, WhatIf
 from tpushare.k8s import builders, commit, eviction
 from tpushare.k8s.errors import ApiError
 from tpushare.quota.manager import QuotaManager
-from tpushare.utils import locks
+from tpushare.utils import const, locks
 from tpushare.utils import node as nodeutils
 from tpushare.utils import pod as podutils
 
@@ -540,6 +540,7 @@ class AutoscaleExecutor:
                      "detail": why})
                 continue
             status = self._evict(name, pod)
+            self._record_evict(name, pod, status)
             if status == eviction.EVICTED:
                 decision["evictions"].append(
                     {"pod": pod.key(), "status": "evicted"})
@@ -655,6 +656,23 @@ class AutoscaleExecutor:
             return "failed"
 
     # -- telemetry -------------------------------------------------------- #
+
+    @staticmethod
+    def _record_evict(node: str, pod: Pod, status: str) -> None:
+        """Drain evictions land in the flight recorder as
+        ``autoscale:evict`` decisions chained (via the pod's trace-id
+        annotation) to the bind that placed the pod — so
+        ``/debug/trace?id=`` answers 'why did my pod disappear' with
+        the placement it undid (docs/observability.md §7)."""
+        try:
+            with trace.phase("autoscale:evict", pod.namespace,
+                             pod.name, pod.uid) as dec:
+                trace.set_parent(
+                    pod.annotations.get(const.ANN_TRACE_ID, ""))
+                trace.note("node", node)
+                trace.complete(dec, f"drain-{status}", node=node)
+        except Exception:  # noqa: BLE001 - telemetry must not drain
+            trace.recorder().drops.inc()
 
     @staticmethod
     def _count(action: str) -> None:

@@ -558,6 +558,7 @@ class NodeInfo:
         # is attributed to this allocate phase, not its caller's.
         with trace.span("allocate", node=self.name):
             trace_id = trace.current_trace_id() or None
+            trace_parent = trace.current_parent_id() or None
             with self._lock:
                 chip_ids = self.pick_chips(pod)  # raises AllocationError
                 if podutils.get_chips_from_pod_resource(pod) > 0:
@@ -568,7 +569,8 @@ class NodeInfo:
                 hbm_chip = self.chips[chip_ids[0]].total_hbm
                 provisional = podutils.updated_pod_annotation_spec(
                     pod, chip_ids, hbm_pod, hbm_chip,
-                    assume_time_ns=time.time_ns(), trace_id=trace_id
+                    assume_time_ns=time.time_ns(), trace_id=trace_id,
+                    trace_parent=trace_parent,
                 )
                 for cid in chip_ids:
                     self.chips[cid].add_pod(provisional)
@@ -587,6 +589,7 @@ class NodeInfo:
                     new_pod = podutils.updated_pod_annotation_spec(
                         fresh, chip_ids, hbm_pod, hbm_chip,
                         assume_time_ns=time.time_ns(), trace_id=trace_id,
+                        trace_parent=trace_parent,
                     )
                     new_pod = client.update_pod(new_pod)
                 if bind:

@@ -83,6 +83,17 @@ Environment (reference cmd/main.go:23,92-98):
   docs/observability.md §Retrospective). ``off`` disables sampling,
   markers, and exemplar annotation; every emission site degrades to a
   no-op.
+* ``TPUSHARE_BLACKBOX_DIR`` — when set, arms the durable black-box
+  flight journal (docs/observability.md §7): markers, per-tick series
+  samples, and completed decisions append to CRC-framed, size-capped
+  segments under this directory (``TPUSHARE_BLACKBOX_SEGMENT_BYTES`` /
+  ``TPUSHARE_BLACKBOX_SEGMENTS`` bound it); SIGTERM/atexit fsync the
+  tail, and the next start replays it onto ``/debug/timeline`` behind
+  a ``restart`` marker. Unset (default) = no journal, no disk I/O.
+* ``TPUSHARE_EXPORT_URL`` — when set, arms the push exporter: the same
+  records stream as JSON-lines POSTs to this HTTP sink (bounded queue,
+  retry with exponential backoff, ``export-stall`` marker on sustained
+  failure). Unset (default) = no exporter.
 """
 
 from __future__ import annotations
@@ -109,13 +120,27 @@ from tpushare.scheduler.prioritize import Prioritize
 log = logging.getLogger(__name__)
 
 
-def setup_signals(stop_event: threading.Event) -> None:
+def setup_signals(stop_event: threading.Event,
+                  flush=None) -> None:
     """First SIGINT/SIGTERM requests shutdown; a second forces exit
-    (reference pkg/utils/signals/signal.go:16-30)."""
+    (reference pkg/utils/signals/signal.go:16-30).
+
+    ``flush`` (``() -> bool``, e.g. ``obs.flush_blackbox``) runs on the
+    FIRST signal, before the main thread starts tearing servers down —
+    the black-box journal's SIGTERM durability point. The stop event is
+    set BEFORE flush is attempted, and any flush failure is swallowed:
+    a journal that cannot sync must delay shutdown by at most its own
+    internal timeout, never wedge it (the second signal still force-
+    exits regardless)."""
     def handler(signum, frame):
         if stop_event.is_set():
             os._exit(1)
         stop_event.set()
+        if flush is not None:
+            try:
+                flush()
+            except Exception:  # noqa: BLE001 - flushing must not wedge exit
+                pass
 
     signal.signal(signal.SIGINT, handler)
     signal.signal(signal.SIGTERM, handler)
@@ -376,8 +401,16 @@ def main() -> None:
         client, is_leader=leader.is_leader if leader is not None else None)
     controller, binder = stack.controller, stack.binder
 
+    from tpushare import obs
+
     stop = threading.Event()
-    setup_signals(stop)
+    setup_signals(stop, flush=obs.flush_blackbox)
+    # A clean interpreter exit (sys.exit, main-thread return) flushes
+    # too — the journal's tail must survive every exit the OS lets us
+    # see. SIGKILL durability comes from the writer's per-drain flush
+    # to the page cache (obs/blackbox.py).
+    import atexit
+    atexit.register(obs.flush_blackbox)
 
     controller.start(workers=workers)
     debug_routes = os.environ.get("DEBUG_ROUTES", "1").lower() not in (
@@ -413,6 +446,10 @@ def main() -> None:
         leader.stop()
     binder.gang_planner.stop()
     controller.stop()
+    # Last: drain + fsync + close the black-box journal and exporter
+    # (the signal handler already flushed what was queued at SIGTERM;
+    # this catches anything the teardown above emitted).
+    obs.stop()
 
 
 if __name__ == "__main__":

@@ -420,6 +420,13 @@ class Controller:
         self.hub.start()
         if not self.hub.wait_for_sync():
             raise RuntimeError("informer cache never synced")
+        # Crash forensics (docs/observability.md §7): replay the
+        # previous process's black-box journal tail — pre-crash markers
+        # and samples back onto the timeline, decisions into the
+        # flight recorder's restored buffer — behind a `restart`
+        # boundary marker. No-op unless TPUSHARE_BLACKBOX_DIR is set;
+        # once per process.
+        obs.replay_startup()
         # The initial LIST populates the stores without dispatching
         # handlers; seed the quota table from it so limits are enforced
         # from the very first filter request, not the first cm rewrite.

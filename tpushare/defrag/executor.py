@@ -350,6 +350,10 @@ class DefragExecutor:
         try:
             with trace.phase("defrag:move", move.namespace, move.name,
                              move.uid) as dec:
+                # Chain to the plan decision when it recorded one, else
+                # straight to the bind that placed the pod — either way
+                # the ancestor walk reaches the original placement.
+                trace.set_parent(move.trace_id or move.parent_id)
                 trace.note("planId", plan.plan_id)
                 trace.note("from", move.from_node)
                 trace.note("to", move.to_node)

@@ -69,7 +69,8 @@ class Move:
     span."""
 
     __slots__ = ("namespace", "name", "uid", "from_node", "to_node",
-                 "gang", "hbm", "chips", "status", "trace_id", "detail")
+                 "gang", "hbm", "chips", "status", "trace_id", "detail",
+                 "parent_id")
 
     def __init__(self, pod: Pod, from_node: str, to_node: str) -> None:
         self.namespace = pod.namespace
@@ -82,6 +83,11 @@ class Move:
         self.status = "planned"
         self.trace_id = ""
         self.detail = ""
+        #: Causal parent: the bind decision that placed this pod (its
+        #: trace-id annotation) — the move's plan/execute decisions
+        #: descend from it, so /debug/trace?id= resolves an eviction
+        #: back to the placement it undid, even across a restart.
+        self.parent_id = pod.annotations.get(const.ANN_TRACE_ID, "")
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
@@ -619,6 +625,7 @@ class RebalancePlanner:
             try:
                 with trace.phase("defrag:plan", move.namespace, move.name,
                                  move.uid) as dec:
+                    trace.set_parent(move.parent_id)
                     trace.note("planId", plan.plan_id)
                     trace.note("from", move.from_node)
                     trace.note("to", move.to_node)

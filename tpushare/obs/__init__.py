@@ -26,24 +26,36 @@ taxonomy, and the burn → cursor → timeline → exemplar → trace runbook.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 from tpushare.obs import sources
 from tpushare.obs.anomaly import AnomalyEngine, Rule
+from tpushare.obs.blackbox import BlackboxJournal, journal_dir, replay
 from tpushare.obs.exemplars import ExemplarStore
+from tpushare.obs.export import Exporter, export_url
 from tpushare.obs.timeline import (MARKER_KINDS, TimelineRecorder,
                                    enabled)
 
 __all__ = [
-    "AnomalyEngine", "ExemplarStore", "MARKER_KINDS", "Rule",
-    "TimelineRecorder", "anomalies", "annotate_metrics", "enabled",
-    "exemplars", "mark", "mark_drops", "note_verb", "reset",
-    "snapshot", "sources", "start", "stop", "timeline", "wire",
+    "AnomalyEngine", "BlackboxJournal", "ExemplarStore", "Exporter",
+    "MARKER_KINDS", "Rule", "TimelineRecorder", "anomalies",
+    "annotate_metrics", "blackbox", "blackbox_snapshot", "enabled",
+    "exemplars", "exporter", "flush_blackbox", "mark", "mark_drops",
+    "note_verb", "replay_startup", "reset", "snapshot", "sources",
+    "start", "stop", "stop_blackbox", "timeline", "wire",
 ]
 
 _timeline = TimelineRecorder()
 _anomalies = AnomalyEngine(_timeline)
 _exemplars = ExemplarStore()
+#: Armed iff TPUSHARE_BLACKBOX_DIR / TPUSHARE_EXPORT_URL are set —
+#: None otherwise, and every tee below checks before touching them.
+_blackbox: BlackboxJournal | None = None
+_exporter: Exporter | None = None
+#: replay_startup() runs once per process (the restart boundary marker
+#: must not multiply when Controller.start is retried in tests).
+_replayed = False
 
 
 def _hook_anomalies() -> None:
@@ -63,6 +75,14 @@ def anomalies() -> AnomalyEngine:
 
 def exemplars() -> ExemplarStore:
     return _exemplars
+
+
+def blackbox() -> BlackboxJournal | None:
+    return _blackbox
+
+
+def exporter() -> Exporter | None:
+    return _exporter
 
 
 # -- wiring ---------------------------------------------------------------- #
@@ -90,12 +110,157 @@ def wire(client: object | None = None, demand: object | None = None,
 
 def start() -> bool:
     """Arm the background sampler (idempotent; False under the
-    ``TPUSHARE_TIMELINE=off`` kill switch)."""
-    return _timeline.start()
+    ``TPUSHARE_TIMELINE=off`` kill switch) and, when
+    ``TPUSHARE_BLACKBOX_DIR`` / ``TPUSHARE_EXPORT_URL`` are set, the
+    black-box journal and push exporter."""
+    armed = _timeline.start()
+    _arm_blackbox()
+    return armed
 
 
 def stop() -> None:
     _timeline.stop()
+    stop_blackbox()
+
+
+# -- black-box journal + push export ---------------------------------------- #
+
+
+def _tee(doc: dict[str, Any]) -> None:
+    """Offer one record to the durable journal and the exporter
+    (whichever are armed). Both intakes are fire-and-forget already;
+    the try is for the encode path here."""
+    try:
+        if _blackbox is not None:
+            _blackbox.append(doc)
+        if _exporter is not None:
+            _exporter.offer(doc)
+    except Exception:  # noqa: BLE001 - teeing must never reach callers
+        _timeline.mark_drops.inc()
+
+
+def _on_decision_complete(dec: Any) -> None:
+    """trace complete-hook: journal every finalized flight-recorder
+    decision (the crash story's "what was bound when we died")."""
+    _tee({"t": "decision", "ts": time.time(), "doc": dec.to_json()})
+
+
+def _journal_tick(now: float) -> None:
+    """Timeline tick-hook: journal a compact last-value sample of
+    every series (the crash story's "what the gauges said")."""
+    if _blackbox is None and _exporter is None:
+        return
+    values = _timeline.last_values()
+    if values:
+        _tee({"t": "sample", "ts": now, "series": values})
+
+
+def _arm_blackbox() -> None:
+    """Build and start the journal/exporter from the environment
+    (idempotent; either can be armed without the other)."""
+    global _blackbox, _exporter
+    directory, url = journal_dir(), export_url()
+    if directory and _blackbox is None:
+        journal = BlackboxJournal(directory)
+        journal.on_rotate = lambda seq: mark(
+            "journal-rotate", f"segment {seq}", segment=seq)
+        journal.start()
+        _blackbox = journal
+    if url and _exporter is None:
+        exp = Exporter(url)
+        exp.on_stall = lambda failures: mark(
+            "export-stall", f"{failures} consecutive failed posts",
+            failures=failures)
+        exp.start()
+        _exporter = exp
+    if _blackbox is not None or _exporter is not None:
+        from tpushare import trace
+        trace.add_complete_hook(_on_decision_complete)
+        if _journal_tick not in _timeline._tick_hooks:
+            _timeline.add_tick_hook(_journal_tick)
+
+
+def stop_blackbox() -> None:
+    """Disarm the journal and exporter (flushing both), leaving the
+    timeline itself alone — the bench overhead probe's off-arm, and
+    part of reset()."""
+    global _blackbox, _exporter
+    from tpushare import trace
+    trace.remove_complete_hook(_on_decision_complete)
+    journal, exp = _blackbox, _exporter
+    _blackbox = None
+    _exporter = None
+    if exp is not None:
+        exp.stop()
+    if journal is not None:
+        journal.stop()
+
+
+def flush_blackbox() -> bool:
+    """Synchronously fsync the journal — the SIGTERM/atexit durability
+    point (cmd/main). Never raises; False means the flush could not
+    complete (counted) and shutdown should proceed anyway."""
+    try:
+        journal = _blackbox
+        if journal is None:
+            return True
+        return journal.flush()
+    except Exception:  # noqa: BLE001 - a failed flush must not wedge exit
+        _timeline.mark_drops.inc()
+        return False
+
+
+def blackbox_snapshot() -> dict[str, Any]:
+    """The ``GET /debug/blackbox`` document: journal + export health."""
+    return {
+        "armed": _blackbox is not None,
+        "replayed": _replayed,
+        "journal": (_blackbox.snapshot()
+                    if _blackbox is not None else None),
+        "export": (_exporter.stats()
+                   if _exporter is not None else None),
+    }
+
+
+def replay_startup() -> int:
+    """Replay the previous process's journal tail onto this process's
+    surfaces: markers and samples back onto the timeline (original
+    timestamps), decisions into the flight recorder's restored buffer
+    — then stamp the ``restart`` boundary marker. Called from
+    ``Controller.start()``; once per process; returns the number of
+    records replayed."""
+    global _replayed
+    if _replayed:
+        return 0
+    directory = journal_dir()
+    if not directory:
+        return 0
+    _replayed = True
+    from tpushare import trace
+    replayed = 0
+    for doc in replay(directory):
+        try:
+            kind = doc.get("t")
+            ts = float(doc.get("ts", 0.0))
+            if kind == "marker":
+                _timeline.mark(doc.get("kind", ""),
+                               doc.get("detail", ""),
+                               dict(doc.get("attrs") or {}), ts=ts)
+            elif kind == "sample":
+                for name, value in (doc.get("series") or {}).items():
+                    _timeline.record(str(name), float(value), ts=ts)
+            elif kind == "decision":
+                trace.restore(doc.get("doc") or {})
+            else:
+                continue
+            replayed += 1
+        except Exception:  # noqa: BLE001 - a bad frame must not stop replay
+            _timeline.mark_drops.inc()
+    # The boundary goes through mark() so it is journaled too: the
+    # NEXT restart replays it as history, separating the epochs.
+    mark("restart", f"replayed {replayed} journal records",
+         replayed=replayed)
+    return replayed
 
 
 # -- fire-and-forget intake ------------------------------------------------- #
@@ -117,7 +282,14 @@ def mark(kind: str, detail: str = "", trace_id: str | None = None,
             trace_id = trace.current_trace_id()
         if trace_id:
             str_attrs["trace_id"] = trace_id
-        return _timeline.mark(kind, detail, str_attrs)
+        ts = time.time()
+        cursor = _timeline.mark(kind, detail, str_attrs, ts=ts)
+        # Tee the marker to the durable journal/exporter AFTER the
+        # timeline accepted it (an invalid kind raised above and is
+        # never journaled, so replay can trust journaled kinds).
+        _tee({"t": "marker", "ts": ts, "cursor": cursor, "kind": kind,
+              "detail": detail, "attrs": str_attrs})
+        return cursor
     except Exception:  # noqa: BLE001 - marking must never reach callers
         _timeline.mark_drops.inc()
         return None
@@ -174,6 +346,9 @@ def snapshot(window_s: float | None = None,
 
 def reset() -> None:
     """Stop the sampler and drop all retrospective state (tests)."""
+    global _replayed
+    stop_blackbox()
+    _replayed = False
     _timeline.reset()
     _anomalies.reset()
     _exemplars.reset()

@@ -72,6 +72,7 @@ MARKER_KINDS = frozenset({
     "leader", "defrag-plan", "defrag-abort", "router-scaleout",
     "slo-burn", "config", "gang-commit", "gang-rollback", "anomaly",
     "autoscale-up", "autoscale-down", "autoscale-abort",
+    "restart", "journal-rotate", "export-stall",
 })
 
 
@@ -373,6 +374,14 @@ class TimelineRecorder:
     def series_count(self) -> int:
         with self._lock:
             return len(self._series)
+
+    def last_values(self) -> dict[str, float]:
+        """The newest sample of every series — the cheap per-tick
+        snapshot the black-box journal records as its ``sample``
+        frames (full rings would make every tick a megabyte)."""
+        with self._lock:
+            return {name: s.tier0[-1][1]
+                    for name, s in self._series.items() if s.tier0}
 
     def reset(self) -> None:
         """Tests: drop all state, keep the thread/source registration

@@ -46,15 +46,21 @@ DEFAULT_MAX_BATCH = 16
 
 
 class WorkItem:
-    __slots__ = ("args", "t0", "done", "result", "error", "queue_s")
+    __slots__ = ("args", "t0", "done", "result", "error", "queue_s",
+                 "parent")
 
-    def __init__(self, args: Any) -> None:
+    def __init__(self, args: Any, parent: str = "") -> None:
         self.args = args
         self.t0 = time.perf_counter()
         self.done = False
         self.result: Any = None
         self.error: BaseException | None = None
         self.queue_s = 0.0
+        #: Causal parent trace id from the request's ``traceparent``
+        #: header — carried through the gate so the batch executor can
+        #: stamp it on each item's decision (batching must not strip
+        #: causality).
+        self.parent = parent
 
 
 class VerbBatcher:
@@ -84,12 +90,12 @@ class VerbBatcher:
 
     # -- public API -------------------------------------------------------- #
 
-    def submit(self, args: Any) -> tuple[Any, float]:
+    def submit(self, args: Any, parent: str = "") -> tuple[Any, float]:
         """Run ``args`` through the gate; returns ``(result,
         queue_wait_seconds)``. Raises whatever the executor raised."""
         if not self.enabled:
-            return self.run_batch([WorkItem(args)])[0], 0.0
-        item = WorkItem(args)
+            return self.run_batch([WorkItem(args, parent)])[0], 0.0
+        item = WorkItem(args, parent)
         with self._cond:
             if not self._draining and not self._pending:
                 # Depth 1: nothing queued, nothing in flight — the

@@ -333,17 +333,20 @@ class ExtenderHTTPServer(HTTPServer):
             # on the item's own handler thread.
             try:
                 out.append(self._run_filter(it.args, it.queue_s,
-                                            table, nominated))
+                                            table, nominated,
+                                            parent=it.parent))
             except Exception as e:  # noqa: BLE001 - re-raised per item
                 out.append(e)
         return out
 
-    def _run_filter(self, args, queue_s, table, nominated):
+    def _run_filter(self, args, queue_s, table, nominated, parent=""):
         t0 = time.perf_counter()
         with metrics.FILTER_LATENCY.time(), \
                 trace.phase("filter", args.pod.namespace,
                             args.pod.name, args.pod.uid,
                             enabled=_traced_pod(args.pod)) as dec:
+            if parent:
+                trace.set_parent(parent)
             if queue_s:
                 trace.note_queue_wait(queue_s)
             result = self.predicate.handle(args, table=table,
@@ -374,7 +377,8 @@ class ExtenderHTTPServer(HTTPServer):
         # this latency lands in remembers the trace-id.
         obs.note_verb("filter", handler_ms / 1e3,
                       dec.trace_id if dec is not None else "")
-        return wire.encode_filter_result(result), handler_ms
+        return (wire.encode_filter_result(result), handler_ms,
+                dec.trace_id if dec is not None else "")
 
     def _prioritize_batch(self, items: list[WorkItem]):
         table = self.prioritize.snapshot()
@@ -382,17 +386,20 @@ class ExtenderHTTPServer(HTTPServer):
         for it in items:
             try:  # per-item isolation, as in _filter_batch
                 out.append(self._run_prioritize(it.args, it.queue_s,
-                                                table))
+                                                table,
+                                                parent=it.parent))
             except Exception as e:  # noqa: BLE001 - re-raised per item
                 out.append(e)
         return out
 
-    def _run_prioritize(self, args, queue_s, table):
+    def _run_prioritize(self, args, queue_s, table, parent=""):
         t0 = time.perf_counter()
         with metrics.PRIORITIZE_LATENCY.time(), \
                 trace.phase("prioritize", args.pod.namespace,
                             args.pod.name, args.pod.uid,
                             enabled=_traced_pod(args.pod)) as dec:
+            if parent:
+                trace.set_parent(parent)
             if queue_s:
                 trace.note_queue_wait(queue_s)
             entries = self.prioritize.handle(args, table=table)
@@ -400,7 +407,8 @@ class ExtenderHTTPServer(HTTPServer):
         obs.note_verb("prioritize", handler_ms / 1e3,
                       dec.trace_id if dec is not None else "")
         # HostPriorityList is a bare JSON array on the wire.
-        return wire.encode_host_priorities(entries), handler_ms
+        return (wire.encode_host_priorities(entries), handler_ms,
+                dec.trace_id if dec is not None else "")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -597,6 +605,13 @@ class _Handler(BaseHTTPRequestHandler):
             return False, None
         return True, min(max(window, 1.0), 3600.0)
 
+    def _parent_trace(self) -> str:
+        """The caller's causal root from the W3C ``traceparent``
+        request header, or ``""`` (absent/malformed headers are not an
+        error — causality is observational)."""
+        return trace.parse_traceparent(
+            self.headers.get("traceparent", "") or "")
+
     # -- verbs -------------------------------------------------------------
     def _query(self) -> dict[str, str]:
         if "?" not in self.path:
@@ -673,6 +688,31 @@ class _Handler(BaseHTTPRequestHandler):
                 # depth, keep-alive reuse, the micro-batch gates, and
                 # the wire-memo fill (docs/observability.md).
                 self._send_json(self.server.http_stats())
+            elif path == "/debug/blackbox":
+                doc = obs.blackbox_snapshot()
+                if doc["journal"] is None and doc["export"] is None:
+                    self._send_json(
+                        {"Error": "black-box journal is not armed "
+                                  "(set TPUSHARE_BLACKBOX_DIR and/or "
+                                  "TPUSHARE_EXPORT_URL)"}, 404)
+                else:
+                    self._send_json(doc)
+            elif path == "/debug/trace":
+                # The causal-chain resolver: /debug/trace?id=<trace-id>
+                # → target + ancestors + descendants, across
+                # components and restarts (journal-restored decisions
+                # participate). The per-pod lookup stays at
+                # /debug/trace/<ns>/<pod>.
+                chain_id = self._query().get("id", "")
+                chain = (trace.causal_chain(chain_id)
+                         if chain_id else None)
+                if chain is None:
+                    self._send_json(
+                        {"Error": f"no causal chain for {chain_id!r} "
+                                  "(want /debug/trace?id=<trace-id>)"},
+                        404)
+                else:
+                    self._send_json(chain)
             elif path.startswith("/debug/trace/"):
                 rest = path[len("/debug/trace/"):]
                 ns, sep, pod_name = rest.partition("/")
@@ -807,12 +847,16 @@ class _Handler(BaseHTTPRequestHandler):
                 # verb itself — trace phase, SLO story, encode — runs
                 # in the server's _run_filter on whichever thread
                 # drains the batch.
-                res, queue_s = self.server.filter_gate.submit(args)
+                res, queue_s = self.server.filter_gate.submit(
+                    args, parent=self._parent_trace())
                 if isinstance(res, Exception):
                     raise res  # this item's own failure: 500 below
-                body, handler_ms = res
-                self._send_bytes(body, extra_headers=_server_timing(
-                    handler_ms, queue_s * 1e3))
+                body, handler_ms, trace_id = res
+                headers = _server_timing(handler_ms, queue_s * 1e3)
+                if trace_id:
+                    headers["traceparent"] = \
+                        trace.format_traceparent(trace_id)
+                self._send_bytes(body, extra_headers=headers)
             elif path == f"{prefix}/prioritize":
                 args = self._read_args()
                 if args is None:
@@ -821,12 +865,16 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"Error": "prioritize not configured"},
                                     404)
                     return
-                res, queue_s = self.server.prioritize_gate.submit(args)
+                res, queue_s = self.server.prioritize_gate.submit(
+                    args, parent=self._parent_trace())
                 if isinstance(res, Exception):
                     raise res  # this item's own failure: 500 below
-                body, handler_ms = res
-                self._send_bytes(body, extra_headers=_server_timing(
-                    handler_ms, queue_s * 1e3))
+                body, handler_ms, trace_id = res
+                headers = _server_timing(handler_ms, queue_s * 1e3)
+                if trace_id:
+                    headers["traceparent"] = \
+                        trace.format_traceparent(trace_id)
+                self._send_bytes(body, extra_headers=headers)
             elif path == f"{prefix}/preempt":
                 doc = self._read_json()
                 if doc is None:
@@ -841,6 +889,7 @@ class _Handler(BaseHTTPRequestHandler):
                                     pre_args.pod.name, pre_args.pod.uid,
                                     enabled=_traced_pod(pre_args.pod)) \
                         as dec:
+                    trace.set_parent(self._parent_trace())
                     result = self.server.preempt.handle(pre_args)
                 obs.note_verb("preempt", time.perf_counter() - t0,
                               dec.trace_id if dec is not None else "")
@@ -880,6 +929,11 @@ class _Handler(BaseHTTPRequestHandler):
                         trace.phase("bind", args_parsed.pod_namespace,
                                     args_parsed.pod_name,
                                     args_parsed.pod_uid) as dec:
+                    # The caller's traceparent makes this bind's
+                    # decision a child of the scheduler's causal root
+                    # (and, via the pod annotation, the ancestor every
+                    # later defrag/autoscale action resolves to).
+                    trace.set_parent(self._parent_trace())
                     result = self.server.binder.handle(args_parsed)
                 handler_ms = (time.perf_counter() - t0) * 1e3
                 if result.error and not result.pending:
@@ -911,9 +965,13 @@ class _Handler(BaseHTTPRequestHandler):
                               dec.trace_id if dec is not None else "")
                 # Reference returns HTTP 500 when bind fails
                 # (routes.go:139-143) so the scheduler retries.
+                headers = _server_timing(handler_ms)
+                if dec is not None:
+                    headers["traceparent"] = \
+                        trace.format_traceparent(dec.trace_id)
                 self._send_json(result.to_json(),
                                 500 if result.error else 200,
-                                extra_headers=_server_timing(handler_ms))
+                                extra_headers=headers)
             else:
                 self._send_json({"Error": f"no route for {path}"}, 404)
         except Exception as e:  # pragma: no cover - defensive

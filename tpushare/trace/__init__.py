@@ -24,16 +24,21 @@ from typing import Any
 
 from tpushare.trace.recorder import (DEFAULT_CAPACITY, Decision,
                                      DropCounter, FlightRecorder, Span,
-                                     add_phase_hook, new_trace_id,
+                                     add_complete_hook, add_phase_hook,
+                                     format_traceparent, new_trace_id,
+                                     parse_traceparent,
+                                     remove_complete_hook,
                                      remove_phase_hook, set_phase_probe)
 from tpushare.utils import locks
 
 __all__ = [
     "DEFAULT_CAPACITY", "Decision", "DropCounter", "FlightRecorder",
-    "Span", "add_phase_hook", "complete", "current", "current_trace_id",
-    "flight", "get_trace", "new_trace_id", "note", "note_api_call",
-    "note_queue_wait", "phase", "recorder", "remove_phase_hook",
-    "reset", "set_phase_probe", "span",
+    "Span", "add_complete_hook", "add_phase_hook", "causal_chain",
+    "complete", "current", "current_parent_id", "current_trace_id",
+    "flight", "format_traceparent", "get_trace", "new_trace_id", "note",
+    "note_api_call", "note_queue_wait", "parse_traceparent", "phase",
+    "recorder", "remove_complete_hook", "remove_phase_hook", "reset",
+    "restore", "set_parent", "set_phase_probe", "span",
 ]
 
 _recorder = FlightRecorder()
@@ -75,6 +80,29 @@ def current() -> Decision | None:
 
 def current_trace_id() -> str:
     return _recorder.current_trace_id()
+
+
+def current_parent_id() -> str:
+    return _recorder.current_parent_id()
+
+
+def set_parent(parent_id: str) -> None:
+    """Stamp a causal parent on this thread's current decision (no-op
+    without one) — wire verbs pass the caller's ``traceparent``,
+    defrag/autoscale pass the bind trace id off the pod annotation."""
+    _recorder.set_parent(parent_id)
+
+
+def restore(doc: dict) -> None:
+    """Admit a decision doc replayed from a previous process's
+    black-box journal (causal-chain history, not live state)."""
+    _recorder.restore(doc)
+
+
+def causal_chain(trace_id: str) -> dict | None:
+    """Resolve a trace id into target + ancestors + descendants across
+    components and restarts (the ``/debug/trace?id=`` surface)."""
+    return _recorder.causal_chain(trace_id)
 
 
 def complete(dec: Decision | None, outcome: str, node: str = "",

@@ -77,6 +77,49 @@ def new_trace_id() -> str:
     return uuid.uuid4().hex[:12]
 
 
+#: Restored (pre-restart) decision docs kept for causal-chain lookups.
+DEFAULT_MAX_RESTORED = 512
+
+_HEX = set("0123456789abcdef")
+
+
+def parse_traceparent(header: str) -> str:
+    """Extract our trace id from a W3C ``traceparent`` header
+    (``00-<32 hex trace-id>-<16 hex span-id>-<flags>``), or ``""``.
+
+    Our native ids are 12 hex chars; :func:`format_traceparent` pads
+    them right with zeros, so a 32-hex id ending in 20 zeros
+    canonicalizes back to its 12-hex form. A foreign id (entropy in the
+    tail) is kept whole — we join their trace rather than truncate it.
+    """
+    parts = header.strip().lower().split("-")
+    if len(parts) != 4:
+        return ""
+    version, trace_id, span_id, _flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+        return ""
+    if not (set(version) <= _HEX and set(trace_id) <= _HEX
+            and set(span_id) <= _HEX):
+        return ""
+    if version == "ff":  # forbidden by the W3C spec
+        return ""
+    if trace_id == "0" * 32:
+        return ""
+    if trace_id.endswith("0" * 20):
+        return trace_id[:12]
+    return trace_id
+
+
+def format_traceparent(trace_id: str) -> str:
+    """Render one of our trace ids as a W3C ``traceparent`` header
+    value (native 12-hex ids are zero-padded to the 32-hex field; the
+    span-id field carries the same id — we model causality at decision
+    granularity, not span granularity)."""
+    tid = (trace_id + "0" * 32)[:32]
+    sid = (trace_id + "0" * 16)[:16]
+    return f"00-{tid}-{sid}-01"
+
+
 #: Phase-exit sinks beyond the ring: ``hook(verb, span)`` runs as each
 #: verb phase closes (the per-verb cost ledger in
 #: :mod:`tpushare.profiling` registers one). Appended-at-import then
@@ -96,6 +139,28 @@ def add_phase_hook(hook: Any) -> None:
 def remove_phase_hook(hook: Any) -> None:
     if hook in _phase_hooks:
         _phase_hooks.remove(hook)
+
+
+#: Decision-completion sinks beyond the ring: ``hook(decision)`` runs
+#: as each decision finalizes — the black-box journal tees completed
+#: decisions to disk here. Same contract as :data:`_phase_hooks`:
+#: append-at-import, read-only iteration (no lock), failures
+#: drop-counted; invoked AFTER the recorder's lock is released so a
+#: slow sink can never extend the completion critical section.
+_complete_hooks: list[Any] = []
+
+
+def add_complete_hook(hook: Any) -> None:
+    """Register ``hook(dec: Decision)``, invoked when a decision
+    finalizes (outcome and timings final, decision already on the
+    ring)."""
+    if hook not in _complete_hooks:
+        _complete_hooks.append(hook)
+
+
+def remove_complete_hook(hook: Any) -> None:
+    if hook in _complete_hooks:
+        _complete_hooks.remove(hook)
 
 
 #: Optional phase probe: ``probe(verb) -> context manager | None``,
@@ -175,6 +240,11 @@ class Decision:
         self.namespace = namespace
         self.name = name
         self.uid = uid
+        #: Causal parent: the trace id of the decision this one
+        #: descends from — a defrag move's parent is the bind that
+        #: placed the pod, a wire verb's parent arrives in the caller's
+        #: ``traceparent`` header. Empty for causal roots.
+        self.parent_id = ""
         self.started_at = time.time()
         self._t0 = time.perf_counter()
         self.outcome = "open"
@@ -241,6 +311,8 @@ class Decision:
             # handler thread; Span objects are append-only after open.
             "spans": [sp.to_json() for sp in list(self.spans)],
         }
+        if self.parent_id:
+            doc["parentId"] = self.parent_id
         return doc
 
 
@@ -270,6 +342,12 @@ class FlightRecorder:
         #: boundary may attribute to either side, which a statistical
         #: profile absorbs.
         self._active_verbs: dict[int, str] = {}
+        #: Decisions replayed from a previous process's black-box
+        #: journal (raw docs tagged ``restored: true``): served by the
+        #: causal-chain resolver so an eviction after a restart still
+        #: finds the bind that placed the pod. Bounded like the ring.
+        self._restored: deque[dict[str, Any]] = deque(
+            maxlen=DEFAULT_MAX_RESTORED)
         self.drops = DropCounter()
 
     # -- current-decision plumbing --------------------------------------- #
@@ -280,6 +358,21 @@ class FlightRecorder:
     def current_trace_id(self) -> str:
         dec = self.current()
         return dec.trace_id if dec is not None else ""
+
+    def current_parent_id(self) -> str:
+        dec = self.current()
+        return dec.parent_id if dec is not None else ""
+
+    def set_parent(self, parent_id: str) -> None:
+        """Stamp the causal parent on this thread's current decision.
+        No-op without a decision or with an empty/self parent — call
+        sites pass whatever annotation/header they have."""
+        dec = self.current()
+        if (dec is None or not parent_id
+                or parent_id == dec.trace_id):
+            return
+        if not dec.parent_id:
+            dec.parent_id = parent_id
 
     def active_verb_map(self) -> dict[int, str]:
         """The live tid → open-verb map (see ``_active_verbs``). The
@@ -389,6 +482,14 @@ class FlightRecorder:
                 del self._open[(dec.namespace, dec.name)]
             dec.finish(outcome, node, error)
             self._ring.append(dec)
+        # Completion sinks run OUTSIDE the lock: the black-box journal
+        # (or any other tee) must never extend the critical section a
+        # verb's completion sits in.
+        for hook in _complete_hooks:
+            try:
+                hook(dec)
+            except Exception:  # noqa: BLE001 - hooks are telemetry
+                self.drops.inc()
 
     # -- sub-spans and attribution ---------------------------------------- #
 
@@ -455,6 +556,85 @@ class FlightRecorder:
         sp.api_s += max(seconds, 0.0)
         sp.api_calls += 1
 
+    # -- restored decisions and causal chains ------------------------------ #
+
+    def restore(self, doc: dict[str, Any]) -> None:
+        """Admit one decision doc replayed from a previous process's
+        black-box journal. Kept as the raw dict (tagged
+        ``restored: true``) — pre-crash decisions are history, not
+        live state, so they never re-enter the open table or ring."""
+        try:
+            if not isinstance(doc, dict) or not doc.get("traceId"):
+                self.drops.inc()
+                return
+            marked = dict(doc)
+            marked["restored"] = True
+            with self._lock:
+                self._restored.append(marked)
+        except Exception:  # noqa: BLE001 - replay is telemetry
+            self.drops.inc()
+
+    def _all_docs(self) -> list[dict[str, Any]]:
+        """Every decision doc the causal resolver can see: restored
+        history first (oldest), then the ring, then still-open
+        attempts — later docs win on trace-id collision."""
+        with self._lock:
+            docs = list(self._restored)
+            docs.extend(d.to_json() for d in self._ring)
+            docs.extend(d.to_json() for d in self._open.values())
+        return docs
+
+    def causal_chain(self, trace_id: str) -> dict[str, Any] | None:
+        """Resolve ``trace_id`` into its causal chain: the target
+        decision, its ancestors (walking ``parentId`` up to the root),
+        and its descendants (every decision whose parent chain reaches
+        it). This is the ``/debug/trace?id=`` surface — it spans
+        components AND restarts because restored journal docs
+        participate."""
+        docs = self._all_docs()
+        by_id: dict[str, dict[str, Any]] = {}
+        children: dict[str, list[dict[str, Any]]] = {}
+        for doc in docs:
+            tid = doc.get("traceId", "")
+            if tid:
+                by_id[tid] = doc
+        for doc in by_id.values():
+            parent = doc.get("parentId", "")
+            if parent:
+                children.setdefault(parent, []).append(doc)
+        target = by_id.get(trace_id)
+        if target is None:
+            return None
+        ancestors: list[dict[str, Any]] = []
+        seen = {trace_id}
+        parent = target.get("parentId", "")
+        # Depth cap: a corrupt/cyclic parent chain must terminate.
+        while parent and parent not in seen and len(ancestors) < 16:
+            seen.add(parent)
+            node = by_id.get(parent)
+            if node is None:
+                # Parent aged out of every buffer: report the dangling
+                # id so the operator knows the chain continues.
+                ancestors.append({"traceId": parent, "missing": True})
+                break
+            ancestors.append(node)
+            parent = node.get("parentId", "")
+        descendants: list[dict[str, Any]] = []
+        frontier = [trace_id]
+        visited = {trace_id}
+        while frontier and len(descendants) < 64:
+            nxt: list[str] = []
+            for tid in frontier:
+                for child in children.get(tid, []):
+                    ctid = child.get("traceId", "")
+                    if ctid and ctid not in visited:
+                        visited.add(ctid)
+                        descendants.append(child)
+                        nxt.append(ctid)
+            frontier = nxt
+        return {"target": target, "ancestors": ancestors,
+                "descendants": descendants}
+
     # -- readers ----------------------------------------------------------- #
 
     def flight(self, limit: int | None = None) -> list[dict]:
@@ -481,6 +661,14 @@ class FlightRecorder:
                     if (dec.namespace == namespace and dec.name == name
                             and dec.trace_id == trace_id):
                         return dec.to_json()
+                # Restored journal docs resolve too: the explain
+                # surface must answer for decisions a previous process
+                # made (docs/observability.md §7).
+                for doc in reversed(self._restored):
+                    if (doc.get("namespace") == namespace
+                            and doc.get("name") == name
+                            and doc.get("traceId") == trace_id):
+                        return dict(doc)
                 return None
             for dec in reversed(self._ring):
                 if dec.namespace == namespace and dec.name == name:
@@ -493,4 +681,5 @@ class FlightRecorder:
             self._ring.clear()
             self._open.clear()
             self._active_verbs.clear()
+            self._restored.clear()
             self.drops = DropCounter()

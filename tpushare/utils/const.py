@@ -61,13 +61,22 @@ ANN_ASSUME_TIME = "tpushare.io/assume-time"
 #: and the device plugin ignore it.
 ANN_TRACE_ID = "tpushare.io/trace-id"
 
+#: Causal parent of the bind decision — the trace id this placement
+#: descends from (the scheduler's ``traceparent`` header, a defrag
+#: plan's move, a router scale-out). Later actors touching the pod
+#: (defrag, autoscale drain, eviction) read ANN_TRACE_ID as THEIR
+#: parent, chaining causality across components and restarts
+#: (docs/observability.md §7). Purely observational, like trace-id.
+ANN_TRACE_PARENT = "tpushare.io/trace-parent"
+
 #: The bind-time grant record as a unit: every annotation the extender
 #: writes when placing a pod. Rollback (gang TTL expiry) and
 #: re-request modeling (the defrag planner's what-if re-placement, the
 #: simulator's migrant recreation) strip exactly this set — one tuple,
 #: so a future grant annotation cannot be forgotten at one strip site.
 GRANT_ANNOTATIONS = (ANN_CHIP_IDX, ANN_HBM_POD, ANN_HBM_CHIP,
-                     ANN_ASSIGNED, ANN_ASSUME_TIME, ANN_TRACE_ID)
+                     ANN_ASSIGNED, ANN_ASSUME_TIME, ANN_TRACE_ID,
+                     ANN_TRACE_PARENT)
 
 # --------------------------------------------------------------------------
 # Node annotations (new — the reference had no node-side schema beyond the

@@ -223,6 +223,7 @@ def updated_pod_annotation_spec(
     hbm_chip: int,
     assume_time_ns: int | None = None,
     trace_id: str | None = None,
+    trace_parent: str | None = None,
 ) -> Pod:
     """Deep-copy ``pod`` with the bind-time annotation set applied.
 
@@ -230,7 +231,8 @@ def updated_pod_annotation_spec(
     the nanosecond assume time — the durable commit record the ledger is
     rebuilt from on restart and the device plugin matches on (reference
     ``GetUpdatedPodAnnotationSpec``, pod.go:192-206). ``trace_id`` adds
-    the decision-trace correlation key (observational only).
+    the decision-trace correlation key, ``trace_parent`` the causal
+    ancestor that decision descends from (both observational only).
     """
     new_pod = pod.deepcopy()
     ann = new_pod.metadata.setdefault("annotations", {})
@@ -244,4 +246,6 @@ def updated_pod_annotation_spec(
     ann[const.ANN_ASSUME_TIME] = str(now_ns)
     if trace_id:
         ann[const.ANN_TRACE_ID] = trace_id
+    if trace_parent:
+        ann[const.ANN_TRACE_PARENT] = trace_parent
     return new_pod
