@@ -1,6 +1,6 @@
 # Top-level developer entry points.
 
-.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-wire bench-topo bench-autoscale bench-workload bench-router bench-diff all
+.PHONY: test lint test-race chipcheck cochipcheck native bench bench-scale bench-wire bench-topo bench-autoscale bench-workload bench-router bench-fleetday bench-diff all
 
 # CPU test suite (virtual 8-device mesh; kernels in interpreter mode).
 test:
@@ -92,8 +92,18 @@ bench-workload:
 bench-router:
 	python bench_router.py --gate
 
+# The fleet-day witness: one seeded, clock-compressed 24h replay
+# through the REAL stack (quota apply, surge, NotReady host, defrag
+# wave, autoscale up/down), every act graded against its marker /
+# Event / metric legs — gated on 100% matched conformance, end-of-day
+# SLO + fairness + node-hours scalars, zero guarantee evictions, and
+# the witness overhead probe. Writes BENCH_FLEETDAY.json
+# (docs/observability.md §8).
+bench-fleetday:
+	python bench.py --fleet-day --gate
+
 # Drift check: re-run the scale + wire + autoscale + topology +
-# router + workload smokes and diff their gated stats against the
+# router + fleet-day + workload smokes and diff their gated stats against the
 # committed contracts (>10% unfavorable drift exits nonzero; boolean
 # gates like the router fairness/shed/drain proofs must simply still
 # pass). Smoke scenarios are smaller than the committed runs, so treat
@@ -106,11 +116,13 @@ bench-diff:
 	python bench.py --autoscale --smoke > /tmp/tpushare-bench-autoscale.json
 	python bench.py --topology --smoke > /tmp/tpushare-bench-topo.json
 	python bench_router.py --smoke > /tmp/tpushare-bench-router.json
+	python bench.py --fleet-day --smoke > /tmp/tpushare-bench-fleetday.json
 	python tools/bench_diff.py BENCH_SCALE.json /tmp/tpushare-bench-scale.json
 	python tools/bench_diff.py BENCH_WIRE_r01.json /tmp/tpushare-bench-wire.json
 	python tools/bench_diff.py BENCH_AUTOSCALE.json /tmp/tpushare-bench-autoscale.json
 	python tools/bench_diff.py BENCH_TOPO_r01.json /tmp/tpushare-bench-topo.json
 	python tools/bench_diff.py BENCH_ROUTER_r02.json /tmp/tpushare-bench-router.json
+	python tools/bench_diff.py BENCH_FLEETDAY.json /tmp/tpushare-bench-fleetday.json
 	python bench_workload.py --allow-cpu > /tmp/tpushare-bench-workload.json
 	python tools/bench_diff.py BENCH_WORKLOAD_r09.json /tmp/tpushare-bench-workload.json
 

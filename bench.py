@@ -1558,6 +1558,179 @@ def _blackbox_overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
 
 
 # ------------------------------------------------------------------------- #
+# --fleet-day: the composed 24h witnessed replay (docs/observability.md §8)
+# ------------------------------------------------------------------------- #
+
+#: The committed fleet-day seed: BENCH_FLEETDAY.json is the verdict of
+#: THIS day; same seed -> same witness verdicts and scalars, bit for bit.
+FLEETDAY_SEED = 1234
+#: Smoke compresses the day, not the story: every injected act still
+#: runs (the scale_up/scale_down fractions land on distinct hours down
+#: to 8; CI uses 12).
+FLEETDAY_SMOKE_HOURS = 12
+#: End-of-day pod-SLO floor: every workload pod the day admitted must
+#: end bound (the composed day is engineered to place everything — a
+#: miss means a subsystem dropped a pod on the floor).
+FLEETDAY_GATE_SLO_PCT = 95.0
+#: Router fairness floor across the steady tenants' served share
+#: (Jain index; the flooder is excluded — shedding IT is the point).
+FLEETDAY_GATE_JAIN = 0.9
+#: Elasticity gate: the day's node-hours may not exceed the
+#: peak-static fleet's (max fleet size x hours).
+FLEETDAY_GATE_NODE_HOURS = 1.0
+
+
+def _witness_overhead_probe(fleet: "_Fleet", rng, batches: int = 5,
+                            per_batch: int = 500) -> dict:
+    """The witness's overhead gate: the same interleaved mutation-free
+    batches as :func:`_overhead_probe`, with the fleet-day witness
+    armed (carrying a staked day of expectations, so the armed arm
+    pays the real ``obs.mark`` tee + intake bookkeeping) vs disarmed.
+    The witness's hot-path footprint is one armed-check per marker —
+    markers fire on acts, not per request — so the gated handlers must
+    not measurably notice it. Same MIN-of-batch-p99s estimator and the
+    same max(5%, floor) allowance, reported ms-unit like
+    :func:`_blackbox_overhead_probe` so the drift contract diffs the
+    delta as a scalar."""
+    from tpushare import obs
+    from tpushare.k8s.builders import make_pod
+
+    pod = fleet.api.create_pod(make_pod("witness-probe", hbm=24))
+    witness = obs.witness()
+    was_armed = witness.armed()
+    witness.reset()
+
+    p99s: dict[bool, list[float]] = {True: [], False: []}
+    try:
+        for _ in range(batches):
+            for armed in (False, True):
+                if armed:
+                    witness.arm()
+                    for i in range(6):
+                        witness.expect(f"probe-act-{i}", kind="config",
+                                       window_s=30.0, injected_ts=0.0)
+                else:
+                    witness.reset()
+                p99s[armed].append(_probe_batch(fleet, rng, pod,
+                                                per_batch))
+    finally:
+        witness.reset()
+        if was_armed:  # pragma: no cover - probe owns the singleton
+            witness.arm()
+
+    p99_off = min(p99s[False])
+    p99_on = min(p99s[True])
+    delta_ms = max(p99_on - p99_off, 0.0)
+    allowance_ms = max(SCALE_GATE_OVERHEAD * p99_off,
+                       SCALE_GATE_OVERHEAD_FLOOR_MS)
+    return {
+        "value": round(delta_ms, 3),
+        "limit": round(allowance_ms, 3),
+        "pass": delta_ms <= allowance_ms,
+        "p99_off_ms": round(p99_off, 3),
+        "p99_on_ms": round(p99_on, 3),
+        "p99_delta": round(delta_ms / p99_off if p99_off else 0.0, 4),
+    }
+
+
+def bench_fleet_day(smoke: bool) -> dict:
+    """Run the committed fleet-day scenario through the REAL stack via
+    tools/simulate.py's composed-scenario driver and return its
+    ``fleet_day`` report, plus the witness overhead probe on a quiet
+    probe fleet (the day itself is serialized replay, not a latency
+    harness)."""
+    import random
+
+    import yaml
+
+    from tools import simulate as sim
+
+    scenario = yaml.safe_load(sim.EXAMPLE_FLEET_DAY)
+    if smoke:
+        scenario["fleet_day"]["hours"] = FLEETDAY_SMOKE_HOURS
+    report = sim.simulate(scenario, seed=FLEETDAY_SEED)
+    day = report.get("fleet_day") or {}
+    if day.get("error"):
+        raise SystemExit(f"fleet-day scenario failed: {day['error']}")
+
+    rng = random.Random(97)
+    fleet = _Fleet("fw", 64 if smoke else 256)
+    try:
+        overhead = _witness_overhead_probe(
+            fleet, rng, batches=3 if smoke else 5,
+            per_batch=120 if smoke else 500)
+    finally:
+        fleet.close()
+    return {"day": day, "witness_overhead": overhead}
+
+
+def main_fleet_day(smoke: bool) -> None:
+    """``--fleet-day``: one compressed, seeded 24-hour replay through
+    every subsystem, graded act by act by the fleet-day witness
+    (docs/observability.md §8). Prints ONE JSON line; the full run
+    writes BENCH_FLEETDAY.json (the bench-diff drift contract).
+    ``--gate`` fails the run unless conformance is 100% matched AND
+    the end-of-day scalars hold."""
+    import logging
+    import os
+    import sys
+
+    logging.disable(logging.WARNING)
+    result = bench_fleet_day(smoke)
+    day = result["day"]
+    witness = day.get("witness") or {}
+    scalars = day.get("scalars") or {}
+    conformance = float(witness.get("conformancePct") or 0.0)
+    gates = {
+        # Every injected act matched in its window, nothing unexplained:
+        # the timeline itself is under test, so the limit is exact.
+        "witness_conformance": {
+            "value": conformance, "limit": 100.0,
+            "pass": bool(witness.get("pass")) and conformance >= 100.0},
+        "pod_slo_compliance": {
+            "value": scalars.get("pod_slo_compliance_pct"),
+            "limit": FLEETDAY_GATE_SLO_PCT,
+            "pass": (scalars.get("pod_slo_compliance_pct") or 0.0)
+            >= FLEETDAY_GATE_SLO_PCT},
+        "router_fairness_jain": {
+            "value": scalars.get("router_fairness_jain"),
+            "limit": FLEETDAY_GATE_JAIN,
+            "pass": (scalars.get("router_fairness_jain") or 0.0)
+            >= FLEETDAY_GATE_JAIN},
+        "node_hours_ratio": {
+            "value": scalars.get("node_hours_ratio"),
+            "limit": FLEETDAY_GATE_NODE_HOURS,
+            "pass": (scalars.get("node_hours_ratio") or 2.0)
+            <= FLEETDAY_GATE_NODE_HOURS},
+        "guarantee_evictions": {
+            "value": scalars.get("guarantee_evictions"),
+            "limit": 0,
+            "pass": scalars.get("guarantee_evictions") == 0},
+        "witness_overhead": result["witness_overhead"],
+    }
+    doc = {
+        "metric": "fleet_day_witness_conformance_pct",
+        "value": round(conformance, 2),
+        "unit": "%",
+        "vs_baseline": round(conformance / 100.0, 4),
+        "smoke": smoke,
+        "seed": FLEETDAY_SEED,
+        "gates": gates,
+        **result,
+    }
+    line = json.dumps(doc)
+    print(line)
+    if not smoke:
+        root = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(root, "BENCH_FLEETDAY.json"), "w",
+                  encoding="utf-8") as f:
+            f.write(line + "\n")
+    if "--gate" in sys.argv and not all(g["pass"]
+                                        for g in gates.values()):
+        sys.exit(1)
+
+
+# ------------------------------------------------------------------------- #
 # The subprocess wire client: the honest wire clock (ROADMAP item 4)
 # ------------------------------------------------------------------------- #
 
@@ -2437,5 +2610,9 @@ if __name__ == "__main__":
         # Demand-driven fleet sizing over a diurnal wave, judged
         # against the peak-sized static fleet (docs/autoscale.md).
         main_autoscale(smoke="--smoke" in _sys.argv)
+    elif "--fleet-day" in _sys.argv:
+        # The composed, seeded 24h replay with the fleet-day witness
+        # grading every act (docs/observability.md §8).
+        main_fleet_day(smoke="--smoke" in _sys.argv)
     else:
         main()

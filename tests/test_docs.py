@@ -416,6 +416,65 @@ def test_observability_doc_covers_blackbox():
         assert needle in doc, needle
 
 
+def test_observability_doc_covers_fleetday():
+    """§8 is the fleet-day-witness contract: the witness model, the
+    verdict taxonomy, the composed-day surfaces and gates, and the
+    triage runbook (including the missing-marker row) must stay
+    pinned."""
+    with open(OBSERVABILITY_MD, encoding="utf-8") as f:
+        doc = f.read()
+    for needle in ("fleet-day witness", "/debug/fleetday",
+                   "kubectl inspect tpushare fleetday",
+                   "stakes an expectation", "marker leg", "event leg",
+                   "metric leg", "MARKER_KINDS",
+                   "`matched`", "`late`", "`missing`", "`spurious`",
+                   "tpushare_witness_events_matched_total",
+                   "tpushare_witness_events_late_total",
+                   "tpushare_witness_events_missing_total",
+                   "tpushare_witness_events_spurious_total",
+                   "--example-fleet-day", "bench.py --fleet-day",
+                   "make bench-fleetday", "BENCH_FLEETDAY.json",
+                   "obs.set_clock", "bit for bit", "`node-notready`",
+                   "Runbook: a witness verdict went red",
+                   "marker=MISS", "event=MISS", "metric=MISS"):
+        assert needle in doc, needle
+
+
+def test_fleet_day_expected_kinds_are_in_the_taxonomy():
+    """The fleet-day driver's expectation kinds and the timeline's
+    marker taxonomy must not drift: every kind the composed day
+    witnesses must exist in MARKER_KINDS (checked by AST — the lint
+    job runs this without importing the project)."""
+    def _literal(path: str, name: str) -> list[str]:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and any(getattr(t, "id", "") == name
+                            for t in node.targets)):
+                value = node.value
+                # frozenset({...}) wraps the literal in a Call.
+                if isinstance(value, ast.Call):
+                    value = value.args[0]
+                return [c.value for c in value.elts]
+        raise AssertionError(f"{name} literal not found in {path}")
+
+    expected = _literal(os.path.join(REPO_ROOT, "tools", "simulate.py"),
+                        "FLEET_DAY_EXPECTED_KINDS")
+    taxonomy = _literal(os.path.join(REPO_ROOT, "tpushare", "obs",
+                                     "timeline.py"), "MARKER_KINDS")
+    assert expected, "fleet-day driver witnesses no kinds?"
+    stray = sorted(set(expected) - set(taxonomy))
+    assert not stray, (
+        f"fleet-day expected kinds missing from MARKER_KINDS: {stray}")
+    # ...and every witnessed kind is documented in the marker taxonomy.
+    with open(OBSERVABILITY_MD, encoding="utf-8") as f:
+        doc = f.read()
+    undocumented = sorted(k for k in expected if f"`{k}`" not in doc)
+    assert not undocumented, (
+        f"witnessed kinds absent from observability.md: {undocumented}")
+
+
 if __name__ == "__main__":
     # CI's lint job runs this file as a plain script (no pytest, no
     # project install — tests/conftest.py would drag jax in); the same
@@ -440,7 +499,9 @@ if __name__ == "__main__":
                   test_perf_doc_is_linked,
                   test_vet_doc_covers_the_flow_layer,
                   test_vet_doc_covers_the_protocol_layer,
-                  test_vet_doc_is_linked):
+                  test_vet_doc_is_linked,
+                  test_observability_doc_covers_fleetday,
+                  test_fleet_day_expected_kinds_are_in_the_taxonomy):
         try:
             check()
         except AssertionError as e:

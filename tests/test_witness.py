@@ -1,0 +1,362 @@
+"""Fleet-day witness: the ISSUE-19 acceptance contract
+(tpushare/obs/witness.py, tools/simulate.py fleet_day,
+docs/observability.md §8).
+
+Covers: the verdict logic leg by leg (matched / late / missing /
+spurious, marker + Event + metric, the pre-injection baseline
+semantics), schedule validation (unknown kinds and duplicate ids fail
+the author loudly), clock injection through ``obs.set_clock``, the
+composed day through the REAL stack with a passing verdict table, the
+seeded-fault drill (suppress one marker and one Event; the witness
+reports exactly those legs as missing — nothing else), same-seed
+bit-for-bit reproducibility, and the scrape counters."""
+
+import json
+
+import pytest
+import yaml
+
+from tpushare import obs
+from tpushare.k8s import events as k8s_events
+from tpushare.obs.witness import FleetDayWitness
+
+
+@pytest.fixture(autouse=True)
+def fresh_witness():
+    """obs is a module singleton; every test starts clean (conftest's
+    _fresh_obs resets on teardown, this guards the front door too)."""
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def make_witness(now: float = 0.0) -> tuple[FleetDayWitness, list]:
+    clock = [now]
+    w = FleetDayWitness()
+    w.set_now(lambda: clock[0])
+    return w, clock
+
+
+def raw_event(name: str, reason: str, message: str = "") -> tuple[str, dict]:
+    """One FakeApiServer-shaped event record: (namespace, doc)."""
+    return ("kube-system", {"metadata": {"name": name},
+                            "reason": reason, "message": message})
+
+
+# ------------------------------------------------------------------------ #
+# Verdict logic, leg by leg
+# ------------------------------------------------------------------------ #
+
+
+class TestVerdictLegs:
+    def test_marker_inside_window_matches(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="config", injected_ts=10.0, window_s=5.0)
+        w.observe_marker("config", 12.0, "quota applied", {})
+        report = w.evaluate()
+        assert report["pass"]
+        assert report["verdicts"][0]["verdict"] == "matched"
+        assert report["verdicts"][0]["markerLagS"] == 2.0
+
+    def test_marker_after_deadline_is_late(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="config", injected_ts=10.0, window_s=5.0)
+        w.observe_marker("config", 30.0, "quota applied", {})
+        report = w.evaluate()
+        assert not report["pass"]
+        assert report["verdicts"][0]["verdict"] == "late"
+
+    def test_no_marker_is_missing(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="config", injected_ts=10.0, window_s=5.0)
+        report = w.evaluate()
+        verdict = report["verdicts"][0]
+        assert verdict["verdict"] == "missing"
+        assert verdict["legs"] == {"marker": False, "event": None,
+                                   "metric": None}
+
+    def test_detail_substring_must_match(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="config", detail_substr="quota",
+                 injected_ts=10.0, window_s=5.0)
+        w.observe_marker("config", 11.0, "slo objectives applied", {})
+        assert w.evaluate()["verdicts"][0]["verdict"] == "missing"
+
+    def test_marker_attrs_count_toward_detail(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="node-notready", detail_substr="node=tpu-03",
+                 injected_ts=10.0, window_s=5.0)
+        w.observe_marker("node-notready", 11.0, "host failure",
+                         {"node": "tpu-03"})
+        assert w.evaluate()["verdicts"][0]["verdict"] == "matched"
+
+    def test_event_leg(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="node-notready",
+                 event_reason=k8s_events.REASON_NODE_NOTREADY,
+                 injected_ts=10.0, window_s=5.0)
+        w.observe_marker("node-notready", 11.0, "node tpu-03 NotReady", {})
+        w.observe_events([raw_event("e1",
+                                    k8s_events.REASON_NODE_NOTREADY)],
+                         now=11.0)
+        report = w.evaluate()
+        assert report["verdicts"][0]["verdict"] == "matched"
+        assert report["verdicts"][0]["legs"]["event"] is True
+
+    def test_missing_event_leg_names_itself(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="node-notready",
+                 event_reason=k8s_events.REASON_NODE_NOTREADY,
+                 injected_ts=10.0, window_s=5.0)
+        w.observe_marker("node-notready", 11.0, "node tpu-03 NotReady", {})
+        verdict = w.evaluate()["verdicts"][0]
+        assert verdict["verdict"] == "missing"
+        assert verdict["legs"] == {"marker": True, "event": False,
+                                   "metric": None}
+
+    def test_event_dedupe_keeps_first_observation_stamp(self):
+        # The same Event re-polled later must not move its observed
+        # timestamp past the expectation's injection.
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="node-notready",
+                 event_reason=k8s_events.REASON_NODE_NOTREADY,
+                 injected_ts=10.0, window_s=5.0)
+        w.observe_marker("node-notready", 11.0, "NotReady", {})
+        w.observe_events([raw_event("e1",
+                                    k8s_events.REASON_NODE_NOTREADY)],
+                         now=11.0)
+        w.observe_events([raw_event("e1",
+                                    k8s_events.REASON_NODE_NOTREADY)],
+                         now=500.0)
+        assert w.evaluate()["verdicts"][0]["verdict"] == "matched"
+
+    def test_metric_leg_positive_delta(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="router-scaleout", metric="queue",
+                 metric_delta=2.0, injected_ts=10.0, window_s=5.0)
+        w.observe_marker("router-scaleout", 11.0, "queue depth", {})
+        series = {"queue": {"tier0": [[5.0, 1.0], [12.0, 4.0]]}}
+        assert w.evaluate(series=series)["verdicts"][0]["verdict"] \
+            == "matched"
+
+    def test_metric_leg_negative_delta(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="node-notready", metric="ready",
+                 metric_delta=-1.0, injected_ts=10.0, window_s=5.0)
+        w.observe_marker("node-notready", 11.0, "NotReady", {})
+        series = {"ready": {"tier0": [[5.0, 6.0], [12.0, 5.0]]}}
+        assert w.evaluate(series=series)["verdicts"][0]["verdict"] \
+            == "matched"
+
+    def test_metric_baseline_is_the_pre_injection_point(self):
+        # A point stamped exactly AT the injection instant reflects
+        # pre-state (the replay driver samples before acting, then
+        # advances the clock before the post-injection sample): it is
+        # the baseline, and the movement after it satisfies the leg.
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="autoscale-down", metric="fleet",
+                 metric_delta=-1.0, injected_ts=10.0, window_s=5.0)
+        w.observe_marker("autoscale-down", 10.0, "drain", {})
+        series = {"fleet": {"tier0": [[10.0, 7.0], [10.6, 6.0]]}}
+        assert w.evaluate(series=series)["verdicts"][0]["verdict"] \
+            == "matched"
+
+    def test_metric_leg_flat_series_is_missing(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="autoscale-up", metric="fleet",
+                 metric_delta=1.0, injected_ts=10.0, window_s=5.0)
+        w.observe_marker("autoscale-up", 11.0, "provision", {})
+        series = {"fleet": {"tier0": [[5.0, 6.0], [12.0, 6.0]]}}
+        verdict = w.evaluate(series=series)["verdicts"][0]
+        assert verdict["verdict"] == "missing"
+        assert verdict["legs"]["metric"] is False
+
+
+class TestSpuriousAndSchedule:
+    def test_unexplained_marker_of_witnessed_kind_is_spurious(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="config", injected_ts=10.0, window_s=5.0)
+        w.observe_marker("config", 11.0, "quota applied", {})
+        w.observe_marker("config", 300.0, "phantom", {})
+        report = w.evaluate()
+        assert not report["pass"]
+        assert report["counts"] == {"matched": 1, "late": 0,
+                                    "missing": 0, "spurious": 1}
+        assert report["spurious"][0]["detail"] == "phantom"
+
+    def test_unwitnessed_kinds_never_count_spurious(self):
+        # anomaly markers fire all day; only kinds the schedule
+        # witnesses can go spurious.
+        w, _ = make_witness()
+        w.arm()
+        w.expect("act", kind="config", injected_ts=10.0, window_s=5.0)
+        w.observe_marker("config", 11.0, "quota applied", {})
+        w.observe_marker("anomaly", 300.0, "stranded-hbm-high", {})
+        report = w.evaluate()
+        assert report["pass"]
+        assert report["counts"]["spurious"] == 0
+
+    def test_unknown_kind_fails_the_author(self):
+        w, _ = make_witness()
+        with pytest.raises(ValueError, match="unknown marker kind"):
+            w.expect("act", kind="no-such-kind", injected_ts=0.0)
+
+    def test_duplicate_id_fails_the_author(self):
+        w, _ = make_witness()
+        w.expect("act", kind="config", injected_ts=0.0)
+        with pytest.raises(ValueError, match="duplicate expectation"):
+            w.expect("act", kind="config", injected_ts=0.0)
+
+    def test_disarmed_witness_observes_nothing(self):
+        w, _ = make_witness()
+        w.expect("act", kind="config", injected_ts=10.0, window_s=5.0)
+        w.observe_marker("config", 11.0, "quota applied", {})
+        assert w.evaluate()["verdicts"][0]["verdict"] == "missing"
+
+    def test_counts_accumulate_across_evaluations(self):
+        w, _ = make_witness()
+        w.arm()
+        w.expect("a", kind="config", injected_ts=10.0, window_s=5.0)
+        w.observe_marker("config", 11.0, "quota", {})
+        w.evaluate()
+        w.evaluate()
+        assert w.counts()["matched"] == 2
+
+
+# ------------------------------------------------------------------------ #
+# Clock injection
+# ------------------------------------------------------------------------ #
+
+
+class TestClockInjection:
+    def test_set_clock_stamps_expectations_and_markers(self):
+        clock = [123.0]
+        obs.set_clock(lambda: clock[0])
+        w = obs.witness()
+        w.arm()
+        exp = w.expect("act", kind="config", window_s=5.0)
+        assert exp.injected_ts == 123.0
+        clock[0] = 125.0
+        obs.mark("config", "quota applied")
+        report = w.evaluate()
+        assert report["verdicts"][0]["verdict"] == "matched"
+        assert report["verdicts"][0]["markerTs"] == 125.0
+
+    def test_set_clock_none_restores_wall_time(self):
+        obs.set_clock(lambda: 1.0)
+        obs.set_clock(None)
+        exp = obs.witness().expect("act", kind="config")
+        assert exp.injected_ts > 1e9  # wall clock again
+
+    def test_mark_tee_only_while_armed(self):
+        obs.set_clock(lambda: 10.0)
+        w = obs.witness()
+        w.expect("act", kind="config", window_s=5.0)
+        obs.mark("config", "before arming")
+        w.arm()
+        assert w.evaluate()["verdicts"][0]["verdict"] == "missing"
+
+
+# ------------------------------------------------------------------------ #
+# The composed day through the real stack
+# ------------------------------------------------------------------------ #
+
+
+def tiny_day(hours: int = 8, hour_s: float = 4.0) -> dict:
+    from tools import simulate as sim
+    scenario = yaml.safe_load(sim.EXAMPLE_FLEET_DAY)
+    scenario["fleet_day"]["hours"] = hours
+    scenario["fleet_day"]["hour_s"] = hour_s
+    return scenario
+
+
+class TestFleetDayReplay:
+    def test_composed_day_passes_the_witness(self):
+        from tools import simulate as sim
+        report = sim.simulate(tiny_day(), seed=1234)
+        day = report["fleet_day"]
+        witness = day["witness"]
+        assert witness["pass"], witness
+        assert witness["counts"] == {"matched": 6, "late": 0,
+                                     "missing": 0, "spurious": 0}
+        assert witness["conformancePct"] == 100.0
+        # every staked act is the composed repertoire, one subsystem
+        # each
+        assert [v["kind"] for v in witness["verdicts"]] == [
+            "config", "router-scaleout", "node-notready",
+            "defrag-plan", "autoscale-up", "autoscale-down"]
+        # the day's elasticity story: the wave bought a node and the
+        # trough gave back exactly that node
+        fleet = day["fleetByHour"]
+        assert max(fleet) == 7 and fleet[0] == 6 and fleet[-1] == 6
+        assert day["scalars"]["guarantee_evictions"] == 0
+        assert day["scalars"]["node_hours_ratio"] <= 1.0
+
+    def test_same_seed_reproduces_bit_for_bit(self):
+        from tools import simulate as sim
+        a = sim.simulate(tiny_day(), seed=555)["fleet_day"]
+        b = sim.simulate(tiny_day(), seed=555)["fleet_day"]
+        assert json.dumps(a, sort_keys=True) == \
+            json.dumps(b, sort_keys=True)
+
+    def test_seeded_fault_reports_exactly_the_suppressed_legs(self):
+        """The witness's reason to exist: drop ONE marker and ONE
+        Event on the emission path; the verdict table must name
+        exactly those legs as missing — every other act still
+        matches, and nothing goes spurious."""
+        from tools import simulate as sim
+
+        real_mark = obs.mark
+        real_record = k8s_events.record
+
+        def dropping_mark(kind, detail, **attrs):
+            if kind == "node-notready":
+                return -1  # the telemetry fault under test
+            return real_mark(kind, detail, **attrs)
+
+        def dropping_record(client, pod, reason, message, **kwargs):
+            if reason == k8s_events.REASON_NODE_NOTREADY:
+                return  # the Event pipeline fault under test
+            real_record(client, pod, reason, message, **kwargs)
+
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr(obs, "mark", dropping_mark)
+            mp.setattr(k8s_events, "record", dropping_record)
+            report = sim.simulate(tiny_day(), seed=1234)
+
+        witness = report["fleet_day"]["witness"]
+        assert not witness["pass"]
+        assert witness["counts"] == {"matched": 5, "late": 0,
+                                     "missing": 1, "spurious": 0}
+        (broken,) = [v for v in witness["verdicts"]
+                     if v["verdict"] == "missing"]
+        assert broken["id"] == "host-notready"
+        # exactly the two suppressed legs read MISS; the metric leg
+        # (fleet_nodes_ready) still saw the real host failure
+        assert broken["legs"] == {"marker": False, "event": False,
+                                  "metric": True}
+
+    def test_scrape_counters_follow_the_verdicts(self):
+        from tools import simulate as sim
+        from tpushare.routes import metrics
+
+        sim.simulate(tiny_day(), seed=1234)
+        metrics.observe_timeline()  # the scrape path sets the gauges
+        text = metrics.render().decode()
+        assert "tpushare_witness_events_matched_total 6.0" in text
+        assert "tpushare_witness_events_missing_total 0.0" in text
+        assert "tpushare_witness_events_late_total 0.0" in text
+        assert "tpushare_witness_events_spurious_total 0.0" in text

@@ -735,6 +735,76 @@ def render_blackbox(doc: dict) -> str:
     return "\n".join(lines)
 
 
+def fetch_fleetday(endpoint: str) -> dict | None:
+    """The fleet-day witness snapshot from ``/debug/fleetday``; None
+    when debug routes are disabled."""
+    try:
+        with urllib.request.urlopen(f"{endpoint}/debug/fleetday",
+                                    timeout=10) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return None
+        raise
+
+
+def render_fleetday(doc: dict) -> str:
+    """The witness's posture plus its last conformance verdict: one
+    row per injected act with the marker/event/metric legs."""
+    counts = doc.get("counts") or {}
+    lines = [
+        f"fleet-day witness: {'armed' if doc.get('armed') else 'disarmed'}, "
+        f"{len(doc.get('expectations') or [])} staked expectations, "
+        f"{doc.get('observedMarkers', 0)} markers / "
+        f"{doc.get('observedEvents', 0)} Events observed",
+        "totals: " + ", ".join(
+            f"{k} {counts.get(k, 0)}"
+            for k in ("matched", "late", "missing", "spurious")),
+    ]
+    report = doc.get("report")
+    if not report:
+        lines.append("")
+        lines.append("no verdict yet — the report lands when a "
+                     "fleet-day replay calls evaluate() "
+                     "(python tools/simulate.py --example-fleet-day, "
+                     "or python bench.py --fleet-day)")
+        return "\n".join(lines)
+    verdict = "PASS" if report.get("pass") else "FAIL"
+    lines.append("")
+    lines.append(f"last replay: {verdict} — "
+                 f"{report.get('conformancePct', 0)}% conformance "
+                 f"({report.get('expectations', 0)} acts)")
+    rows = []
+    for v in report.get("verdicts") or []:
+        legs = v.get("legs") or {}
+        leg_txt = " ".join(
+            f"{name}={'ok' if ok else 'MISS'}"
+            for name, ok in legs.items() if ok is not None)
+        lag = v.get("markerLagS")
+        rows.append([str(v.get("id", "?")), str(v.get("kind", "?")),
+                     f"t={v.get('injectedTs', '?')}",
+                     str(v.get("verdict", "?")),
+                     f"lag {lag}s" if lag is not None else "-",
+                     leg_txt])
+    if rows:
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+        lines += ["  " + "  ".join(c.ljust(w)
+                                   for c, w in zip(r, widths)).rstrip()
+                  for r in rows]
+    for s in report.get("spurious") or []:
+        lines.append(f"  SPURIOUS {s.get('kind', '?')} at "
+                     f"t={s.get('ts', '?')}: {s.get('detail', '')}")
+    if doc.get("drops"):
+        lines.append("")
+        lines.append(f"drops: {doc['drops']} (observation intake)")
+    lines.append("")
+    lines.append("A missing verdict names the broken leg "
+                 "(marker/event/metric); triage rows: "
+                 "docs/observability.md §8. Full data: "
+                 "GET /debug/fleetday.")
+    return "\n".join(lines)
+
+
 def fetch_defrag(endpoint: str) -> dict | None:
     """The fragmentation/rebalance snapshot from ``/debug/defrag``;
     None when the extender runs without the defrag executor wired or
@@ -1179,7 +1249,10 @@ def main(argv: list[str] | None = None) -> int:
                              "for the retrospective fleet history "
                              "(series sparklines + event markers); or "
                              "the literal 'blackbox' for the durable "
-                             "flight-journal and push-export posture")
+                             "flight-journal and push-export posture; or "
+                             "the literal 'fleetday' for the fleet-day "
+                             "witness's expectation schedule and last "
+                             "conformance verdict")
     parser.add_argument("pod", nargs="?", metavar="[ns/]pod",
                         help="with 'explain': the pod whose placement "
                              "decision to explain (namespace defaults "
@@ -1260,6 +1333,23 @@ def main(argv: list[str] | None = None) -> int:
                   "disabled (DEBUG_ROUTES=0)", file=sys.stderr)
             return 1
         print(render_blackbox(doc))
+        return 0
+    if args.node == "fleetday":
+        if args.pod:
+            print(f"unexpected argument {args.pod!r} after 'fleetday'",
+                  file=sys.stderr)
+            return 2
+        try:
+            doc = fetch_fleetday(args.endpoint)
+        except (urllib.error.URLError, OSError) as e:
+            print(f"cannot reach tpushare extender at {args.endpoint}: {e}",
+                  file=sys.stderr)
+            return 1
+        if doc is None:
+            print("fleet-day view unavailable — debug routes are "
+                  "disabled (DEBUG_ROUTES=0)", file=sys.stderr)
+            return 1
+        print(render_fleetday(doc))
         return 0
     if args.node == "topology":
         if args.pod:

@@ -78,6 +78,19 @@ Scenario schema (YAML or JSON)::
           over: 8
           prompt_len: 100        # bucketed like the slot server
           max_new: 200
+    fleet_day:                   # after the replay, play one seeded,
+      hours: 24                  # clock-compressed day through the
+      hour_s: 6                  # same stack: diurnal router traffic
+      seed: 1234                 # with tenant churn plus one injected
+                                 # act per chapter (quota ConfigMap
+                                 # apply, request surge, NotReady
+                                 # host, active defrag wave, autoscale
+                                 # up/down) — each graded by the
+                                 # fleet-day witness (marker + Event +
+                                 # metric legs, docs/observability.md
+                                 # §8); --seed overrides `seed`, and
+                                 # the same seed reproduces identical
+                                 # witness verdicts and scalars
     workload:                    # ordered arrival stream
       - count: 8                 # pods in this group      (default 1)
         name: trainer            # names name-0..          (required)
@@ -110,6 +123,8 @@ from __future__ import annotations
 import argparse
 import http.client
 import json
+import math
+import random
 import statistics
 import sys
 import time
@@ -270,6 +285,92 @@ workload:
 """
 
 
+#: Marker kinds the fleet-day schedule stakes expectations on — one
+#: per injected act, in day order. tests/test_docs.py cross-checks
+#: this tuple against tpushare.obs.timeline.MARKER_KINDS by AST, so a
+#: renamed kind fails the build, not the witness at replay time.
+FLEET_DAY_EXPECTED_KINDS = (
+    "config",           # mid-day quota ConfigMap apply
+    "router-scaleout",  # request-surge queue signal
+    "node-notready",    # host failure
+    "defrag-plan",      # consolidation wave
+    "autoscale-up",     # evening capacity wave
+    "autoscale-down",   # overnight trough drain
+)
+
+EXAMPLE_FLEET_DAY = """\
+# tpushare fleet-day scenario: one compressed 24-hour trace through
+# the REAL stack, with the fleet-day witness grading every injected
+# act (quota apply, surge, NotReady host, defrag wave, autoscale
+# up/down) against the telemetry it must produce. Same seed -> same
+# witness verdicts and scalars, bit for bit:
+#   python tools/simulate.py fleet_day.yaml --seed 1234
+fleet:
+  - count: 4                 # the sharing pool the day fragments
+    prefix: frag
+    chips: 4
+    hbm_per_chip: 16
+  - count: 2                 # serve-class hosts (bigger chips) the
+    prefix: serve            # decode replicas and the evening wave
+    chips: 2                 # need; tainted so batch stays off them
+    hbm_per_chip: 24
+    taints:
+      - {key: pool, value: serve, effect: NoSchedule}
+quotas:
+  # Guarantees make bound pods immovable to defrag and drains — the
+  # day's zero-guarantee-eviction gate rides on these entries.
+  team-serve: {guaranteeHBM: 32, limitHBM: 48}
+  team-anchor: {guaranteeHBM: 16, limitHBM: 24}
+  team-train: {limitHBM: 128, limitChips: 8}
+  team-batch: {limitHBM: 96}
+  team-wave: {limitHBM: 64}
+  chat-a: {guaranteeHBM: 16, limitHBM: 48}
+  chat-b: {guaranteeHBM: 16, limitHBM: 48}
+  chat-c: {guaranteeHBM: 16, limitHBM: 48}
+  flood: {guaranteeHBM: 4, limitHBM: 8}
+workload:
+  # Guaranteed anchors that must survive the whole day untouched.
+  # Spread scoring pins one immovable pod per serve CHIP, so no
+  # serve chip ever has 20 GiB free and the evening wave is forced
+  # onto a provisioned host.
+  - {name: anchor-a, namespace: team-anchor, hbm: 8, node: serve-00,
+     annotations: {tpushare.io/scoring: spread},
+     tolerations: [{key: pool, operator: Exists}]}
+  - {name: anchor-b, namespace: team-anchor, hbm: 8, node: serve-01,
+     annotations: {tpushare.io/scoring: spread},
+     tolerations: [{key: pool, operator: Exists}]}
+  # Two decode replicas the router fronts, one per serve host.
+  - {name: decode-a, namespace: team-serve, hbm: 8, node: serve-00,
+     annotations: {tpushare.io/scoring: spread},
+     tolerations: [{key: pool, operator: Exists}]}
+  - {name: decode-b, namespace: team-serve, hbm: 8, node: serve-01,
+     annotations: {tpushare.io/scoring: spread},
+     tolerations: [{key: pool, operator: Exists}]}
+  # Spread shards fragment the sharing pool two-per-host...
+  - count: 8
+    name: shard
+    namespace: team-batch
+    hbm: 6
+    annotations: {tpushare.io/scoring: spread}
+  # ...so the 4-chip ring cannot bind until the defrag wave frees a
+  # host mid-day.
+  - {name: ring, namespace: team-train, chips: 4}
+fleet_day:
+  hours: 24                  # scenario hours in the day
+  hour_s: 6                  # compressed seconds per scenario hour
+  seed: 1234                 # the day's RNG seed (--seed overrides)
+  peak_requests_per_hour: 6  # diurnal half-sine peak, per tenant
+  surge_requests: 8          # per steady tenant at the surge hour
+  surge_flood_requests: 12   # the flooder's burst on top
+  wave:                      # evening training wave: a shape only a
+    count: 2                 # new serve-class host can take (20 GiB
+    hbm: 20                  # on one chip beats every 16 GiB sharing
+    namespace: team-wave     # chip, and the serve chips hold
+    tolerations:             # guaranteed pods defrag cannot move ->
+      - {key: pool, operator: Exists}  # the scale-up is forced)
+"""
+
+
 def load_scenario(path: str) -> dict:
     with open(path) as f:
         text = f.read()
@@ -368,8 +469,10 @@ class _Client:
         self.conn.close()
 
 
-def simulate(scenario: dict) -> dict:
-    """Replay ``scenario`` and return the report document."""
+def simulate(scenario: dict, seed: int | None = None) -> dict:
+    """Replay ``scenario`` and return the report document. ``seed``
+    overrides the scenario's ``fleet_day.seed`` (ignored otherwise)."""
+    from tpushare import obs as _obs
     from tpushare.api.objects import Node
     from tpushare.cmd.main import serve_stack, shutdown_stack
     from tpushare.k8s.errors import NotFoundError
@@ -378,6 +481,15 @@ def simulate(scenario: dict) -> dict:
     node_docs = _expand_fleet(scenario)
     if not node_docs:
         return {"error": "scenario has no fleet"}
+    fleet_day_cfg = scenario.get("fleet_day")
+    day_clock = {"now": 0.0}
+    if fleet_day_cfg:
+        # The whole day plays on one compressed scenario clock: reset
+        # the obs singletons (a previous run's markers must not leak
+        # into the witness join) and swap their clock in BEFORE the
+        # stack boots, so even boot-time markers stamp scenario time.
+        _obs.reset()
+        _obs.set_clock(lambda: day_clock["now"])
     # Journeys/SLO windows are process singletons (like the flight
     # recorder); a replay must report ITS pods' journeys, not a
     # previous run's.
@@ -399,6 +511,11 @@ def simulate(scenario: dict) -> dict:
         # the controller's informer seeds the quota table from it.
         api.create_configmap(quota_cm)
     stack, server = serve_stack(api)
+    if fleet_day_cfg:
+        # Manual sampling only: the background sampler ticks on WALL
+        # cadence and would interleave nondeterministic points (and
+        # anomaly evaluations) into the seeded scenario-clock replay.
+        _obs.timeline().stop()
     client = _Client(*server.server_address[:2])
 
     placements: list[dict] = []
@@ -476,6 +593,19 @@ def simulate(scenario: dict) -> dict:
                                            "namespace", "default"),
                                        "node": final.node_name,
                                        "via": "gang commit"})
+        # Fleet-day round (scenario `fleet_day:`): replay one seeded,
+        # clock-compressed day on top of the baseline packing —
+        # diurnal router traffic with tenant churn plus one injected
+        # act per chapter (quota apply, surge, NotReady host, defrag
+        # wave, autoscale up/down), every act graded by the fleet-day
+        # witness (docs/observability.md §8).
+        fleet_day_report = None
+        if fleet_day_cfg:
+            day_seed = int(seed if seed is not None
+                           else fleet_day_cfg.get("seed", 0))
+            fleet_day_report = _run_fleet_day(
+                api, client, stack, scenario, day_clock, unschedulable,
+                held, placements, random.Random(day_seed), day_seed)
         # Defragmentation round (scenario `defrag: dry-run|active`):
         # run the extender's REAL rebalancer over whatever is still
         # unschedulable — the offline dry-run of the fragment → plan →
@@ -528,9 +658,14 @@ def simulate(scenario: dict) -> dict:
             profiling.stop()
         client.close()
         shutdown_stack(stack, server)
+        if fleet_day_cfg:
+            # Hand the wall clock back to the obs singletons — the
+            # next replay (or test) must not inherit a frozen day.
+            _obs.set_clock(None)
     report = _report(inspect_doc, placements, held, unschedulable,
                      latencies, executed_preemptions, tenants, slo_doc,
-                     defrag_report, serving_report, autoscale_report)
+                     defrag_report, serving_report, autoscale_report,
+                     fleet_day_report)
     if hotspots_doc is not None:
         report["hotspots"] = hotspots_doc
     if timeline_doc is not None:
@@ -828,6 +963,504 @@ def _run_serving(api, client: _Client, stack, scenario, all_nodes,
     }
 
 
+def _run_fleet_day(api, client: _Client, stack, scenario, clock,
+                   unschedulable, held, placements, rng, seed) -> dict:
+    """One seeded, clock-compressed day through the REAL stack, graded
+    by the fleet-day witness (``tpushare/obs/witness.py``).
+
+    The baseline replay has already packed the fleet; this round plays
+    the day on top of it: diurnal open-loop router traffic with seeded
+    tenant churn, and one injection per chapter — a quota ConfigMap
+    apply, a request surge (queue signal -> one scale-out bind through
+    the real verbs), a NotReady host (and its recovery), an active
+    defrag wave, and an autoscale up/down round-trip. Each injection
+    STAKES a witness expectation first (marker kind, optional Event
+    reason and metric delta, a conformance window), then acts; the
+    end-of-day ``evaluate()`` joins schedule against observation into
+    per-event verdicts plus the day's scalars (pod-SLO compliance,
+    Jain fairness over the steady tenants — queued requests count as
+    served because the bounded drain retires them — node-hours vs
+    peak-static, guaranteed-pod evictions).
+
+    Every timestamp rides the scenario clock (``clock["now"]``), which
+    only this driver advances; wall-clock waits for the watch/Event
+    threads (``_await``) do not move it, so whatever fires during a
+    wait stamps a deterministic time. Same seed -> same verdicts and
+    scalars, bit for bit (docs/observability.md §8)."""
+    from tpushare import obs as _obs
+    from tpushare.api.objects import ConfigMap
+    from tpushare.k8s import events as _events
+    from tpushare.k8s.builders import make_pod
+    from tpushare.k8s.errors import NotFoundError
+    from tpushare.obs import sources as _sources
+    from tpushare.router import DecodeReplica, Router
+    from tpushare.utils import const as _c
+    from tpushare.utils import node as nodeutils
+
+    cfg = scenario["fleet_day"]
+    hours = int(cfg.get("hours", 24))
+    hour_s = float(cfg.get("hour_s", 6.0))
+    window_s = float(cfg.get("window_s", hour_s))
+    steps = max(int(cfg.get("steps_per_hour", 10)), 1)
+    tick_s = hour_s / steps
+    quotas = scenario.get("quotas") or {}
+    witness = _obs.witness()
+
+    def now() -> float:
+        return clock["now"]
+
+    def sample() -> None:
+        _obs.timeline().tick()
+
+    def settle() -> None:
+        """Advance the scenario clock one integration step, then
+        sample: an injection acts with the clock frozen, so without
+        the step its post-injection point would share a timestamp
+        with the pre-injection baseline and the witness's metric-leg
+        baseline would read the POST value."""
+        clock["now"] += tick_s
+        sample()
+
+    def _await(pred, timeout: float = 5.0) -> bool:
+        """Bounded WALL-clock wait for the async watch/Event paths;
+        the scenario clock is frozen meanwhile, so whatever fires
+        during the wait stamps a deterministic timestamp."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.01)
+        return bool(pred())
+
+    def _await_marker(kind: str, since: float) -> bool:
+        def seen() -> bool:
+            doc = _obs.timeline().snapshot()
+            return any(m["kind"] == kind and m["ts"] >= since
+                       for m in doc.get("markers", []))
+        return _await(seen)
+
+    def _poll_events() -> None:
+        _events.flush(timeout=2.0)
+        witness.observe_events(list(api.events), now=now())
+
+    def _guaranteed_ns(ns: str) -> bool:
+        spec = quotas.get(ns) or {}
+        return (float(spec.get("guaranteeHBM", 0) or 0) > 0
+                or float(spec.get("guaranteeChips", 0) or 0) > 0)
+
+    def _retry_unschedulable(via: str) -> None:
+        """Re-run the pending bucket against the re-listed fleet (it
+        just changed) — the defrag/autoscale rounds' recovery idiom."""
+        for verdict in unschedulable[:]:
+            try:
+                pod = api.get_pod(verdict.get("namespace", "default"),
+                                  verdict["pod"])
+            except NotFoundError:
+                continue
+            candidates = [n.name for n in api.list_nodes()
+                          if nodeutils.is_schedulable(n, pod)]
+            retry = _schedule_one(client, pod, candidates)
+            if retry.pop("state") == "bound":
+                unschedulable.remove(verdict)
+                retry["pod"] = pod.name
+                retry["namespace"] = pod.namespace
+                retry["via"] = via
+                placements.append(retry)
+
+    guarantee_evictions: list[str] = []
+    provisioned_pods: list[str] = []
+    provisioned_nodes: list[str] = []
+    wave_pods: list[tuple[str, str]] = []
+    failed_node: dict = {"name": None}
+
+    # -- the router front (decode pods bound by the baseline replay) -- #
+    serving = cfg.get("serving") or {}
+    prefix = str(serving.get("pods", "decode"))
+    slots = int(serving.get("slots_per_replica", 4))
+    model = {
+        "decode_tok_s": float(serving.get("decode_tok_s", 4000.0)),
+        "prefill_tok_s": float(serving.get("prefill_tok_s", 200_000.0)),
+        "admission_overhead": float(
+            serving.get("admission_overhead", 0.10)),
+    }
+    fronted = [p for p in placements if p["pod"].startswith(prefix)]
+    if not fronted:
+        return {"error": f"fleet_day: no bound pod named {prefix}* "
+                         "to front with the router"}
+    serve_ns = fronted[0].get("namespace", "default")
+    # Cooldown zero: the signal is evaluated every tick, but the
+    # MARKER only fires while `on_scaleout` is armed — and the surge
+    # injection arms it for exactly one shot, so an incidental queue
+    # blip in another hour cannot page (= go spurious in the witness).
+    router = Router(quota=stack.controller.quota, clock=now,
+                    scaleout_queue_factor=float(
+                        serving.get("scaleout_queue_factor", 0.3)),
+                    scaleout_cooldown_s=0.0)
+    for p in fronted:
+        pod = api.get_pod(p.get("namespace", "default"), p["pod"])
+        ann = pod.raw["metadata"].get("annotations") or {}
+        router.add_replica(DecodeReplica(
+            p["pod"], slots=slots, node=p.get("node", ""),
+            hbm_gib=float(ann.get(_c.ANN_HBM_POD, 0) or 0), **model))
+    _obs.timeline().add_source("router", _sources.router_source(router))
+
+    # Arm AFTER the baseline replay: the seeded quota ConfigMap fired
+    # a boot-time "config" marker the schedule does not witness.
+    witness.arm()
+    sample()
+
+    tenants = [str(t) for t in cfg.get("tenants",
+                                       ("chat-a", "chat-b", "chat-c"))]
+    prompt_len = int(cfg.get("prompt_len", 128))
+    max_new = int(cfg.get("max_new", 64))
+    peak_rph = int(cfg.get("peak_requests_per_hour", 6))
+    outcomes: dict[str, dict[str, int]] = {}
+
+    def _submit(tenant: str) -> None:
+        dec = router.submit(tenant, prompt_len, max_new, now=now())
+        row = outcomes.setdefault(
+            tenant, {"assigned": 0, "queued": 0, "shed": 0})
+        row[dec["outcome"]] += 1
+
+    # -- the injected acts --------------------------------------------- #
+
+    def _inject_quota() -> dict:
+        t = now()
+        witness.expect("quota-apply", kind="config",
+                       detail_substr="quota", window_s=window_s,
+                       injected_ts=t)
+        tighten = str(cfg.get("quota_tighten_tenant", "flood"))
+        spec = dict(quotas.get(tighten) or {})
+        spec["limitHBM"] = max(int(spec.get("limitHBM", 8) or 8) // 2, 1)
+        doc = _quota_configmap(scenario)
+        doc["data"][tighten] = json.dumps(spec)
+        api.update_configmap(ConfigMap(doc))
+        observed = _await_marker("config", t)
+        settle()
+        return {"event": "quota-apply", "ts": t, "tenant": tighten,
+                "observed": observed}
+
+    def _inject_surge() -> dict:
+        t = now()
+        witness.expect("request-surge", kind="router-scaleout",
+                       detail_substr="queue depth",
+                       metric="router_queue_depth", metric_delta=2.0,
+                       window_s=window_s, injected_ts=t)
+
+        def _provision(spec: dict) -> None:
+            # One scale-out bind through the real verbs, then disarm:
+            # the day witnesses exactly one router-scaleout page.
+            router.on_scaleout = None
+            name = f"{prefix}-scale-{len(provisioned_pods)}"
+            pod = api.create_pod(make_pod(
+                name, hbm=int(spec.get("hbmGiB", 8)) or 8,
+                namespace=serve_ns))
+            candidates = [n.name for n in api.list_nodes()
+                          if nodeutils.is_schedulable(n, pod)]
+            verdict = _schedule_one(client, pod, candidates)
+            if verdict.get("state") != "bound":
+                unschedulable.append({"pod": name,
+                                      "namespace": serve_ns,
+                                      "reason": verdict.get("reason")})
+                return
+            provisioned_pods.append(name)
+            placements.append({"pod": name, "namespace": serve_ns,
+                               "node": verdict.get("node"),
+                               "via": "router scale-out"})
+            router.add_replica(DecodeReplica(
+                name, slots=slots, node=verdict.get("node") or "",
+                hbm_gib=float(spec.get("hbmGiB", 8) or 8), **model))
+
+        router.on_scaleout = _provision
+        for tenant in tenants:
+            for _ in range(int(cfg.get("surge_requests", 8))):
+                _submit(tenant)
+        for _ in range(int(cfg.get("surge_flood_requests", 12))):
+            _submit("flood")
+        router.tick(now())
+        settle()
+        return {"event": "request-surge", "ts": t,
+                "scaledOut": list(provisioned_pods)}
+
+    def _inject_notready() -> dict:
+        untainted = sorted(
+            n.name for n in api.list_nodes()
+            if not (n.raw.get("spec") or {}).get("taints")
+            and not n.unschedulable)
+        name = str(cfg.get("fail_node", "") or rng.choice(untainted))
+        failed_node["name"] = name
+        t = now()
+        witness.expect("host-notready", kind="node-notready",
+                       detail_substr=name,
+                       event_reason=_events.REASON_NODE_NOTREADY,
+                       metric="fleet_nodes_ready", metric_delta=-1.0,
+                       window_s=window_s, injected_ts=t)
+        node = api.get_node(name)
+        node.raw.setdefault("status", {})["conditions"] = [
+            {"type": "Ready", "status": "False",
+             "reason": "KubeletStopped"}]
+        api.update_node(node)
+        observed = _await_marker("node-notready", t)
+        _poll_events()
+        settle()
+        return {"event": "node-notready", "ts": t, "node": name,
+                "observed": observed}
+
+    def _inject_recover() -> dict:
+        name = failed_node["name"]
+        if not name:
+            return {"event": "node-recovered", "skipped": True}
+        node = api.get_node(name)
+        node.raw.setdefault("status", {})["conditions"] = [
+            {"type": "Ready", "status": "True"}]
+        api.update_node(node)
+        # Only the True->False edge marks; recovery just restores the
+        # informer's view (and the fleet_nodes_ready series).
+        _await(lambda: (lambda n: n is not None and n.ready)(
+            stack.controller.hub.nodes.get(name)))
+        settle()
+        return {"event": "node-recovered", "ts": now(), "node": name}
+
+    def _inject_defrag() -> dict:
+        t = now()
+        witness.expect("defrag-wave", kind="defrag-plan",
+                       event_reason=_events.REASON_DEFRAG_MOVE,
+                       window_s=window_s, injected_ts=t)
+        report = _run_defrag(api, client, stack, "active",
+                             unschedulable, placements,
+                             api.list_nodes())
+        moves = (report.get("plan") or {}).get("moves", [])
+        for move in moves:
+            if move.get("status") != "evicted":
+                continue
+            ns = str(move.get("pod", "")).split("/", 1)[0]
+            if _guaranteed_ns(ns):
+                guarantee_evictions.append(str(move["pod"]))
+        _poll_events()
+        settle()
+        return {"event": "defrag-wave", "ts": t, "moves": len(moves),
+                "recovered": report.get("recovered", [])}
+
+    def _inject_scale_up() -> dict:
+        wave = cfg.get("wave") or {}
+        count = int(wave.get("count", 2))
+        ns = str(wave.get("namespace", "team-wave"))
+        for i in range(count):
+            doc = make_pod(f"wave-{i}", hbm=int(wave.get("hbm", 20)),
+                           chips=int(wave.get("chips", 0)),
+                           namespace=ns)
+            doc["spec"]["tolerations"] = list(
+                wave.get("tolerations")
+                or [{"key": "pool", "operator": "Exists"}])
+            pod = api.create_pod(doc)
+            wave_pods.append((ns, pod.name))
+            candidates = [n.name for n in api.list_nodes()
+                          if nodeutils.is_schedulable(n, pod)]
+            verdict = _schedule_one(client, pod, candidates)
+            verdict["pod"] = pod.name
+            verdict["namespace"] = ns
+            if verdict.pop("state") == "bound":
+                verdict["via"] = "fleet-day wave"
+                placements.append(verdict)
+            else:
+                unschedulable.append(verdict)
+        t = now()
+        witness.expect("evening-scale-up", kind="autoscale-up",
+                       metric="fleet_nodes", metric_delta=1.0,
+                       window_s=window_s, injected_ts=t)
+        ex = stack.controller.autoscale
+        ex.mode = "active"
+        ex.up_delay_s = ex.down_delay_s = ex.cooldown_s = 0.0
+        ex._now = now
+        for _ in range(count + 2):
+            if not any(str(v.get("pod", "")).startswith("wave-")
+                       for v in unschedulable):
+                break
+            decision = ex.tick()
+            if (decision is None
+                    or decision.get("action") != "scale-up"
+                    or decision.get("error")):
+                break
+            stack.controller.wait_idle(timeout=10)
+            node_name = decision["node"]
+            provisioned_nodes.append(node_name)
+            _await(lambda: stack.controller.hub.nodes.get(node_name)
+                   is not None)
+            _retry_unschedulable("autoscale")
+        settle()
+        return {"event": "autoscale-up", "ts": t,
+                "nodes": list(provisioned_nodes)}
+
+    def _inject_scale_down() -> dict:
+        t = now()
+        witness.expect("overnight-scale-down", kind="autoscale-down",
+                       metric="fleet_nodes", metric_delta=-1.0,
+                       window_s=window_s, injected_ts=t)
+        # The wave retires (its owner is done); the trough is real.
+        for pns, pname in wave_pods:
+            try:
+                api.delete_pod(pns, pname)
+            except NotFoundError:
+                pass
+        stack.controller.wait_idle(timeout=10)
+        ex = stack.controller.autoscale
+        ex._now = now
+        originals = {f"{p.namespace}/{p.name}": p
+                     for p in api.list_pods()}
+        drained: list[str] = []
+        for _ in range(8):
+            decision = ex.tick()
+            if decision is None:
+                break
+            if (decision.get("action") != "scale-down"
+                    or decision.get("error")):
+                break
+            stack.controller.wait_idle(timeout=10)
+            # Play the Job controller for every drain eviction, the
+            # autoscale round's idiom — and count any guaranteed
+            # victim against the day's zero-eviction gate.
+            for ev in decision.get("evictions") or []:
+                if ev.get("status") != "evicted":
+                    continue
+                pns = str(ev["pod"]).split("/", 1)[0]
+                if _guaranteed_ns(pns):
+                    guarantee_evictions.append(str(ev["pod"]))
+                original = originals.get(ev["pod"])
+                if original is None:
+                    continue
+                raw = original.deepcopy().raw
+                meta = raw.setdefault("metadata", {})
+                for key in ("uid", "resourceVersion"):
+                    meta.pop(key, None)
+                ann = meta.get("annotations") or {}
+                for key in _c.GRANT_ANNOTATIONS:
+                    ann.pop(key, None)
+                raw.setdefault("spec", {}).pop("nodeName", None)
+                raw["status"] = {"phase": "Pending"}
+                pod = api.create_pod(raw)
+                candidates = [n.name for n in api.list_nodes()
+                              if nodeutils.is_schedulable(n, pod)]
+                verdict = _schedule_one(client, pod, candidates)
+                verdict["pod"] = pod.name
+                verdict["namespace"] = pod.namespace
+                if verdict.pop("state") == "bound":
+                    verdict["via"] = "autoscale drain"
+                    placements.append(verdict)
+            if decision.get("phase") == "delete":
+                drained.append(decision["node"])
+                stack.controller.wait_idle(timeout=10)
+                if set(provisioned_nodes) <= set(drained):
+                    break  # the wave capacity is gone; stop shrinking
+        settle()
+        return {"event": "autoscale-down", "ts": t, "drained": drained}
+
+    # -- the day ------------------------------------------------------- #
+
+    schedule: dict[int, list] = {}
+
+    def _at(key: str, default: float, fn) -> None:
+        h = int(float(cfg.get(key, default)) * hours)
+        schedule.setdefault(min(max(h, 0), hours - 1), []).append(fn)
+
+    _at("quota_at", 0.25, _inject_quota)
+    _at("surge_at", 0.40, _inject_surge)
+    _at("notready_at", 0.50, _inject_notready)
+    _at("recover_at", 0.55, _inject_recover)
+    _at("defrag_at", 0.65, _inject_defrag)
+    _at("scale_up_at", 0.80, _inject_scale_up)
+    _at("scale_down_at", 0.90, _inject_scale_down)
+
+    fleet_by_hour: list[int] = []
+    injections: list[dict] = []
+    for h in range(hours):
+        clock["now"] = max(clock["now"], h * hour_s)
+        for fn in schedule.get(h, []):
+            record = fn()
+            if record:
+                injections.append({"hour": h, **record})
+        # Diurnal open-loop traffic with seeded tenant churn: the
+        # half-sine profile peaks mid-day; which tenants are awake
+        # each hour (and when their requests land) is the rng's call.
+        load = math.sin(math.pi * (h + 0.5) / hours)
+        arrivals: list[tuple[float, str]] = []
+        for tenant in tenants:
+            if rng.random() >= 0.3 + 0.7 * load:
+                continue
+            for _ in range(max(1, round(peak_rph * load))):
+                arrivals.append((h * hour_s + rng.random() * hour_s,
+                                 tenant))
+        arrivals.sort()
+        nxt = 0
+        for s in range(steps):
+            step_end = h * hour_s + (s + 1) * tick_s
+            while nxt < len(arrivals) and arrivals[nxt][0] <= step_end:
+                clock["now"] = max(clock["now"], arrivals[nxt][0])
+                _submit(arrivals[nxt][1])
+                nxt += 1
+            clock["now"] = max(clock["now"], step_end)
+            router.tick(clock["now"])
+        sample()
+        fleet_by_hour.append(len(api.list_nodes()))
+
+    # Bounded drain, the serving round's idiom: every queued request
+    # retires (which is why Jain fairness counts queued as served).
+    deadline = clock["now"] + 600.0
+    while clock["now"] < deadline:
+        router.tick(clock["now"])
+        snap = router.snapshot()
+        if snap["queuedTotal"] == 0 and snap["slotsInUse"] == 0:
+            break
+        clock["now"] += max(tick_s, 0.5)
+    stack.controller.wait_idle(timeout=10)
+
+    # -- the verdict join ---------------------------------------------- #
+    _poll_events()
+    series = _obs.timeline().snapshot(markers=False).get("series") or {}
+    witness_report = witness.evaluate(series=series)
+    witness.disarm()
+
+    demanded = len(placements) + len(held) + len(unschedulable)
+    compliance = (100.0 * len(placements) / demanded
+                  if demanded else 100.0)
+    xs = []
+    for tenant in tenants:
+        row = outcomes.get(tenant)
+        if not row:
+            continue
+        total = row["assigned"] + row["queued"] + row["shed"]
+        if total:
+            xs.append((row["assigned"] + row["queued"]) / total)
+    sq = sum(x * x for x in xs)
+    fairness = round(sum(xs) ** 2 / (len(xs) * sq), 4) if sq else None
+    node_hours = float(sum(fleet_by_hour))
+    peak_static = (float(max(fleet_by_hour) * hours)
+                   if fleet_by_hour else 0.0)
+    snap = router.snapshot()
+    return {
+        "seed": seed,
+        "hours": hours,
+        "hourS": hour_s,
+        "injections": injections,
+        "witness": witness_report,
+        "traffic": {
+            "outcomes": outcomes,
+            "scaleOut": {"signals": snap["scaleOut"]["signals"],
+                         "bound": list(provisioned_pods)},
+        },
+        "fleetByHour": fleet_by_hour,
+        "guaranteeEvictions": guarantee_evictions,
+        "scalars": {
+            "pod_slo_compliance_pct": round(compliance, 2),
+            "router_fairness_jain": fairness,
+            "node_hours": node_hours,
+            "peak_static_node_hours": peak_static,
+            "node_hours_ratio": (round(node_hours / peak_static, 4)
+                                 if peak_static else None),
+            "guarantee_evictions": len(guarantee_evictions),
+        },
+    }
+
+
 def _quota_configmap(scenario: dict) -> dict | None:
     """Scenario ``quotas:`` table -> the tpushare-quotas ConfigMap doc
     (None when the scenario declares no quotas)."""
@@ -1002,7 +1635,7 @@ def _gang_topology(inspect_doc) -> list[dict]:
 def _report(inspect_doc, placements, held, unschedulable,
             latencies, executed_preemptions=(), tenants=(),
             slo_doc=None, defrag_report=None, serving_report=None,
-            autoscale_report=None):
+            autoscale_report=None, fleet_day_report=None):
     nodes = []
     total_hbm = used_hbm = free_whole_chips = cordoned_hbm = 0
     for n in inspect_doc.get("nodes", []):
@@ -1051,6 +1684,7 @@ def _report(inspect_doc, placements, held, unschedulable,
         **({"defrag": defrag_report} if defrag_report else {}),
         **({"serving": serving_report} if serving_report else {}),
         **({"autoscale": autoscale_report} if autoscale_report else {}),
+        **({"fleet_day": fleet_day_report} if fleet_day_report else {}),
     }
 
 
@@ -1236,6 +1870,34 @@ def _print_human(report: dict) -> None:
                        else "DID NOT drain")
             print(f"  scale-out: {s['scaleOut']['signals']} "
                   f"signal(s), bound {scaled or 'none'}; {drained}")
+    if report.get("fleet_day"):
+        fd = report["fleet_day"]
+        if fd.get("error"):
+            print(f"\nfleet-day: {fd['error']}")
+        else:
+            w = fd["witness"]
+            c = w["counts"]
+            print(f"\nfleet-day (seed {fd['seed']}, {fd['hours']}h x "
+                  f"{fd['hourS']:g}s): witness "
+                  f"{'PASS' if w['pass'] else 'FAIL'} — "
+                  f"{c['matched']} matched, {c['late']} late, "
+                  f"{c['missing']} missing, {c['spurious']} spurious "
+                  f"({w['conformancePct']}% conformance)")
+            for v in w["verdicts"]:
+                lag = (f"marker +{v['markerLagS']}s"
+                       if v["markerLagS"] is not None else "no marker")
+                bad = ",".join(k for k, ok in v["legs"].items()
+                               if ok is False)
+                print(f"  {v['verdict']:8s} {v['id']} ({v['kind']}) "
+                      f"{lag}"
+                      + (f"; failed leg(s): {bad}" if bad else ""))
+            s = fd["scalars"]
+            print(f"  slo compliance {s['pod_slo_compliance_pct']}%, "
+                  f"fairness J {s['router_fairness_jain']}, "
+                  f"node-hours {s['node_hours']:g}/"
+                  f"{s['peak_static_node_hours']:g} "
+                  f"(ratio {s['node_hours_ratio']}), guarantee "
+                  f"evictions {s['guarantee_evictions']}")
     timeline = report.get("timeline")
     if timeline:
         series = timeline.get("series") or {}
@@ -1507,6 +2169,17 @@ def main() -> None:
                          "(surge -> shed the flooder -> scale-out "
                          "binds a decode pod -> queues drain) and "
                          "exit")
+    ap.add_argument("--example-fleet-day", action="store_true",
+                    help="print the fleet-day witness demo scenario "
+                         "(one seeded, compressed 24h day: quota "
+                         "apply, surge, NotReady host, defrag wave, "
+                         "autoscale up/down — every act graded by "
+                         "the fleet-day witness) and exit")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="seed the fleet-day RNG (overrides the "
+                         "scenario's fleet_day.seed); two runs with "
+                         "the same seed produce identical witness "
+                         "verdicts and scalars")
     ap.add_argument("--example-topology", action="store_true",
                     help="print a topology-aware gang placement demo "
                          "scenario (fragmented host torus; the same "
@@ -1540,6 +2213,9 @@ def main() -> None:
     if args.example_serving:
         print(EXAMPLE_SERVING, end="")
         return
+    if args.example_fleet_day:
+        print(EXAMPLE_FLEET_DAY, end="")
+        return
     if args.example_topology:
         print(EXAMPLE_TOPOLOGY, end="")
         return
@@ -1568,7 +2244,7 @@ def main() -> None:
             _print_defrag(report)
         return
     scenario = load_scenario(args.scenario)
-    report = simulate(scenario)
+    report = simulate(scenario, seed=args.seed)
     if scenario.get("topology_compare"):
         # The same scenario replayed with the slice placer DISABLED
         # (TPUSHARE_TOPOLOGY=off, exactly the production kill switch):
@@ -1578,7 +2254,7 @@ def main() -> None:
         saved = os.environ.get("TPUSHARE_TOPOLOGY")
         os.environ["TPUSHARE_TOPOLOGY"] = "off"
         try:
-            blind = simulate(scenario)
+            blind = simulate(scenario, seed=args.seed)
         finally:
             if saved is None:
                 os.environ.pop("TPUSHARE_TOPOLOGY", None)

@@ -118,6 +118,10 @@ GUARDED_FIELDS: dict[str, tuple[str, ...]] = {
     # lock-free — GIL-atomic deque appends on the gated hot path.)
     "TimelineRecorder": ("_series", "_sources"),
     "AnomalyEngine": ("_fired", "_event_at"),
+    # The fleet-day witness (tpushare/obs/witness.py): HTTP/controller
+    # threads tee markers and Events in while the replay driver stakes
+    # expectations, evaluates, and the scrape reads the verdict totals.
+    "FleetDayWitness": ("_expectations", "_events", "_counts"),
     # The black-box journal (tpushare/obs/blackbox.py): the writer
     # thread drains and rotates segments while the SIGTERM flush and
     # /debug/blackbox readers touch the open file handle and its

@@ -236,7 +236,8 @@ def build_stack(client, is_leader=None) -> Stack:
     # gets history for free.
     from tpushare import obs
     obs.wire(client=client, demand=predicate.demand,
-             defrag=controller.defrag, workqueue=controller.queue)
+             defrag=controller.defrag, workqueue=controller.queue,
+             nodes=controller.hub.nodes.list)
     obs.start()
     return Stack(controller, predicate, prioritize, binder, inspect,
                  preempt, admission)

@@ -109,7 +109,7 @@ class Controller:
         # (capacity, sharing annotation) must land in the cache from the
         # watch instead of being discovered per filter call.
         self.hub.add_node_handler(
-            on_update=lambda old, new: self.cache.refresh_node(new),
+            on_update=self._on_node_update,
             on_delete=self._on_node_delete)
         self.hub.add_configmap_handler(
             on_add=self._on_quota_configmap,
@@ -258,6 +258,29 @@ class Controller:
         with self._removed_lock:
             self._removed[pod.key()] = pod
         self.queue.add(pod.key())
+
+    def _on_node_update(self, old, new) -> None:
+        """Node document changed: refresh the cached ledger (capacity,
+        sharing annotation — the verb fast paths serve cached state),
+        and surface a Ready→NotReady transition as a host-failure
+        marker + Warning Event. Only the edge fires — a node that
+        STAYS NotReady across status heartbeats must not flood the
+        timeline; recovery is visible as the fleet_nodes_ready series
+        climbing back."""
+        self.cache.refresh_node(new)
+        if old is not None and old.ready and not new.ready:
+            cursor = obs.mark("node-notready",
+                              f"node {new.name} NotReady",
+                              node=new.name)
+            pod = Pod({"metadata": {"name": "tpushare-scheduler-extender",
+                                    "namespace": "kube-system",
+                                    "uid": ""}})
+            events.record(
+                self.client, pod, events.REASON_NODE_NOTREADY,
+                f"node {new.name} transitioned to NotReady; its chips "
+                f"stay in the ledger until the Node object is deleted "
+                f"[timeline {cursor}]",
+                event_type="Warning", trace_id="")
 
     def _on_node_delete(self, node) -> None:
         """Node object deleted from the apiserver: drop its ledger so its

@@ -102,6 +102,7 @@ REASON_DEFRAG_MOVE = "TPUShareDefragMove"
 REASON_DEFRAG_ABORTED = "TPUShareDefragAborted"
 REASON_AUTOSCALE_ABORTED = "TPUShareAutoscaleAborted"
 REASON_ANOMALY = "TPUShareAnomaly"
+REASON_NODE_NOTREADY = "TPUShareNodeNotReady"
 
 
 def record(client, pod: Pod, reason: str, message: str,

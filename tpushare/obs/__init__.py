@@ -27,7 +27,7 @@ taxonomy, and the burn → cursor → timeline → exemplar → trace runbook.
 from __future__ import annotations
 
 import time
-from typing import Any
+from typing import Any, Callable
 
 from tpushare.obs import sources
 from tpushare.obs.anomaly import AnomalyEngine, Rule
@@ -36,19 +36,26 @@ from tpushare.obs.exemplars import ExemplarStore
 from tpushare.obs.export import Exporter, export_url
 from tpushare.obs.timeline import (MARKER_KINDS, TimelineRecorder,
                                    enabled)
+from tpushare.obs.witness import FleetDayWitness
 
 __all__ = [
     "AnomalyEngine", "BlackboxJournal", "ExemplarStore", "Exporter",
-    "MARKER_KINDS", "Rule", "TimelineRecorder", "anomalies",
-    "annotate_metrics", "blackbox", "blackbox_snapshot", "enabled",
-    "exemplars", "exporter", "flush_blackbox", "mark", "mark_drops",
-    "note_verb", "replay_startup", "reset", "snapshot", "sources",
-    "start", "stop", "stop_blackbox", "timeline", "wire",
+    "FleetDayWitness", "MARKER_KINDS", "Rule", "TimelineRecorder",
+    "anomalies", "annotate_metrics", "blackbox", "blackbox_snapshot",
+    "enabled", "exemplars", "exporter", "flush_blackbox", "mark",
+    "mark_drops", "note_verb", "replay_startup", "reset", "set_clock",
+    "snapshot", "sources", "start", "stop", "stop_blackbox",
+    "timeline", "wire", "witness",
 ]
 
 _timeline = TimelineRecorder()
 _anomalies = AnomalyEngine(_timeline)
 _exemplars = ExemplarStore()
+_witness = FleetDayWitness()
+#: The observability clock. mark() stamps with this; set_clock() swaps
+#: it (and the recorder/anomaly/witness clocks) for the fleet-day
+#: scenario's compressed day. Always time.time outside that replay.
+_clock: Callable[[], float] = time.time
 #: Armed iff TPUSHARE_BLACKBOX_DIR / TPUSHARE_EXPORT_URL are set —
 #: None otherwise, and every tee below checks before touching them.
 _blackbox: BlackboxJournal | None = None
@@ -85,12 +92,31 @@ def exporter() -> Exporter | None:
     return _exporter
 
 
+def witness() -> FleetDayWitness:
+    return _witness
+
+
+def set_clock(now_fn: Callable[[], float] | None) -> None:
+    """Swap the observability clock — marker stamps, sampler ticks,
+    anomaly evaluation, and the witness all read it — so the fleet-day
+    scenario's compressed day lands in the tiered rings on the
+    scenario clock, not wall time. ``None`` restores ``time.time``.
+    Callers must restore in a finally: every other consumer of the
+    rings assumes wall-clock timestamps."""
+    global _clock
+    _clock = now_fn if now_fn is not None else time.time
+    _timeline.set_now(_clock)
+    _anomalies.set_now(_clock)
+    _witness.set_now(_clock)
+
+
 # -- wiring ---------------------------------------------------------------- #
 
 
 def wire(client: object | None = None, demand: object | None = None,
          defrag: object | None = None, workqueue: object | None = None,
-         router: object | None = None) -> None:
+         router: object | None = None,
+         nodes: object | None = None) -> None:
     """Register sample sources for whatever subsystems exist (replaces
     any prior registration under the same name) and arm anomaly Event
     emission. Called from ``build_stack``; safe to call repeatedly."""
@@ -104,6 +130,8 @@ def wire(client: object | None = None, demand: object | None = None,
                              sources.workqueue_source(workqueue))
     if router is not None:
         _timeline.add_source("router", sources.router_source(router))
+    if nodes is not None:
+        _timeline.add_source("fleet", sources.fleet_source(nodes))
     if client is not None:
         _anomalies.set_client(client)
 
@@ -282,11 +310,13 @@ def mark(kind: str, detail: str = "", trace_id: str | None = None,
             trace_id = trace.current_trace_id()
         if trace_id:
             str_attrs["trace_id"] = trace_id
-        ts = time.time()
+        ts = _clock()
         cursor = _timeline.mark(kind, detail, str_attrs, ts=ts)
         # Tee the marker to the durable journal/exporter AFTER the
         # timeline accepted it (an invalid kind raised above and is
-        # never journaled, so replay can trust journaled kinds).
+        # never journaled, so replay can trust journaled kinds) —
+        # and to the fleet-day witness, which no-ops unless armed.
+        _witness.observe_marker(kind, ts, detail, str_attrs)
         _tee({"t": "marker", "ts": ts, "cursor": cursor, "kind": kind,
               "detail": detail, "attrs": str_attrs})
         return cursor
@@ -348,8 +378,10 @@ def reset() -> None:
     """Stop the sampler and drop all retrospective state (tests)."""
     global _replayed
     stop_blackbox()
+    set_clock(None)
     _replayed = False
     _timeline.reset()
     _anomalies.reset()
     _exemplars.reset()
+    _witness.reset()
     _hook_anomalies()

@@ -126,6 +126,12 @@ class AnomalyEngine:
             self._lock, "AnomalyEngine._event_at")
         self.drops = DropCounter()
 
+    def set_now(self, now_fn: Callable[[], float]) -> None:
+        """Swap the engine's clock (fleet-day scenario clock; see
+        :meth:`TimelineRecorder.set_now`)."""
+        with self._lock:
+            self._now = now_fn
+
     def set_client(self, client: object) -> None:
         """Arm Event emission (marker + counter fire regardless)."""
         with self._lock:

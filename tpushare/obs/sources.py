@@ -84,6 +84,18 @@ def workqueue_source(workqueue: Any) -> Callable[[], dict[str, float]]:
     return sample
 
 
+def fleet_source(node_lister: Any) -> Callable[[], dict[str, float]]:
+    """Fleet size and readiness from the node informer's lister — the
+    autoscale and NotReady metric legs of the fleet-day witness."""
+    def sample() -> dict[str, float]:
+        nodes = node_lister()
+        ready = sum(1 for n in nodes
+                    if n.ready and not n.unschedulable)
+        return {"fleet_nodes": float(len(nodes)),
+                "fleet_nodes_ready": float(ready)}
+    return sample
+
+
 def router_source(router: Any) -> Callable[[], dict[str, float]]:
     """Serving queue pressure — the scale-out signal's raw input."""
     def sample() -> dict[str, float]:

@@ -72,7 +72,7 @@ MARKER_KINDS = frozenset({
     "leader", "defrag-plan", "defrag-abort", "router-scaleout",
     "slo-burn", "config", "gang-commit", "gang-rollback", "anomaly",
     "autoscale-up", "autoscale-down", "autoscale-abort",
-    "restart", "journal-rotate", "export-stall",
+    "restart", "journal-rotate", "export-stall", "node-notready",
 })
 
 
@@ -172,6 +172,14 @@ class TimelineRecorder:
         self.mark_drops = DropCounter()
         #: Per-tick callbacks (the anomaly engine hooks in here).
         self._tick_hooks: list[Callable[[float], None]] = []
+
+    def set_now(self, now_fn: Callable[[], float]) -> None:
+        """Swap the recorder's clock. The fleet-day gate replays a
+        compressed day on a scenario clock so samples and markers land
+        in the tiered rings at scenario time, not wall time; tests and
+        the gate restore ``time.time`` via ``obs.set_clock(None)``."""
+        with self._lock:
+            self._now = now_fn
 
     # -- lifecycle -------------------------------------------------------- #
 

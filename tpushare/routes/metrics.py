@@ -735,6 +735,34 @@ TIMELINE_SERIES = Gauge(
     "the coldest series is evicted per new one)",
     registry=REGISTRY,
 )
+WITNESS_MATCHED = Gauge(
+    "tpushare_witness_events_matched_total",
+    "Fleet-day witness verdicts: injected events whose declared "
+    "marker/Event/metric legs all surfaced inside the conformance "
+    "window (monotonic, set at scrape from the witness counters)",
+    registry=REGISTRY,
+)
+WITNESS_LATE = Gauge(
+    "tpushare_witness_events_late_total",
+    "Fleet-day witness verdicts: injected events whose legs all "
+    "surfaced but whose marker landed past the conformance window "
+    "(monotonic, set at scrape)",
+    registry=REGISTRY,
+)
+WITNESS_MISSING = Gauge(
+    "tpushare_witness_events_missing_total",
+    "Fleet-day witness verdicts: injected events with at least one "
+    "declared leg that never surfaced — the page that would not have "
+    "fired (monotonic, set at scrape)",
+    registry=REGISTRY,
+)
+WITNESS_SPURIOUS = Gauge(
+    "tpushare_witness_events_spurious_total",
+    "Fleet-day witness verdicts: observed markers of witnessed kinds "
+    "no expectation's window explains — the page that fired for "
+    "nothing (monotonic, set at scrape)",
+    registry=REGISTRY,
+)
 
 
 #: Process birth for tpushare_uptime_seconds — import time of this
@@ -773,6 +801,11 @@ def observe_timeline() -> None:
         ANOMALIES_FIRED.clear()
         for rule, count in obs.anomalies().fired_counts().items():
             ANOMALIES_FIRED.labels(rule=rule).set(count)
+        counts = obs.witness().counts()
+        WITNESS_MATCHED.set(counts["matched"])
+        WITNESS_LATE.set(counts["late"])
+        WITNESS_MISSING.set(counts["missing"])
+        WITNESS_SPURIOUS.set(counts["spurious"])
 
 
 def observe_cache(cache) -> None:

@@ -48,6 +48,9 @@ Routes:
   verb-latency exemplars (``?window=`` seconds, ``?series=`` comma
   list of name prefixes, ``?markers=0`` omits the marker lane;
   docs/observability.md §Retrospective)
+* ``GET  /debug/fleetday`` — the fleet-day witness: injected-event
+  expectation schedule, observation counts, and the last conformance
+  verdict report (docs/observability.md §8)
 
 The scheduling verbs run inside :mod:`tpushare.trace` phases, so every
 TPU pod's filter → prioritize → (preempt) → bind story is captured
@@ -697,6 +700,11 @@ class _Handler(BaseHTTPRequestHandler):
                                   "TPUSHARE_EXPORT_URL)"}, 404)
                 else:
                     self._send_json(doc)
+            elif path == "/debug/fleetday":
+                # The fleet-day witness verdict: expectation schedule,
+                # observation counts, and the last evaluate() report
+                # (null until a fleet-day replay has run).
+                self._send_json(obs.witness().snapshot())
             elif path == "/debug/trace":
                 # The causal-chain resolver: /debug/trace?id=<trace-id>
                 # → target + ancestors + descendants, across
